@@ -1,0 +1,26 @@
+"""nomad_tpu — a TPU-native cluster-scheduling framework.
+
+A ground-up re-design of the capabilities of HashiCorp Nomad 0.5
+(reference: /root/reference, pure Go) with a JAX/XLA placement engine:
+instead of a per-node iterator chain (reference scheduler/stack.go), the
+scheduling worker batches evaluations into dense node x task-group
+resource/constraint matrices and solves feasibility, BestFit-v3 scoring
+and selection in one vectorized pass on TPU.
+
+Layering (mirrors SURVEY.md section 1):
+  structs/    data model (Job, Node, Allocation, Evaluation, Plan, ...)
+  state/      MVCC in-memory state store with watch notifications
+  scheduler/  CPU reference scheduler (correctness oracle) + TPU factories
+  ops/        JAX kernels: feasibility masks, bin-pack scoring, selection
+  models/     the batched placer "model" (matrix building, bucketing)
+  parallel/   device-mesh sharding of the node axis (pjit/shard_map)
+  server/     control plane: log/FSM, eval broker, plan queue/applier, worker
+  client/     client agent: fingerprints, alloc/task runners, drivers
+  api/        HTTP API + Python SDK
+  jobspec/    job specification parsing
+  cli/        command line interface
+"""
+
+__version__ = "0.1.0"
+# Matches reference version.go:8 capability target (Nomad 0.5.0-dev).
+API_MAJOR_VERSION = 1

@@ -1,0 +1,4 @@
+from .store import PeriodicLaunch, StateSnapshot, StateStore
+from . import watch
+
+__all__ = ["PeriodicLaunch", "StateSnapshot", "StateStore", "watch"]

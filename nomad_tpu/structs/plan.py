@@ -1,0 +1,92 @@
+"""Plan and PlanResult — the scheduler's proposed state mutations.
+
+Reference: nomad/structs/structs.go:3435 (Plan), :3528 (PlanResult),
+:3475 (AppendUpdate), :3503 (PopUpdate), :3516 (AppendAlloc).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import consts
+from .alloc import Allocation, AllocMetric
+from .job import Job
+
+
+@dataclass
+class Plan:
+    eval_id: str = ""
+    eval_token: str = ""  # split-brain guard: must match broker's token
+    priority: int = 0
+    all_at_once: bool = False  # gang commit: reject unless fully applicable
+    job: Optional[Job] = None
+    # node id -> allocs to update/evict on that node
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # node id -> new allocations for that node
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    annotations: Optional["PlanAnnotations"] = None
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+
+    def append_update(
+        self, alloc: Allocation, desired_status: str, description: str
+    ) -> None:
+        """Record an evict/stop of an existing alloc. The copied alloc is
+        stripped of its embedded job to keep the plan small (the reference
+        nulls Job on updates, structs.go:3475)."""
+        new_alloc = alloc.copy()
+        new_alloc.job = None
+        new_alloc.desired_status = desired_status
+        new_alloc.desired_description = description
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def pop_update(self, alloc: Allocation) -> None:
+        """Undo the most recent staged update for alloc (used by the
+        in-place-update path when the re-selection fails)."""
+        updates = self.node_update.get(alloc.node_id, [])
+        if updates and updates[-1].id == alloc.id:
+            updates.pop()
+            if not updates:
+                del self.node_update[alloc.node_id]
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def is_no_op(self) -> bool:
+        return not self.node_update and not self.node_allocation
+
+    def copy(self) -> "Plan":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PlanResult:
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    refresh_index: int = 0  # worker must refresh its snapshot to this index
+    alloc_index: int = 0  # raft index the accepted allocs committed at
+
+    def is_no_op(self) -> bool:
+        return not self.node_update and not self.node_allocation
+
+    def full_commit(self, plan: Plan) -> tuple:
+        """Compare attempted vs accepted placements: (full, expected, actual)."""
+        expected = sum(len(v) for v in plan.node_allocation.values())
+        actual = sum(len(v) for v in self.node_allocation.values())
+        return expected == actual, expected, actual
+
+
+@dataclass
+class PlanAnnotations:
+    desired_tg_updates: Dict[str, "DesiredUpdates"] = field(default_factory=dict)
+
+
+@dataclass
+class DesiredUpdates:
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0

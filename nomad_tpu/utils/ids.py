@@ -1,0 +1,10 @@
+import uuid
+
+
+def generate_uuid() -> str:
+    """Random identifier for jobs-internal objects (allocs, evals, nodes).
+
+    Same shape as the reference's structs.GenerateUUID
+    (reference nomad/structs/structs.go uses crypto/rand hex-8-4-4-4-12).
+    """
+    return str(uuid.uuid4())

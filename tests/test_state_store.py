@@ -1,0 +1,243 @@
+"""State store tests (mirror nomad/state/state_store_test.go scenarios)."""
+
+import threading
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore, watch
+from nomad_tpu.structs import consts
+
+
+def test_upsert_node_and_indexes():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1000, n)
+    out = s.node_by_id(n.id)
+    assert out.id == n.id
+    assert out.create_index == 1000 and out.modify_index == 1000
+    assert s.index("nodes") == 1000
+    assert s.latest_index() == 1000
+
+
+def test_update_node_status():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1, n)
+    s.update_node_status(2, n.id, consts.NODE_STATUS_DOWN)
+    assert s.node_by_id(n.id).status == consts.NODE_STATUS_DOWN
+    assert s.node_by_id(n.id).modify_index == 2
+
+
+def test_update_node_drain():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1, n)
+    s.update_node_drain(2, n.id, True)
+    assert s.node_by_id(n.id).drain is True
+
+
+def test_snapshot_isolation():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1, n)
+    snap = s.snapshot()
+    n2 = mock.node()
+    s.upsert_node(2, n2)
+    assert len(snap.nodes()) == 1
+    assert len(s.snapshot().nodes()) == 2
+    assert snap.latest_index() == 1
+
+
+def test_upsert_job_preserves_create_index():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(10, j)
+    j2 = j.copy()
+    j2.priority = 70
+    s.upsert_job(20, j2)
+    out = s.job_by_id(j.id)
+    assert out.create_index == 10
+    assert out.modify_index == 20
+    assert out.job_modify_index == 20
+    assert out.priority == 70
+
+
+def test_job_summary_created():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(10, j)
+    summary = s.job_summary_by_id(j.id)
+    assert summary is not None
+    assert "web" in summary.summary
+
+
+def test_upsert_allocs_and_queries():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(5, j)
+    a = mock.alloc()
+    a.job = j
+    a.job_id = j.id
+    s.upsert_allocs(10, [a])
+    assert s.alloc_by_id(a.id).id == a.id
+    assert [x.id for x in s.allocs_by_job(j.id)] == [a.id]
+    assert [x.id for x in s.allocs_by_node(a.node_id)] == [a.id]
+    assert [x.id for x in s.allocs_by_eval(a.eval_id)] == [a.id]
+    # job derived status: alloc is non-terminal -> running
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_RUNNING
+
+
+def test_upsert_allocs_preserves_client_status():
+    s = StateStore()
+    a = mock.alloc()
+    s.upsert_allocs(10, [a])
+    cl = a.copy()
+    cl.client_status = consts.ALLOC_CLIENT_RUNNING
+    s.update_allocs_from_client(11, [cl])
+    # scheduler-side re-upsert must not clobber the client status
+    sched = a.copy()
+    sched.desired_status = consts.ALLOC_DESIRED_RUN
+    s.upsert_allocs(12, [sched])
+    out = s.alloc_by_id(a.id)
+    assert out.client_status == consts.ALLOC_CLIENT_RUNNING
+    assert out.modify_index == 12
+
+
+def test_update_allocs_from_client_keeps_alloc_modify_index():
+    s = StateStore()
+    a = mock.alloc()
+    s.upsert_allocs(10, [a])
+    cl = a.copy()
+    cl.client_status = consts.ALLOC_CLIENT_RUNNING
+    s.update_allocs_from_client(11, [cl])
+    out = s.alloc_by_id(a.id)
+    assert out.alloc_modify_index == 10  # client writes don't bump it
+    assert out.modify_index == 11
+
+
+def test_allocs_by_node_terminal():
+    s = StateStore()
+    a1 = mock.alloc()
+    a2 = mock.alloc()
+    a2.node_id = a1.node_id
+    a2.desired_status = consts.ALLOC_DESIRED_STOP
+    s.upsert_allocs(10, [a1, a2])
+    live = s.allocs_by_node_terminal(a1.node_id, False)
+    term = s.allocs_by_node_terminal(a1.node_id, True)
+    assert [a.id for a in live] == [a1.id]
+    assert [a.id for a in term] == [a2.id]
+
+
+def test_upsert_evals_and_job_summary_queued():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(5, j)
+    e = mock.eval()
+    e.job_id = j.id
+    e.queued_allocations = {"web": 4}
+    s.upsert_evals(10, [e])
+    assert s.eval_by_id(e.id).modify_index == 10
+    assert [x.id for x in s.evals_by_job(j.id)] == [e.id]
+    assert s.job_summary_by_id(j.id).summary["web"].queued == 4
+    # eval pending + no allocs -> job pending
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_PENDING
+
+
+def test_delete_evals_and_allocs():
+    s = StateStore()
+    e = mock.eval()
+    a = mock.alloc()
+    s.upsert_evals(10, [e])
+    s.upsert_allocs(11, [a])
+    s.delete_evals(12, [e.id], [a.id])
+    assert s.eval_by_id(e.id) is None
+    assert s.alloc_by_id(a.id) is None
+    assert s.allocs_by_job(a.job_id) == []
+
+
+def test_job_status_dead_after_terminal():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(5, j)
+    e = mock.eval()
+    e.job_id = j.id
+    s.upsert_evals(6, [e])
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_PENDING
+    e2 = e.copy()
+    e2.status = consts.EVAL_STATUS_COMPLETE
+    s.upsert_evals(7, [e2])
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_DEAD
+
+
+def test_watch_fires_on_write():
+    s = StateStore()
+    ev = s.watch([watch.table("nodes")])
+    assert not ev.is_set()
+    s.upsert_node(1, mock.node())
+    assert ev.wait(1.0)
+
+
+def test_watch_scoped_to_job():
+    s = StateStore()
+    j1, j2 = mock.job(), mock.job()
+    s.upsert_job(1, j1)
+    s.upsert_job(2, j2)
+    a1 = mock.alloc()
+    a1.job_id = j1.id
+    ev = s.watch([watch.alloc_job(j2.id)])
+    s.upsert_allocs(3, [a1])
+    assert not ev.is_set()
+    a2 = mock.alloc()
+    a2.job_id = j2.id
+    s.upsert_allocs(4, [a2])
+    assert ev.wait(1.0)
+
+
+def test_persist_restore_roundtrip():
+    s = StateStore()
+    j = mock.job()
+    n = mock.node()
+    e = mock.eval()
+    a = mock.alloc()
+    a.job_id = j.id
+    s.upsert_job(1, j)
+    s.upsert_node(2, n)
+    s.upsert_evals(3, [e])
+    s.upsert_allocs(4, [a])
+    data = s.persist()
+    s2 = StateStore.restore(data)
+    assert s2.latest_index() == 4
+    assert s2.job_by_id(j.id) is not None
+    assert s2.node_by_id(n.id) is not None
+    assert s2.eval_by_id(e.id) is not None
+    assert [x.id for x in s2.allocs_by_job(j.id)] == [a.id]
+
+
+def test_concurrent_snapshot_consistency():
+    """Writers must never corrupt a reader's snapshot."""
+    s = StateStore()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 1
+        while not stop.is_set():
+            s.upsert_node(i, mock.node())
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            snap = s.snapshot()
+            nodes = snap.nodes()
+            if len(nodes) != len(snap.nodes()):
+                errors.append("snapshot changed size")
+
+    threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []

@@ -1,0 +1,240 @@
+"""Data-model tests (mirror nomad/structs/*_test.go scenarios)."""
+
+import math
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    Bitmap,
+    Constraint,
+    Job,
+    NetworkIndex,
+    NetworkResource,
+    Port,
+    Resources,
+    allocs_fit,
+    consts,
+    escaped_constraints,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from nomad_tpu.utils.codec import decode, encode, from_dict, to_dict
+
+
+# ---------------------------------------------------------------- resources
+
+def test_resources_superset():
+    big = Resources(cpu=2000, memory_mb=2048, disk_mb=10000, iops=100)
+    small = Resources(cpu=2000, memory_mb=1024, disk_mb=5000, iops=50)
+    ok, dim = big.superset(small)
+    assert ok and dim == ""
+    ok, dim = small.superset(big)
+    assert not ok and dim == "memory"
+
+
+def test_resources_add():
+    r = Resources(cpu=100, memory_mb=100, disk_mb=100,
+                  networks=[NetworkResource(mbits=50, reserved_ports=[Port("a", 80)])])
+    d = Resources(cpu=200, memory_mb=50, disk_mb=50,
+                  networks=[NetworkResource(mbits=25, reserved_ports=[Port("b", 443)])])
+    r.add(d)
+    assert r.cpu == 300 and r.memory_mb == 150 and r.disk_mb == 150
+    assert r.networks[0].mbits == 75
+    assert len(r.networks[0].reserved_ports) == 2
+
+
+# ---------------------------------------------------------------- fit/score
+
+def test_allocs_fit_empty():
+    n = mock.node()
+    fit, dim, used = allocs_fit(n, [])
+    assert fit
+    assert used.cpu == n.reserved.cpu
+
+
+def test_allocs_fit_and_overflow():
+    n = mock.node()
+    a = mock.alloc()
+    fit, _, _ = allocs_fit(n, [a])
+    assert fit
+    # Fill the node beyond capacity
+    a2 = mock.alloc()
+    a2.resources = Resources(cpu=10000, memory_mb=10000)
+    fit, dim, _ = allocs_fit(n, [a, a2])
+    assert not fit
+    assert dim in ("cpu", "memory")
+
+
+def test_allocs_fit_port_collision():
+    n = mock.node()
+    a1 = mock.alloc()
+    a2 = mock.alloc()  # same reserved port 5000 on the same IP
+    fit, dim, _ = allocs_fit(n, [a1, a2])
+    assert not fit
+    assert dim == "reserved port collision"
+
+
+def test_score_fit():
+    n = mock.node()
+    n.reserved = None
+    empty = Resources()
+    assert score_fit(n, empty) == pytest.approx(0.0)
+    full = Resources(cpu=n.resources.cpu, memory_mb=n.resources.memory_mb)
+    assert score_fit(n, full) == pytest.approx(18.0)
+    half = Resources(cpu=n.resources.cpu // 2, memory_mb=n.resources.memory_mb // 2)
+    expected = 20 - 2 * math.pow(10, 0.5)
+    assert score_fit(n, half) == pytest.approx(expected, rel=1e-3)
+
+
+# ---------------------------------------------------------------- network
+
+def test_network_index_assign():
+    n = mock.node()
+    idx = NetworkIndex()
+    assert not idx.set_node(n)
+    ask = NetworkResource(mbits=100, reserved_ports=[Port("main", 8000)],
+                          dynamic_ports=[Port("http", 0)])
+    offer, err = idx.assign_network(ask)
+    assert err == "" and offer is not None
+    assert offer.ip == "192.168.0.100"
+    assert offer.reserved_ports[0].value == 8000
+    dyn = offer.dynamic_ports[0].value
+    assert consts.MIN_DYNAMIC_PORT <= dyn < consts.MAX_DYNAMIC_PORT
+
+
+def test_network_index_reserved_collision():
+    n = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(n)
+    ask = NetworkResource(mbits=10, reserved_ports=[Port("ssh", 22)])
+    offer, err = idx.assign_network(ask)
+    assert offer is None
+    assert err == "reserved port collision"
+
+
+def test_network_index_bandwidth_exceeded():
+    n = mock.node()
+    idx = NetworkIndex()
+    idx.set_node(n)
+    ask = NetworkResource(mbits=2000)
+    offer, err = idx.assign_network(ask)
+    assert offer is None
+    assert err == "bandwidth exceeded"
+
+
+def test_bitmap():
+    b = Bitmap(1024)
+    b.set(42)
+    assert b.check(42) and not b.check(41)
+    assert 42 not in b.indexes_in_range(False, 0, 100)
+    assert 42 in b.indexes_in_range(True, 0, 100)
+    c = b.copy()
+    c.set(43)
+    assert not b.check(43)
+
+
+# ---------------------------------------------------------------- node class
+
+def test_computed_class_stable_and_unique_excluded():
+    n1 = mock.node()
+    n2 = mock.node()  # different id, same capabilities
+    n2.compute_class()
+    assert n1.computed_class == n2.computed_class
+
+    n3 = mock.node()
+    n3.meta["unique.cache_key"] = "x"
+    n3.compute_class()
+    assert n3.computed_class == n1.computed_class
+
+    n4 = mock.node()
+    n4.meta["rack"] = "r1"
+    n4.compute_class()
+    assert n4.computed_class != n1.computed_class
+
+
+def test_escaped_constraints():
+    cs = [
+        Constraint(ltarget="${attr.kernel.name}", rtarget="linux", operand="="),
+        Constraint(ltarget="${node.unique.id}", rtarget="x", operand="="),
+        Constraint(ltarget="${meta.unique.foo}", rtarget="y", operand="="),
+    ]
+    esc = escaped_constraints(cs)
+    assert len(esc) == 2
+
+
+# ---------------------------------------------------------------- allocs
+
+def test_filter_terminal_allocs():
+    live = mock.alloc()
+    dead1 = mock.alloc()
+    dead1.name = "t[0]"
+    dead1.desired_status = consts.ALLOC_DESIRED_STOP
+    dead1.create_index = 5
+    dead2 = mock.alloc()
+    dead2.name = "t[0]"
+    dead2.desired_status = consts.ALLOC_DESIRED_STOP
+    dead2.create_index = 10
+    remaining, terminal = filter_terminal_allocs([live, dead1, dead2])
+    assert remaining == [live]
+    assert terminal["t[0]"] is dead2
+
+
+def test_remove_allocs():
+    a, b = mock.alloc(), mock.alloc()
+    assert remove_allocs([a, b], [a]) == [b]
+
+
+def test_alloc_index():
+    a = mock.alloc()
+    a.name = "job.web[7]"
+    assert a.index() == 7
+
+
+# ---------------------------------------------------------------- job
+
+def test_job_validate():
+    j = mock.job()
+    assert j.validate() == []
+    j.id = ""
+    assert any("ID" in e for e in j.validate())
+
+
+def test_job_validate_dup_groups():
+    j = mock.job()
+    j.task_groups.append(j.task_groups[0].copy())
+    assert any("duplicate" in e for e in j.validate())
+
+
+def test_periodic_next():
+    from nomad_tpu.structs import PeriodicConfig
+    import time
+
+    p = PeriodicConfig(enabled=True, spec="*/15 * * * *")
+    assert p.validate() == []
+    nxt = p.next_launch(time.time())
+    assert nxt is not None and nxt > time.time()
+
+
+# ---------------------------------------------------------------- codec
+
+def test_codec_roundtrip_job():
+    j = mock.job()
+    data = encode(j)
+    j2 = decode(Job, data)
+    assert j2 == j
+
+
+def test_codec_roundtrip_alloc():
+    a = mock.alloc()
+    a2 = from_dict(Allocation, to_dict(a))
+    assert a2 == a
+
+
+def test_codec_roundtrip_node():
+    n = mock.node()
+    from nomad_tpu.structs import Node
+
+    assert from_dict(Node, to_dict(n)) == n
