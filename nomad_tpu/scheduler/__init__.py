@@ -1,0 +1,103 @@
+"""Scheduler factories and interfaces.
+
+Reference: scheduler/scheduler.go:13 (BuiltinSchedulers), :21
+(NewScheduler), :44 (Scheduler iface), :55 (State iface), :77 (Planner
+iface).
+
+The TPU backend registers here as additional factories ("service-tpu",
+"batch-tpu") so the broker/worker loop selects it per-eval without
+touching the control plane — the north-star design in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, Optional, Protocol, Tuple
+
+from ..structs import Evaluation, Plan, PlanResult
+from .generic import GenericScheduler
+from .system import SystemScheduler
+
+
+class Planner(Protocol):
+    """What a scheduler needs from its host (the worker / harness)."""
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        """Submit a plan; returns (result, refreshed-state-or-None)."""
+        ...
+
+    def update_eval(self, eval: Evaluation) -> None: ...
+
+    def create_eval(self, eval: Evaluation) -> None: ...
+
+    def reblock_eval(self, eval: Evaluation) -> None: ...
+
+
+SchedulerFactory = Callable[..., object]
+
+_BUILTIN: Dict[str, SchedulerFactory] = {}
+
+
+def register_scheduler(name: str, factory: SchedulerFactory) -> None:
+    _BUILTIN[name] = factory
+
+
+def scheduler_names():
+    return sorted(_BUILTIN)
+
+
+def new_scheduler(name: str, logger, state, planner,
+                  rng: Optional[random.Random] = None):
+    factory = _BUILTIN.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factory(logger, state, planner, rng=rng)
+
+
+register_scheduler(
+    "service",
+    lambda logger, state, planner, rng=None: GenericScheduler(
+        logger, state, planner, batch=False, rng=rng
+    ),
+)
+register_scheduler(
+    "batch",
+    lambda logger, state, planner, rng=None: GenericScheduler(
+        logger, state, planner, batch=True, rng=rng
+    ),
+)
+register_scheduler(
+    "system",
+    lambda logger, state, planner, rng=None: SystemScheduler(
+        logger, state, planner, rng=rng
+    ),
+)
+
+
+def _register_tpu_factories() -> None:
+    """TPU-backed factories are registered lazily so importing the
+    scheduler package doesn't pull in JAX."""
+    from .tpu import BatchedTPUScheduler  # noqa
+
+    register_scheduler(
+        "service-tpu",
+        lambda logger, state, planner, rng=None: BatchedTPUScheduler(
+            logger, state, planner, batch=False, rng=rng
+        ),
+    )
+    register_scheduler(
+        "batch-tpu",
+        lambda logger, state, planner, rng=None: BatchedTPUScheduler(
+            logger, state, planner, batch=True, rng=rng
+        ),
+    )
+
+
+__all__ = [
+    "GenericScheduler",
+    "SystemScheduler",
+    "Planner",
+    "new_scheduler",
+    "register_scheduler",
+    "scheduler_names",
+]

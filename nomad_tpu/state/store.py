@@ -15,6 +15,7 @@ reference's lock-free MVCC property, SURVEY.md section 2.3).
 
 from __future__ import annotations
 
+import copy as _copy
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set
@@ -279,8 +280,9 @@ class StateStore:
             else:
                 node.create_index = index
             node.modify_index = index
-            if not node.computed_class:
-                node.compute_class()
+            # Always recompute: a re-registering node may carry a stale
+            # class alongside changed attributes.
+            node.compute_class()
             table[node.id] = node
             self._bump(index, "nodes")
         self.notify.notify(items)
@@ -438,7 +440,7 @@ class StateStore:
             for job_id in touched_jobs:
                 job = self._tables["jobs"].data.get(job_id)
                 if job is not None:
-                    items.extend(self._set_job_status(index, job))
+                    items.extend(self._set_job_status(index, job, eval_delete=True))
             self._bump(index, "evals", "allocs")
         self.notify.notify(items)
 
@@ -496,7 +498,11 @@ class StateStore:
                 alloc = existing.copy()
                 alloc.client_status = update.client_status
                 alloc.client_description = update.client_description
-                alloc.task_states = dict(update.task_states)
+                # Deep-copy: the caller keeps mutating its TaskState objects
+                # and stored records must stay immutable for snapshots.
+                alloc.task_states = {
+                    k: _copy.deepcopy(v) for k, v in update.task_states.items()
+                }
                 alloc.modify_index = index
                 table[alloc.id] = alloc
                 self._update_summary_with_alloc(index, alloc, existing)
@@ -571,38 +577,49 @@ class StateStore:
         summary.modify_index = index
         summaries[alloc.job_id] = summary
 
-    def _set_job_status(self, index: int, job: Job) -> list:
-        """Derive job status from its allocs and evals
-        (state_store.go:1417 setJobStatus / :1479 getJobStatus). Returns
-        the watch items to notify (empty when the status is unchanged);
-        a change also bumps the jobs table index."""
-        status = consts.JOB_STATUS_DEAD
+    def _get_job_status(self, job: Job, eval_delete: bool) -> str:
+        """Derive job status (state_store.go:1457 getJobStatus): running if
+        any non-terminal alloc; pending if any non-terminal eval; dead when
+        everything outstanding is terminal (or evals were GC'd); a brand-new
+        job with nothing outstanding is pending (running if periodic)."""
+        has_alloc = False
         for aid in self._indexes["allocs_by_job"].data.get(job.id, ()):
             alloc = self._tables["allocs"].data.get(aid)
-            if alloc is not None and not alloc.terminal_status():
-                status = consts.JOB_STATUS_RUNNING
-                break
-        else:
-            for eid in self._indexes["evals_by_job"].data.get(job.id, ()):
-                ev = self._tables["evals"].data.get(eid)
-                if ev is not None and not ev.terminal_status():
-                    status = consts.JOB_STATUS_PENDING
-                    break
-            else:
-                # A periodic parent that is still registered counts as running.
-                if job.is_periodic():
-                    status = consts.JOB_STATUS_RUNNING
+            if alloc is None:
+                continue
+            has_alloc = True
+            if not alloc.terminal_status():
+                return consts.JOB_STATUS_RUNNING
+        has_eval = False
+        for eid in self._indexes["evals_by_job"].data.get(job.id, ()):
+            ev = self._tables["evals"].data.get(eid)
+            if ev is None:
+                continue
+            has_eval = True
+            if not ev.terminal_status():
+                return consts.JOB_STATUS_PENDING
+        if eval_delete or has_eval or has_alloc:
+            return consts.JOB_STATUS_DEAD
+        # A periodic parent never gets allocs/evals of its own.
+        if job.is_periodic():
+            return consts.JOB_STATUS_RUNNING
+        return consts.JOB_STATUS_PENDING
 
+    def _set_job_status(self, index: int, job: Job, eval_delete: bool = False) -> list:
+        """Recompute and store the derived job status (state_store.go:1417
+        setJobStatus). Returns the watch items to notify (empty when the
+        status is unchanged); a change also bumps the jobs table index."""
+        status = self._get_job_status(job, eval_delete)
+        stored = self._tables["jobs"].data.get(job.id)
+        if stored is None or stored.status == status:
+            return []  # avoid the jobs-table copy-on-write when unchanged
         jobs = self._tables["jobs"].for_write()
-        stored = jobs.get(job.id)
-        if stored is not None and stored.status != status:
-            updated = stored.copy()
-            updated.status = status
-            updated.modify_index = index
-            jobs[job.id] = updated
-            self._bump(index, "jobs")
-            return [watch.table("jobs"), watch.job(job.id)]
-        return []
+        updated = jobs[job.id].copy()
+        updated.status = status
+        updated.modify_index = index
+        jobs[job.id] = updated
+        self._bump(index, "jobs")
+        return [watch.table("jobs"), watch.job(job.id)]
 
     # ------------------------------------------------------------------
     # persistence (FSM snapshot install/restore)

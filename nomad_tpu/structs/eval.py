@@ -112,6 +112,8 @@ def new_eval(job: Job, triggered_by: str) -> Evaluation:
         type=job.type,
         triggered_by=triggered_by,
         job_id=job.id,
-        job_modify_index=job.modify_index,
+        # The spec-change index, not modify_index: derived-status writes
+        # bump the latter without changing the job spec.
+        job_modify_index=job.job_modify_index,
         status=consts.EVAL_STATUS_PENDING,
     )

@@ -55,7 +55,11 @@ def allocs_fit(
 
 def score_fit(node: Node, util: Resources) -> float:
     """BestFit-v3: 20 - (10^free_cpu_frac + 10^free_mem_frac), clamped to
-    [0, 18]. Packed nodes score high; empty nodes score 0."""
+    [0, 18]. Packed nodes score high; empty nodes score 0.
+
+    Note: util (from allocs_fit) includes node.reserved while the
+    denominator subtracts it — reference parity (funcs.go:123-131 does
+    the same), so reserved-heavy nodes score as partially packed."""
     node_cpu = float(node.resources.cpu)
     node_mem = float(node.resources.memory_mb)
     if node.reserved:

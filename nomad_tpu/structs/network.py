@@ -63,6 +63,8 @@ class NetworkIndex:
             self.used_ports[n.ip] = used
         for port in list(n.reserved_ports) + list(n.dynamic_ports):
             if port.value < 0 or port.value >= consts.MAX_VALID_PORT:
+                # Early return leaves the index partially applied —
+                # reference parity (network.go:129-130 does the same).
                 return True
             if used.check(port.value):
                 collide = True

@@ -22,6 +22,8 @@ def _parse_field(expr: str, lo: int, hi: int) -> Set[int]:
         if "/" in part:
             part, step_s = part.split("/", 1)
             step = int(step_s)
+            if step < 1:
+                raise ValueError(f"cron step must be >= 1, got {step}")
         if part == "*" or part == "":
             rng = range(lo, hi + 1)
         elif "-" in part:

@@ -1,0 +1,237 @@
+"""Feasibility/ranking iterator tests (mirror scheduler/feasible_test.go,
+rank_test.go, select_test.go)."""
+
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    ConstraintChecker,
+    DriverChecker,
+    FeasibilityWrapper,
+    ProposedAllocConstraintIterator,
+    StaticIterator,
+    check_constraint,
+    resolve_constraint_target,
+)
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    RankedNode,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.select import LimitIterator, MaxScoreIterator
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Constraint, Plan, Resources, consts
+
+
+def make_ctx(state=None, plan=None, seed=1):
+    state = state or StateStore().snapshot()
+    plan = plan or Plan()
+    return EvalContext(state, plan, rng=random.Random(seed))
+
+
+def test_static_iterator():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(3)]
+    it = StaticIterator(ctx, nodes)
+    out = [it.next() for _ in range(3)]
+    assert out == nodes
+    assert it.next() is None
+    assert ctx.metrics.nodes_evaluated == 3
+
+
+def test_static_iterator_wraparound_after_reset():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(3)]
+    it = StaticIterator(ctx, nodes)
+    it.next()
+    it.reset()
+    seen = {it.next().id for _ in range(3)}
+    assert len(seen) == 3  # wraps to cover all nodes once per pass
+
+
+def test_driver_checker():
+    ctx = make_ctx()
+    n = mock.node()
+    c = DriverChecker(ctx, {"exec"})
+    assert c.feasible(n)
+    c.set_drivers({"docker"})
+    assert not c.feasible(n)
+    n2 = mock.node()
+    n2.attributes["driver.docker"] = "0"
+    c2 = DriverChecker(ctx, {"docker"})
+    assert not c2.feasible(n2)
+
+
+def test_resolve_constraint_target():
+    n = mock.node()
+    assert resolve_constraint_target("${node.unique.id}", n) == (n.id, True)
+    assert resolve_constraint_target("${node.datacenter}", n) == ("dc1", True)
+    assert resolve_constraint_target("${node.class}", n) == (n.node_class, True)
+    assert resolve_constraint_target("${attr.kernel.name}", n) == ("linux", True)
+    assert resolve_constraint_target("${meta.pci-dss}", n) == ("true", True)
+    assert resolve_constraint_target("${attr.nope}", n)[1] is False
+    assert resolve_constraint_target("literal", n) == ("literal", True)
+
+
+def test_check_constraint_operands():
+    ctx = make_ctx()
+    assert check_constraint(ctx, "=", "a", "a")
+    assert not check_constraint(ctx, "!=", "a", "a")
+    assert check_constraint(ctx, "<", "a", "b")
+    assert check_constraint(ctx, ">=", "b", "b")
+    assert check_constraint(ctx, "version", "1.2.3", ">= 1.0, < 2.0")
+    assert not check_constraint(ctx, "version", "2.1.0", ">= 1.0, < 2.0")
+    assert check_constraint(ctx, "version", "1.4.0", "~> 1.2")
+    assert check_constraint(ctx, "regexp", "linux-x64", "^linux")
+    assert not check_constraint(ctx, "regexp", "windows", "^linux")
+    # distinct_hosts passes through (handled elsewhere)
+    assert check_constraint(ctx, "distinct_hosts", "x", "y")
+    assert not check_constraint(ctx, "bogus-op", "x", "y")
+
+
+def test_constraint_checker():
+    ctx = make_ctx()
+    n = mock.node()
+    c = ConstraintChecker(
+        ctx, [Constraint("${attr.kernel.name}", "linux", "=")]
+    )
+    assert c.feasible(n)
+    c.set_constraints([Constraint("${attr.kernel.name}", "darwin", "=")])
+    assert not c.feasible(n)
+    assert ctx.metrics.nodes_filtered == 1
+    # unresolvable target fails closed
+    c.set_constraints([Constraint("${attr.missing}", "x", "=")])
+    assert not c.feasible(n)
+
+
+def test_distinct_hosts_iterator():
+    store = StateStore()
+    job = mock.job()
+    job.constraints.append(Constraint(operand="distinct_hosts"))
+    n1, n2 = mock.node(), mock.node()
+    store.upsert_node(1, n1)
+    store.upsert_node(2, n2)
+    a = mock.alloc()
+    a.job_id = job.id
+    a.job = job
+    a.node_id = n1.id
+    store.upsert_allocs(3, [a])
+
+    ctx = make_ctx(state=store.snapshot())
+    src = StaticIterator(ctx, [store.node_by_id(n1.id), store.node_by_id(n2.id)])
+    it = ProposedAllocConstraintIterator(ctx, src)
+    it.set_job(job)
+    it.set_task_group(job.task_groups[0])
+    out = []
+    while (n := it.next()) is not None:
+        out.append(n.id)
+    assert out == [n2.id]  # n1 already hosts an alloc for this job
+
+
+def test_feasibility_wrapper_memoizes_tg_by_class():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(10)]  # all same computed class
+
+    job_calls, tg_calls = [], []
+
+    class CountingChecker:
+        def __init__(self, sink):
+            self.sink = sink
+
+        def feasible(self, node):
+            self.sink.append(node.id)
+            return True
+
+    src = StaticIterator(ctx, nodes)
+    w = FeasibilityWrapper(
+        ctx, src, [CountingChecker(job_calls)], [CountingChecker(tg_calls)]
+    )
+    ctx.eligibility.set_job(mock.job())
+    w.set_task_group("web")
+    for _ in range(10):
+        assert w.next() is not None
+    # TG checks memoize per computed class (only the first node runs them);
+    # job checks run per node, matching reference feasible.go:512-540.
+    assert len(tg_calls) == 1
+    assert len(job_calls) == 10
+
+
+def test_feasibility_wrapper_ineligible_class_filtered():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(5)]
+
+    class FailChecker:
+        def feasible(self, node):
+            return False
+
+    src = StaticIterator(ctx, nodes)
+    w = FeasibilityWrapper(ctx, src, [FailChecker()], [])
+    ctx.eligibility.set_job(mock.job())
+    w.set_task_group("web")
+    assert w.next() is None
+    # 4 of 5 were filtered by the class memo without running the checker
+    assert ctx.metrics.nodes_filtered >= 4
+
+
+def test_binpack_scores_and_exhaustion():
+    store = StateStore()
+    n1 = mock.node()
+    store.upsert_node(1, n1)
+    ctx = make_ctx(state=store.snapshot())
+    job = mock.job()
+    tg = job.task_groups[0]
+
+    src = StaticRankIterator(ctx, [RankedNode(store.node_by_id(n1.id))])
+    bp = BinPackIterator(ctx, src, evict=False, priority=50)
+    bp.set_task_group(tg)
+    option = bp.next()
+    assert option is not None
+    assert option.score > 0
+    assert "web" in option.task_resources
+    # the network offer was materialized
+    assert option.task_resources["web"].networks[0].dynamic_ports[0].value > 0
+
+    # Ask for more than the node has -> exhausted
+    big = tg.copy()
+    big.tasks[0].resources.cpu = 100000
+    src2 = StaticRankIterator(ctx, [RankedNode(store.node_by_id(n1.id))])
+    bp2 = BinPackIterator(ctx, src2, evict=False, priority=50)
+    bp2.set_task_group(big)
+    assert bp2.next() is None
+    assert ctx.metrics.nodes_exhausted == 1
+
+
+def test_job_anti_affinity():
+    store = StateStore()
+    n1 = mock.node()
+    store.upsert_node(1, n1)
+    job = mock.job()
+    a = mock.alloc()
+    a.job_id = job.id
+    a.node_id = n1.id
+    store.upsert_allocs(2, [a])
+
+    ctx = make_ctx(state=store.snapshot())
+    src = StaticRankIterator(ctx, [RankedNode(store.node_by_id(n1.id))])
+    it = JobAntiAffinityIterator(ctx, src, 10.0, job.id)
+    option = it.next()
+    assert option.score == -10.0
+
+
+def test_limit_and_max_score():
+    ctx = make_ctx()
+    ranked = [RankedNode(mock.node()) for _ in range(5)]
+    for i, r in enumerate(ranked):
+        r.score = float(i)
+    src = StaticRankIterator(ctx, ranked)
+    lim = LimitIterator(ctx, src, 3)
+    ms = MaxScoreIterator(ctx, lim)
+    best = ms.next()
+    assert best.score == 2.0  # only first 3 visited
+    assert ms.next() is None
+    ms.reset()
+    best2 = ms.next()
+    assert best2 is not None

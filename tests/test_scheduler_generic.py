@@ -1,0 +1,346 @@
+"""GenericScheduler tests (mirror scheduler/generic_sched_test.go)."""
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness, RejectPlan
+from nomad_tpu.structs import Constraint, consts, new_eval
+from nomad_tpu.utils.ids import generate_uuid
+
+
+def seed_nodes(h, count):
+    nodes = []
+    for _ in range(count):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return nodes
+
+
+def make_eval(h, job, trigger=consts.EVAL_TRIGGER_JOB_REGISTER):
+    ev = new_eval(job, trigger)
+    return ev
+
+
+def alloc_for(job, node, index):
+    """An allocation shaped like the scheduler would produce for job."""
+    tg = job.task_groups[0]
+    a = mock.alloc()
+    a.id = generate_uuid()
+    a.job = job
+    a.job_id = job.id
+    a.node_id = node.id
+    a.task_group = tg.name
+    a.name = f"{job.name}.{tg.name}[{index}]"
+    a.resources = tg.tasks[0].resources.copy()
+    a.task_resources = {tg.tasks[0].name: tg.tasks[0].resources.copy()}
+    return a
+
+
+def test_job_register():
+    h = Harness(seed=42)
+    seed_nodes(h, 10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(h, job)
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    assert not plan.annotations
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert len(placed) == 10
+    # all 10 landed in state
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    names = {a.name for a in out}
+    assert len(names) == 10
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    # no failed allocations
+    assert not h.evals[0].failed_tg_allocs
+    assert h.evals[0].queued_allocations == {"web": 0}
+
+
+def test_job_register_no_nodes_blocked_eval():
+    h = Harness(seed=1)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(h, job)
+    h.process("service", ev)
+
+    # no plan submitted, blocked eval created with failed TG metrics
+    assert len(h.plans) == 0
+    assert len(h.create_evals) == 1
+    blocked = h.create_evals[0]
+    assert blocked.status == consts.EVAL_STATUS_BLOCKED
+    assert blocked.previous_eval == ev.id
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    update = h.evals[0]
+    assert "web" in update.failed_tg_allocs
+    assert update.failed_tg_allocs["web"].coalesced_failures == 9
+    assert update.queued_allocations == {"web": 10}
+
+
+def test_job_register_partial_capacity():
+    """Nodes can hold only some of the asked allocs -> partial placement
+    + blocked eval for the rest."""
+    h = Harness(seed=7)
+    n = mock.node()  # one node: fits ~7 of the 500MHz/256MB asks
+    h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", make_eval(h, job))
+
+    placed = h.state.allocs_by_job(job.id)
+    assert 0 < len(placed) < 10
+    assert len(h.create_evals) == 1  # blocked eval for the remainder
+    update = h.evals[0]
+    assert update.queued_allocations["web"] == 10 - len(placed)
+
+
+def test_job_register_distinct_hosts():
+    h = Harness(seed=3)
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.constraints.append(Constraint(operand="distinct_hosts"))
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", make_eval(h, job))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 4
+    assert len({a.node_id for a in out}) == 4
+
+
+def test_job_deregister_stops_allocs():
+    h = Harness(seed=4)
+    nodes = seed_nodes(h, 2)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    allocs = [alloc_for(h.state.job_by_id(job.id), nodes[i % 2], i) for i in range(4)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+    h.state.delete_job(h.next_index(), job.id)
+
+    ev = make_eval(h, job, consts.EVAL_TRIGGER_JOB_DEREGISTER)
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    stops = [a for lst in h.plans[0].node_update.values() for a in lst]
+    assert len(stops) == 4
+    assert all(a.desired_status == consts.ALLOC_DESIRED_STOP for a in stops)
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+
+
+def test_job_modify_destructive():
+    h = Harness(seed=5)
+    nodes = seed_nodes(h, 10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    sjob = h.state.job_by_id(job.id)
+    allocs = [alloc_for(sjob, nodes[i], i) for i in range(10)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    # new version with changed env -> destructive update
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "changed"}
+    h.state.upsert_job(h.next_index(), job2)
+
+    h.process("service", make_eval(h, h.state.job_by_id(job.id)))
+
+    plan = h.plans[0]
+    evicted = [a for lst in plan.node_update.values() for a in lst]
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert len(evicted) == 10
+    assert len(placed) == 10
+    # replacements are fresh allocs, not in-place rewrites
+    assert {a.id for a in placed}.isdisjoint({a.id for a in evicted})
+
+
+def test_job_modify_in_place():
+    h = Harness(seed=6)
+    nodes = seed_nodes(h, 10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    sjob = h.state.job_by_id(job.id)
+    allocs = [alloc_for(sjob, nodes[i], i) for i in range(10)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    # spec change that doesn't touch tasks (restart policy) -> in-place
+    job2 = job.copy()
+    job2.task_groups[0].restart_policy.attempts = 99
+    h.state.upsert_job(h.next_index(), job2)
+
+    h.process("service", make_eval(h, h.state.job_by_id(job.id)))
+
+    plan = h.plans[0]
+    evicted = [a for lst in plan.node_update.values() for a in lst]
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert evicted == []
+    assert len(placed) == 10
+    # in-place updates keep the alloc ids
+    assert {a.id for a in placed} == {a.id for a in allocs}
+
+
+def test_rolling_update_limit():
+    h = Harness(seed=8)
+    nodes = seed_nodes(h, 10)
+    job = mock.job()
+    from nomad_tpu.structs import UpdateStrategy
+
+    job.update = UpdateStrategy(stagger=30.0, max_parallel=3)
+    h.state.upsert_job(h.next_index(), job)
+    sjob = h.state.job_by_id(job.id)
+    allocs = [alloc_for(sjob, nodes[i], i) for i in range(10)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "v2"}
+    h.state.upsert_job(h.next_index(), job2)
+
+    h.process("service", make_eval(h, h.state.job_by_id(job.id)))
+
+    plan = h.plans[0]
+    evicted = [a for lst in plan.node_update.values() for a in lst]
+    assert len(evicted) == 3  # max_parallel
+    # a follow-up rolling eval was created with the stagger wait
+    assert len(h.create_evals) == 1
+    follow = h.create_evals[0]
+    assert follow.triggered_by == consts.EVAL_TRIGGER_ROLLING_UPDATE
+    assert follow.wait == 30.0
+    assert follow.previous_eval == h.evals[0].id or follow.previous_eval
+
+
+def test_node_down_allocs_lost_and_replaced():
+    h = Harness(seed=9)
+    nodes = seed_nodes(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    sjob = h.state.job_by_id(job.id)
+    allocs = [alloc_for(sjob, nodes[0], 0), alloc_for(sjob, nodes[1], 1)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+    h.state.update_node_status(h.next_index(), nodes[0].id, consts.NODE_STATUS_DOWN)
+
+    ev = make_eval(h, job, consts.EVAL_TRIGGER_NODE_UPDATE)
+    h.process("service", ev)
+
+    plan = h.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert len(stops) == 1
+    assert stops[0].client_status == consts.ALLOC_CLIENT_LOST
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert len(placed) == 1
+    assert placed[0].node_id != nodes[0].id
+    assert placed[0].previous_allocation == allocs[0].id
+
+
+def test_node_drain_migrates():
+    h = Harness(seed=10)
+    nodes = seed_nodes(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(h.state.job_by_id(job.id), nodes[0], 0)
+    h.state.upsert_allocs(h.next_index(), [a])
+    h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+
+    h.process("service", make_eval(h, job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    plan = h.plans[0]
+    stops = [x for lst in plan.node_update.values() for x in lst]
+    assert len(stops) == 1
+    assert stops[0].client_status != consts.ALLOC_CLIENT_LOST  # migrate, not lost
+    placed = [x for lst in plan.node_allocation.values() for x in lst]
+    assert len(placed) == 1
+    assert placed[0].node_id != nodes[0].id
+
+
+def test_batch_completed_not_replaced():
+    h = Harness(seed=11)
+    nodes = seed_nodes(h, 2)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(h.state.job_by_id(job.id), nodes[0], 0)
+    a.client_status = consts.ALLOC_CLIENT_COMPLETE
+    from nomad_tpu.structs import TaskState
+
+    a.task_states = {"web": TaskState(state=consts.TASK_STATE_DEAD, failed=False)}
+    h.state.upsert_allocs(h.next_index(), [a])
+
+    h.process("batch", make_eval(h, job))
+    # nothing to do: completed batch work stays done
+    assert len(h.plans) == 0
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+
+
+def test_batch_failed_is_replaced():
+    h = Harness(seed=12)
+    nodes = seed_nodes(h, 2)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(h.state.job_by_id(job.id), nodes[0], 0)
+    a.client_status = consts.ALLOC_CLIENT_FAILED
+    h.state.upsert_allocs(h.next_index(), [a])
+
+    h.process("batch", make_eval(h, job))
+    placed = [x for lst in h.plans[0].node_allocation.values() for x in lst]
+    assert len(placed) == 1
+    assert placed[0].previous_allocation == a.id
+
+
+def test_sticky_disk_prefers_previous_node():
+    h = Harness(seed=13)
+    nodes = seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].ephemeral_disk.sticky = True
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(h.state.job_by_id(job.id), nodes[2], 0)
+    a.client_status = consts.ALLOC_CLIENT_FAILED
+    a.desired_status = consts.ALLOC_DESIRED_STOP
+    h.state.upsert_allocs(h.next_index(), [a])
+
+    h.process("service", make_eval(h, job))
+    placed = [x for lst in h.plans[0].node_allocation.values() for x in lst]
+    assert len(placed) == 1
+    assert placed[0].node_id == nodes[2].id  # stuck to the old node
+
+
+def test_reject_plan_exhausts_retries_and_blocks():
+    h = Harness(seed=14)
+    seed_nodes(h, 2)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.planner = RejectPlan(h)
+
+    h.process("service", make_eval(h, job))
+    # failed after max attempts, blocked eval for placement conflicts
+    update = h.evals[-1]
+    assert update.status == consts.EVAL_STATUS_FAILED
+    assert len(h.create_evals) == 1
+    assert h.create_evals[0].triggered_by == consts.EVAL_TRIGGER_MAX_PLANS
+
+
+def test_unknown_trigger_fails_eval():
+    h = Harness(seed=15)
+    job = mock.job()
+    ev = make_eval(h, job, "bogus-trigger")
+    h.process("service", ev)
+    assert h.evals[0].status == consts.EVAL_STATUS_FAILED
+    assert "bogus-trigger" in h.evals[0].status_description
+
+
+def test_annotate_plan():
+    h = Harness(seed=16)
+    seed_nodes(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(h, job)
+    ev.annotate_plan = True
+    h.process("service", ev)
+
+    plan = h.plans[0]
+    assert plan.annotations is not None
+    desired = plan.annotations.desired_tg_updates["web"]
+    assert desired.place == 2

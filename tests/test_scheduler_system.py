@@ -1,0 +1,106 @@
+"""SystemScheduler tests (mirror scheduler/system_sched_test.go)."""
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import Constraint, consts, new_eval
+
+
+def seed_nodes(h, count):
+    nodes = []
+    for _ in range(count):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return nodes
+
+
+def test_system_register_runs_everywhere():
+    h = Harness(seed=20)
+    nodes = seed_nodes(h, 10)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    assert {a.node_id for a in out} == {n.id for n in nodes}
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+
+
+def test_system_constraint_filters_nodes():
+    h = Harness(seed=21)
+    nodes = seed_nodes(h, 4)
+    # make two nodes windows: constraint will filter them
+    for n in nodes[:2]:
+        n2 = n.copy()
+        n2.attributes["kernel.name"] = "windows"
+        n2.computed_class = ""
+        n2.compute_class()
+        h.state.upsert_node(h.next_index(), n2)
+
+    job = mock.system_job()  # constrained to kernel.name = linux
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 2
+    assert {a.node_id for a in out} == {n.id for n in nodes[2:]}
+    # filtered nodes don't count as queued failures
+    update = h.evals[0]
+    assert update.queued_allocations.get("web", 0) == 0
+
+
+def test_system_new_node_gets_alloc():
+    h = Harness(seed=22)
+    nodes = seed_nodes(h, 2)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert len(h.state.allocs_by_job(job.id)) == 2
+
+    # a new node joins -> node-update eval places one more
+    h2 = Harness(state=h.state, seed=23)
+    h2._next_index = h._next_index
+    new_node = mock.node()
+    h2.state.upsert_node(h2.next_index(), new_node)
+    h2.process("system", new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    out = h2.state.allocs_by_job(job.id)
+    assert len(out) == 3
+    assert any(a.node_id == new_node.id for a in out)
+
+
+def test_system_node_down_stops_alloc():
+    h = Harness(seed=24)
+    nodes = seed_nodes(h, 3)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert len(h.state.allocs_by_job(job.id)) == 3
+
+    h.state.update_node_status(h.next_index(), nodes[0].id, consts.NODE_STATUS_DOWN)
+    h2 = Harness(state=h.state, seed=25)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    plan = h2.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    # the alloc on the downed node is marked lost/stopped, no replacement
+    # placed on the tainted node
+    assert len(stops) >= 1
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert all(a.node_id != nodes[0].id for a in placed)
+
+
+def test_system_deregister_stops_all():
+    h = Harness(seed=26)
+    seed_nodes(h, 3)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    h.state.delete_job(h.next_index(), job.id)
+
+    h2 = Harness(state=h.state, seed=27)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_DEREGISTER))
+    stops = [a for lst in h2.plans[0].node_update.values() for a in lst]
+    assert len(stops) == 3

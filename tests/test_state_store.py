@@ -154,6 +154,32 @@ def test_delete_evals_and_allocs():
     assert s.allocs_by_job(a.job_id) == []
 
 
+def test_fresh_job_status_pending():
+    """A new job with nothing outstanding is pending; dead only applies
+    once terminal evals/allocs exist (state_store.go:1457)."""
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(5, j)
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_PENDING
+    from nomad_tpu.structs import PeriodicConfig
+
+    jp = mock.job()
+    jp.periodic = PeriodicConfig(enabled=True, spec="0 0 * * *")
+    s.upsert_job(6, jp)
+    assert s.job_by_id(jp.id).status == consts.JOB_STATUS_RUNNING
+
+
+def test_job_status_dead_after_eval_gc():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(5, j)
+    e = mock.eval()
+    e.job_id = j.id
+    s.upsert_evals(6, [e])
+    s.delete_evals(7, [e.id], [])
+    assert s.job_by_id(j.id).status == consts.JOB_STATUS_DEAD
+
+
 def test_job_status_dead_after_terminal():
     s = StateStore()
     j = mock.job()
