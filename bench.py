@@ -1,0 +1,170 @@
+"""Benchmark: scheduler placement throughput, CPU iterator stack vs
+batched TPU kernel.
+
+Scenario (BASELINE.md config 2): 1k-node cluster, evals placing a
+batch job via CPU+mem bin-packing. The CPU baseline runs the reference
+iterator pipeline (stack.select per placement); the TPU path runs the
+same placements as one batched dense program (ops/binpack.py), B evals
+vmapped per dispatch — the broker drain-to-batch design from
+BASELINE.json's north star.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": evals_per_sec_tpu, "unit": "evals/sec",
+   "vs_baseline": tpu/cpu}
+"""
+
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+N_NODES = 1000
+K_PLACEMENTS = 8  # allocs placed per eval
+CPU_EVALS = 30  # evals timed on the CPU path
+TPU_BATCH = 2048  # evals per TPU dispatch
+TPU_ROUNDS = 8  # timed dispatches (after warmup)
+
+
+def build_cluster():
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+
+    store = StateStore()
+    for i in range(N_NODES):
+        node = mock.node()
+        store.upsert_node(i + 1, node)
+    job = mock.job()
+    job.type = "batch"
+    job.task_groups[0].count = K_PLACEMENTS
+    # config 2 is CPU+mem only: strip the network ask
+    job.task_groups[0].tasks[0].resources.networks = []
+    store.upsert_job(N_NODES + 1, job)
+    return store, job
+
+
+def bench_cpu(store, job):
+    """Reference pipeline: per-eval stack.select loop."""
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.stack import GenericStack
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+    from nomad_tpu.structs import Plan
+
+    snap = store.snapshot()
+    latencies = []
+    start = time.perf_counter()
+    for i in range(CPU_EVALS):
+        t0 = time.perf_counter()
+        plan = Plan(job=job)
+        ctx = EvalContext(snap, plan, rng=random.Random(i))
+        stack = GenericStack(True, ctx)
+        stack.set_job(job)
+        nodes, _ = ready_nodes_in_dcs(snap, job.datacenters)
+        stack.set_nodes(nodes)
+        tg = job.task_groups[0]
+        for _ in range(K_PLACEMENTS):
+            option, _ = stack.select(tg)
+            assert option is not None
+            from nomad_tpu.structs import Allocation
+            from nomad_tpu.utils.ids import generate_uuid
+
+            plan.append_alloc(
+                Allocation(
+                    id=generate_uuid(),
+                    job_id=job.id,
+                    node_id=option.node.id,
+                    task_group=tg.name,
+                    task_resources=dict(option.task_resources),
+                )
+            )
+        latencies.append(time.perf_counter() - t0)
+    elapsed = time.perf_counter() - start
+    return CPU_EVALS / elapsed, latencies
+
+
+def bench_tpu(store, job):
+    """Batched dense program: TPU_BATCH evals per dispatch."""
+    import jax
+
+    from nomad_tpu.models.matrix import ClusterMatrix
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        batched_placement_program_shared,
+        make_asks,
+        make_node_state,
+    )
+
+    snap = store.snapshot()
+    matrix = ClusterMatrix(snap, job)
+    state = make_node_state(
+        matrix.capacity, matrix.sched_capacity, matrix.util,
+        matrix.bw_avail, matrix.bw_used, matrix.ports_free,
+        matrix.job_count, matrix.tg_count, matrix.feasible, matrix.node_ok,
+    )
+    asks = make_asks(*matrix.build_asks([0] * K_PLACEMENTS))
+
+    # The cluster matrix lives on device across dispatches (it changes
+    # only when the snapshot does); per dispatch only keys move.
+    state = jax.tree.map(jax.device_put, state)
+    asks = jax.tree.map(jax.device_put, asks)
+    config = PlacementConfig(anti_affinity_penalty=5.0)
+
+    def dispatch(seed):
+        keys = jax.random.split(jax.random.PRNGKey(seed), TPU_BATCH)
+        choices, scores, _ = batched_placement_program_shared(
+            state, asks, keys, config
+        )
+        return choices
+
+    # Warmup / compile
+    warm = np.asarray(dispatch(0))
+    assert (warm >= 0).all(), "warmup produced failed placements"
+
+    # Latency: one synchronous round including its result fetch — the
+    # submit-to-answer time every eval in that batch observes.
+    t0 = time.perf_counter()
+    np.asarray(dispatch(1))
+    sync_latency = time.perf_counter() - t0
+
+    # Throughput: pipeline the dispatches (JAX async dispatch overlaps
+    # them) and fetch all results in one device->host transfer — the
+    # broker sidecar streams results the same way.
+    start = time.perf_counter()
+    outs = [dispatch(r + 2) for r in range(TPU_ROUNDS)]
+    results = [np.asarray(o) for o in outs]
+    elapsed = time.perf_counter() - start
+    for out in results:
+        assert (out >= 0).all()
+    evals_per_sec = TPU_BATCH * TPU_ROUNDS / elapsed
+    return evals_per_sec, sync_latency
+
+
+def main():
+    store, job = build_cluster()
+
+    cpu_rate, cpu_lat = bench_cpu(store, job)
+    tpu_rate, tpu_p99 = bench_tpu(store, job)
+    cpu_p99 = float(np.percentile(cpu_lat, 99))
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"scheduler placement throughput, {N_NODES} nodes x "
+                    f"{K_PLACEMENTS} allocs/eval (cpu+mem bin-pack); "
+                    f"cpu={cpu_rate:.1f} evals/s p99={cpu_p99*1000:.1f}ms, "
+                    f"tpu p99/batch={tpu_p99*1000:.1f}ms"
+                ),
+                "value": round(tpu_rate, 1),
+                "unit": "evals/sec",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+"""Dense cluster-matrix construction for the TPU placement kernel.
+
+Bridges the object model (structs/state) to the array program
+(ops/binpack.py):
+
+- nodes -> [N, 4] capacity/utilization matrices (+ bandwidth, free
+  dynamic-port counts);
+- constraints -> a [N, G] feasibility mask computed per *computed node
+  class* host-side (C << N constraint evaluations, the dense analog of
+  the reference's FeasibilityWrapper memo, scheduler/feasible.go:457),
+  with `unique.`-escaped constraints evaluated per node;
+- shapes bucketed (N and K padded to fixed sizes) so XLA compiles one
+  program per bucket instead of per cluster size.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..scheduler.context import EvalContext
+from ..scheduler.feasible import ConstraintChecker, DriverChecker
+from ..structs import (
+    Allocation,
+    Job,
+    Node,
+    Plan,
+    consts,
+    escaped_constraints,
+    remove_allocs,
+)
+from ..structs.resources import Resources
+
+# Node-count buckets: VPU-lane-friendly multiples of 128.
+BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768]
+ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
+
+
+def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
+    i = bisect.bisect_left(buckets, max(n, 1))
+    if i == len(buckets):
+        # Beyond the largest bucket: round up to a multiple of the top.
+        top = buckets[-1]
+        return ((n + top - 1) // top) * top
+    return buckets[i]
+
+
+def _alloc_usage(alloc: Allocation) -> Tuple[float, float, float, float, float, int]:
+    """(cpu, mem, disk, iops, mbits, dyn_ports_in_range) consumed by one
+    alloc — same accounting as AllocsFit (structs/funcs.go:72-94)."""
+    cpu = mem = disk = iops = 0.0
+    mbits = 0.0
+    ports = 0
+    resources: List[Resources] = []
+    if alloc.resources is not None:
+        resources.append(alloc.resources)
+    else:
+        if alloc.shared_resources is not None:
+            resources.append(alloc.shared_resources)
+        resources.extend(alloc.task_resources.values())
+    for r in resources:
+        cpu += r.cpu
+        mem += r.memory_mb
+        disk += r.disk_mb
+        iops += r.iops
+    # Network usage mirrors NetworkIndex.AddAllocs: first network of each
+    # task's resources (structs/network.go:94-107).
+    for tr in alloc.task_resources.values():
+        if tr.networks:
+            n0 = tr.networks[0]
+            mbits += n0.mbits
+            for p in list(n0.reserved_ports) + list(n0.dynamic_ports):
+                if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
+                    ports += 1
+    return cpu, mem, disk, iops, mbits, ports
+
+
+class ClusterMatrix:
+    """Dense view of the schedulable cluster for one job's placements."""
+
+    def __init__(self, state, job: Job, plan: Optional[Plan] = None,
+                 nodes: Optional[List[Node]] = None):
+        self.state = state
+        self.job = job
+        self.plan = plan
+        if nodes is None:
+            from ..scheduler.util import ready_nodes_in_dcs
+
+            nodes, by_dc = ready_nodes_in_dcs(state, job.datacenters)
+            self.nodes_by_dc = by_dc
+        else:
+            self.nodes_by_dc = {}
+        self.nodes: List[Node] = nodes
+        self.n_real = len(nodes)
+        self.n = bucket_size(self.n_real)
+        self.groups = job.task_groups
+        self.g = len(self.groups)
+        self._build()
+
+    # ------------------------------------------------------------------
+
+    def _proposed_allocs(self, node_id: str) -> List[Allocation]:
+        existing = self.state.allocs_by_node_terminal(node_id, False)
+        if self.plan is None:
+            return existing
+        proposed = existing
+        updates = self.plan.node_update.get(node_id, [])
+        if updates:
+            proposed = remove_allocs(existing, updates)
+        by_id = {a.id: a for a in proposed}
+        for alloc in self.plan.node_allocation.get(node_id, []):
+            by_id[alloc.id] = alloc
+        return list(by_id.values())
+
+    def _build(self) -> None:
+        n, g = self.n, self.g
+        capacity = np.zeros((n, 4), np.float32)
+        sched_capacity = np.zeros((n, 4), np.float32)
+        util = np.zeros((n, 4), np.float32)
+        bw_avail = np.zeros(n, np.float32)
+        bw_used = np.zeros(n, np.float32)
+        ports_free = np.zeros(n, np.float32)
+        job_count = np.zeros(n, np.int32)
+        tg_count = np.zeros((n, g), np.int32)
+        node_ok = np.zeros(n, bool)
+
+        dyn_range = consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
+
+        for i, node in enumerate(self.nodes):
+            r = node.resources
+            capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+            res = node.reserved
+            res_cpu = res.cpu if res else 0
+            res_mem = res.memory_mb if res else 0
+            res_disk = res.disk_mb if res else 0
+            res_iops = res.iops if res else 0
+            sched_capacity[i] = (
+                r.cpu - res_cpu,
+                r.memory_mb - res_mem,
+                r.disk_mb - res_disk,
+                r.iops - res_iops,
+            )
+            util[i] = (res_cpu, res_mem, res_disk, res_iops)
+            if r.networks:
+                bw_avail[i] = r.networks[0].mbits
+            reserved_dyn_ports = 0
+            if res:
+                for net in res.networks:
+                    bw_used[i] += net.mbits
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                        if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
+                            reserved_dyn_ports += 1
+            ports_used = reserved_dyn_ports
+            for alloc in self._proposed_allocs(node.id):
+                cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
+                util[i] += (cpu, mem, disk, iops)
+                bw_used[i] += mbits
+                ports_used += aports
+                if alloc.job_id == self.job.id:
+                    job_count[i] += 1
+                    for gi, tg in enumerate(self.groups):
+                        if alloc.task_group == tg.name:
+                            tg_count[i, gi] += 1
+            ports_free[i] = dyn_range - ports_used
+            node_ok[i] = True
+
+        self.capacity = capacity
+        self.sched_capacity = sched_capacity
+        self.util = util
+        self.bw_avail = bw_avail
+        self.bw_used = bw_used
+        self.ports_free = ports_free
+        self.job_count = job_count
+        self.tg_count = tg_count
+        self.node_ok = node_ok
+        self.feasible = self._build_feasibility()
+
+    def _build_feasibility(self) -> np.ndarray:
+        """[N, G] constraint mask. Non-escaped job/TG constraints are
+        evaluated once per computed class; escaped ones per node."""
+        n, g = self.n, self.g
+        feasible = np.zeros((n, g), bool)
+        ctx = EvalContext(self.state, Plan())
+
+        job_cons = self.job.constraints
+        job_escaped = escaped_constraints(job_cons)
+        job_static = [c for c in job_cons if c not in job_escaped]
+
+        per_group = []
+        for tg in self.groups:
+            cons = list(tg.constraints)
+            drivers = set()
+            for task in tg.tasks:
+                cons.extend(task.constraints)
+                drivers.add(task.driver)
+            esc = escaped_constraints(cons)
+            static = [c for c in cons if c not in esc]
+            per_group.append((static, esc, drivers))
+
+        class_cache: Dict[Tuple[str, int], bool] = {}
+        job_class_cache: Dict[str, bool] = {}
+        job_checker = ConstraintChecker(ctx, job_static)
+        cons_checker = ConstraintChecker(ctx)
+        driver_checker = DriverChecker(ctx)
+        esc_checker = ConstraintChecker(ctx)
+
+        for i, node in enumerate(self.nodes):
+            cls = node.computed_class
+            job_ok = job_class_cache.get(cls) if cls else None
+            if job_ok is None:
+                job_ok = job_checker.feasible(node)
+                if cls:
+                    job_class_cache[cls] = job_ok
+            if job_ok and job_escaped:
+                esc_checker.set_constraints(job_escaped)
+                job_ok = esc_checker.feasible(node)
+            if not job_ok:
+                continue
+            for gi, (static, esc, drivers) in enumerate(per_group):
+                key = (cls, gi)
+                ok = class_cache.get(key) if cls else None
+                if ok is None:
+                    driver_checker.set_drivers(drivers)
+                    cons_checker.set_constraints(static)
+                    ok = driver_checker.feasible(node) and cons_checker.feasible(node)
+                    if cls:
+                        class_cache[key] = ok
+                if ok and esc:
+                    esc_checker.set_constraints(esc)
+                    ok = esc_checker.feasible(node)
+                feasible[i, gi] = ok
+        return feasible
+
+    # ------------------------------------------------------------------
+
+    def build_asks(self, placements) -> Tuple[np.ndarray, ...]:
+        """Convert an ordered list of (tg_index) placements into padded
+        ask arrays. placements: list of task-group indices."""
+        k_real = len(placements)
+        k = bucket_size(k_real, ASK_BUCKETS)
+        resources = np.zeros((k, 4), np.float32)
+        bw = np.zeros(k, np.float32)
+        ports = np.zeros(k, np.float32)
+        tg_index = np.zeros(k, np.int32)
+        active = np.zeros(k, bool)
+
+        group_sizes = []
+        for tg in self.groups:
+            cpu = mem = iops = 0.0
+            disk = tg.ephemeral_disk.size_mb if tg.ephemeral_disk else 0
+            mbits = 0.0
+            nports = 0
+            for task in tg.tasks:
+                r = task.resources
+                cpu += r.cpu
+                mem += r.memory_mb
+                disk += r.disk_mb
+                iops += r.iops
+                if r.networks:
+                    mbits += r.networks[0].mbits
+                    nports += len(r.networks[0].dynamic_ports) + len(
+                        r.networks[0].reserved_ports
+                    )
+            group_sizes.append((cpu, mem, disk, iops, mbits, nports))
+
+        for j, gi in enumerate(placements):
+            cpu, mem, disk, iops, mbits, nports = group_sizes[gi]
+            resources[j] = (cpu, mem, disk, iops)
+            bw[j] = mbits
+            ports[j] = nports
+            tg_index[j] = gi
+            active[j] = True
+
+        job_dh = any(
+            c.operand == consts.CONSTRAINT_DISTINCT_HOSTS for c in self.job.constraints
+        )
+        tg_dh = np.array(
+            [
+                any(c.operand == consts.CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
+                for tg in self.groups
+            ],
+            bool,
+        )
+        return resources, bw, ports, tg_index, active, job_dh, tg_dh

@@ -1,0 +1,3 @@
+from .binpack import PlacementConfig, placement_program, make_node_state, make_asks
+
+__all__ = ["PlacementConfig", "placement_program", "make_node_state", "make_asks"]

@@ -1,0 +1,250 @@
+"""Vectorized bin-packing placement: the TPU reformulation of the
+reference's per-node iterator chain.
+
+The reference scores candidates one node at a time through
+BinPackIterator (scheduler/rank.go:161) bounded by LimitIterator
+(scheduler/select.go:5). Here one evaluation's K placements run as a
+`lax.scan` whose body performs the whole cluster's feasibility mask,
+BestFit-v3 score, anti-affinity penalty, and masked argmax as dense
+[N]-wide vector ops — one pass on the VPU instead of K x limit Python
+iterations. The scan carries the proposed-usage state so placements
+within an eval see each other (the reference's ProposedAllocs
+semantics, scheduler/context.go:108).
+
+Shapes are static: node count N and placement count K are bucketed by
+the caller (models/matrix.py) so XLA compiles once per bucket. The
+program is pure and vmap-able over a leading batch axis (independent
+evals against the same snapshot = optimistic concurrency) and
+shard_map-able over the node axis (parallel/mesh.py).
+
+Port/network fidelity: dynamic-port *counts* and bandwidth are tracked
+densely; exact port numbers are assigned host-side after the kernel
+picks nodes, and the plan applier re-verifies every node exactly
+(reference plan_apply.go:318), so a dense approximation costs at most a
+retry, never correctness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Resource dims in the dense matrices.
+R_CPU, R_MEM, R_DISK, R_IOPS = 0, 1, 2, 3
+NUM_RESOURCES = 4
+
+NEG_INF = -1e30
+
+
+class PlacementConfig(NamedTuple):
+    """Static (compile-time) knobs."""
+
+    anti_affinity_penalty: float  # 10 service / 5 batch (stack.go:14-18)
+    noise_scale: float = 1e-4  # random tie-break, keyed per eval
+
+
+class NodeState(NamedTuple):
+    """Dense per-node cluster state. All arrays share leading dim N.
+
+    util is the running utilization *including node reserved* and the
+    capacity denominator subtracts reserved — exactly the reference's
+    AllocsFit/ScoreFit accounting (structs/funcs.go:60,123).
+    """
+
+    capacity: jnp.ndarray  # [N, 4] total node resources
+    sched_capacity: jnp.ndarray  # [N, 4] capacity - reserved (score denom)
+    util: jnp.ndarray  # [N, 4] reserved + existing usage (scan-carried)
+    bw_avail: jnp.ndarray  # [N] primary-device bandwidth
+    bw_used: jnp.ndarray  # [N] (scan-carried)
+    ports_free: jnp.ndarray  # [N] free dynamic-port count (scan-carried)
+    job_count: jnp.ndarray  # [N] this job's allocs per node (scan-carried)
+    tg_count: jnp.ndarray  # [N, G] per-task-group counts (scan-carried)
+    feasible: jnp.ndarray  # [N, G] constraint feasibility (static mask)
+    node_ok: jnp.ndarray  # [N] ready & real (not padding)
+
+
+class Asks(NamedTuple):
+    """The K placements to make, in order. Leading dim K."""
+
+    resources: jnp.ndarray  # [K, 4]
+    bw: jnp.ndarray  # [K]
+    ports: jnp.ndarray  # [K] dynamic-port count
+    tg_index: jnp.ndarray  # [K] int32 index into the G axis
+    active: jnp.ndarray  # [K] bool (padding rows are inactive)
+    job_distinct_hosts: jnp.ndarray  # [] bool
+    tg_distinct_hosts: jnp.ndarray  # [G] bool
+
+
+def make_node_state(
+    capacity, sched_capacity, util, bw_avail, bw_used, ports_free,
+    job_count, tg_count, feasible, node_ok,
+) -> NodeState:
+    f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
+    return NodeState(
+        capacity=f32(capacity),
+        sched_capacity=f32(sched_capacity),
+        util=f32(util),
+        bw_avail=f32(bw_avail),
+        bw_used=f32(bw_used),
+        ports_free=f32(ports_free),
+        job_count=jnp.asarray(job_count, jnp.int32),
+        tg_count=jnp.asarray(tg_count, jnp.int32),
+        feasible=jnp.asarray(feasible, bool),
+        node_ok=jnp.asarray(node_ok, bool),
+    )
+
+
+def make_asks(
+    resources, bw, ports, tg_index, active, job_distinct_hosts, tg_distinct_hosts
+) -> Asks:
+    return Asks(
+        resources=jnp.asarray(resources, jnp.float32),
+        bw=jnp.asarray(bw, jnp.float32),
+        ports=jnp.asarray(ports, jnp.float32),
+        tg_index=jnp.asarray(tg_index, jnp.int32),
+        active=jnp.asarray(active, bool),
+        job_distinct_hosts=jnp.asarray(job_distinct_hosts, bool),
+        tg_distinct_hosts=jnp.asarray(tg_distinct_hosts, bool),
+    )
+
+
+def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,
+                    job_dh, tg_dh_all, config: PlacementConfig, noise):
+    """One placement's dense pass: feasibility mask + score over all N
+    nodes. tg_onehot is the [G] one-hot of the ask's task group —
+    one-hot contractions instead of dynamic gathers keep the scan body
+    free of scatter/gather ops. Returns masked_score [N]."""
+    new_util = state.util + ask_res[None, :]
+
+    # AllocsFit: full capacity superset on every dimension.
+    fits = jnp.all(new_util <= state.capacity, axis=1)
+    # Bandwidth and dynamic-port count.
+    fits &= state.bw_used + ask_bw <= state.bw_avail
+    fits &= state.ports_free >= ask_ports
+    # Constraint feasibility for this TG (precomputed per class).
+    fits &= jnp.any(state.feasible & tg_onehot[None, :], axis=1)
+    fits &= state.node_ok
+    # distinct_hosts: job-level blocks any co-placement of the job;
+    # TG-level blocks only same-TG co-placement (feasible.go:211-238).
+    tg_dh = jnp.any(tg_dh_all & tg_onehot)
+    tg_cnt = jnp.sum(state.tg_count * tg_onehot[None, :], axis=1)
+    fits &= jnp.where(job_dh, state.job_count == 0, True)
+    fits &= jnp.where(tg_dh, tg_cnt == 0, True)
+
+    # ScoreFit (BestFit-v3): packed nodes score high.
+    denom = jnp.maximum(state.sched_capacity, 1.0)
+    free_frac = 1.0 - new_util / denom
+    fitness = 20.0 - (
+        jnp.power(10.0, free_frac[:, R_CPU]) + jnp.power(10.0, free_frac[:, R_MEM])
+    )
+    fitness = jnp.clip(fitness, 0.0, 18.0)
+    # Zero schedulable capacity scores worst (fully-reserved node).
+    fitness = jnp.where(
+        (state.sched_capacity[:, R_CPU] <= 0) | (state.sched_capacity[:, R_MEM] <= 0),
+        0.0,
+        fitness,
+    )
+
+    # Job anti-affinity (rank.go:287-299).
+    score = fitness - config.anti_affinity_penalty * state.job_count.astype(jnp.float32)
+
+    # Random tie-break: preserves the reference's shuffled-source
+    # de-correlation between concurrent workers.
+    score = score + noise
+    return jnp.where(fits, score, NEG_INF)
+
+
+def placement_step(state: NodeState, ask, config: PlacementConfig, noise):
+    """Place one ask: pick the argmax-score node and update the carried
+    state. Returns (new_state, (choice, score)); choice is -1 when no
+    node fits or the ask row is padding."""
+    ask_res, ask_bw, ask_ports, tg_onehot, active, job_dh, tg_dh_all = ask
+    n = state.util.shape[0]
+
+    score = _score_and_mask(
+        state, ask_res, ask_bw, ask_ports, tg_onehot, job_dh, tg_dh_all, config, noise
+    )
+    choice = jnp.argmax(score)
+    valid = (score[choice] > NEG_INF / 2) & active
+
+    onehot = (jnp.arange(n) == choice) & valid
+    onehot_f = onehot.astype(jnp.float32)
+    onehot_i = onehot.astype(jnp.int32)
+
+    new_state = state._replace(
+        util=state.util + onehot_f[:, None] * ask_res[None, :],
+        bw_used=state.bw_used + onehot_f * ask_bw,
+        ports_free=state.ports_free - onehot_f * ask_ports,
+        job_count=state.job_count + onehot_i,
+        tg_count=state.tg_count
+        + onehot_i[:, None] * tg_onehot[None, :].astype(jnp.int32),
+    )
+    out_choice = jnp.where(valid, choice, -1).astype(jnp.int32)
+    out_score = jnp.where(valid, score[choice], 0.0)
+    return new_state, (out_choice, out_score)
+
+
+def placement_program(
+    state: NodeState, asks: Asks, key, config: PlacementConfig
+):
+    """Run K sequential placements over the cluster as one compiled
+    program. Returns (choices [K] int32, scores [K] f32, final_state)."""
+
+    k_count = asks.resources.shape[0]
+    n = state.util.shape[0]
+    g = state.feasible.shape[1]
+    # All tie-break noise drawn in one op; the scan consumes rows.
+    noise = jax.random.uniform(
+        key, (k_count, n), minval=0.0, maxval=config.noise_scale
+    )
+    tg_onehots = (
+        jnp.arange(g)[None, :] == asks.tg_index[:, None]
+    )  # [K, G]
+
+    def body(carry, xs):
+        ask_res, ask_bw, ask_ports, tg_onehot, active, noise_row = xs
+        new_state, out = placement_step(
+            carry,
+            (ask_res, ask_bw, ask_ports, tg_onehot, active,
+             asks.job_distinct_hosts, asks.tg_distinct_hosts),
+            config,
+            noise_row,
+        )
+        return new_state, out
+
+    final_state, (choices, scores) = jax.lax.scan(
+        body,
+        state,
+        (asks.resources, asks.bw, asks.ports, tg_onehots, asks.active, noise),
+    )
+    return choices, scores, final_state
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def placement_program_jit(state: NodeState, asks: Asks, key, config: PlacementConfig):
+    return placement_program(state, asks, key, config)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def batched_placement_program(states: NodeState, asks: Asks, keys, config: PlacementConfig):
+    """vmap over a leading batch axis: B independent evals planned
+    against the same snapshot (optimistic concurrency — conflicts are
+    caught by the plan applier, SURVEY.md section 2.4)."""
+    return jax.vmap(
+        lambda s, a, k: placement_program(s, a, k, config)
+    )(states, asks, keys)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def batched_placement_program_shared(
+    state: NodeState, asks: Asks, keys, config: PlacementConfig
+):
+    """Batched evals against ONE shared snapshot/ask: only the PRNG keys
+    carry the batch axis, so the cluster matrix is transferred and held
+    on device once — the broker drain-to-batch fast path."""
+    return jax.vmap(
+        lambda k: placement_program(state, asks, k, config)
+    )(keys)

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, shard_placement_inputs, sharded_placement
+
+__all__ = ["make_mesh", "shard_placement_inputs", "sharded_placement"]

@@ -1,0 +1,107 @@
+"""Device-mesh sharding of the placement program.
+
+The cluster-scheduling analog of model parallelism: the *node axis* is
+the model dimension (a 10k+-node matrix shards across chips over ICI)
+and the *eval batch* is the data dimension (independent evaluations =
+optimistic concurrency). Following the standard recipe: pick a mesh,
+annotate input shardings, and let XLA insert the collectives — the
+masked argmax over the sharded node axis lowers to an all-reduce, and
+the one-hot state update stays node-local.
+
+The reference has no tensor math to shard; its parallelism is N worker
+goroutines (SURVEY.md section 2.4). Here one device-mesh program
+subsumes both: `dp` x `nodes` = workers x cluster-shards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.binpack import Asks, NodeState
+
+DP_AXIS = "dp"  # independent evals (data parallel)
+NODE_AXIS = "nodes"  # cluster node matrix (model parallel)
+
+
+def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
+    """Build a dp x nodes mesh over the available devices. When dp is
+    not given, prefer sharding the node axis (the big dimension)."""
+    devices = np.array(jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    total = devices.size
+    if dp is None:
+        dp = 1
+    assert total % dp == 0, f"{total} devices not divisible by dp={dp}"
+    return Mesh(devices.reshape(dp, total // dp), (DP_AXIS, NODE_AXIS))
+
+
+def _node_state_specs(batched: bool) -> NodeState:
+    """PartitionSpecs for each NodeState leaf: shard the leading node
+    dim (after the optional batch dim) across NODE_AXIS."""
+    b = (DP_AXIS,) if batched else ()
+    vec = P(*b, NODE_AXIS)  # [.., N]
+    mat = P(*b, NODE_AXIS, None)  # [.., N, R]
+    return NodeState(
+        capacity=mat,
+        sched_capacity=mat,
+        util=mat,
+        bw_avail=vec,
+        bw_used=vec,
+        ports_free=vec,
+        job_count=vec,
+        tg_count=mat,
+        feasible=mat,
+        node_ok=vec,
+    )
+
+
+def _asks_specs(batched: bool) -> Asks:
+    b = (DP_AXIS,) if batched else ()
+    return Asks(
+        resources=P(*b, None, None),
+        bw=P(*b, None),
+        ports=P(*b, None),
+        tg_index=P(*b, None),
+        active=P(*b, None),
+        job_distinct_hosts=P(*b),
+        tg_distinct_hosts=P(*b, None),
+    )
+
+
+def shard_placement_inputs(
+    mesh: Mesh, state: NodeState, asks: Asks, keys, batched: bool = False
+) -> Tuple[NodeState, Asks, object]:
+    """Place the inputs on the mesh with the canonical shardings. The
+    node count must divide the nodes-axis size (callers bucket to
+    multiples of 128, models/matrix.py)."""
+    state_sh = jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        _node_state_specs(batched),
+    )
+    asks_sh = jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        asks,
+        _asks_specs(batched),
+    )
+    key_spec = P(DP_AXIS) if batched else P()
+    keys_sh = jax.device_put(keys, NamedSharding(mesh, key_spec))
+    return state_sh, asks_sh, keys_sh
+
+
+def sharded_placement(mesh: Mesh, state: NodeState, asks: Asks, keys, config,
+                      batched: bool = False):
+    """Run the placement program with mesh-sharded inputs. GSPMD
+    propagates the shardings through the scan; the argmax over the
+    sharded node axis becomes a cross-device reduction on ICI."""
+    from ..ops.binpack import batched_placement_program, placement_program_jit
+
+    state, asks, keys = shard_placement_inputs(mesh, state, asks, keys, batched)
+    if batched:
+        return batched_placement_program(state, asks, keys, config)
+    return placement_program_jit(state, asks, keys, config)

@@ -49,6 +49,9 @@ def scheduler_names():
 def new_scheduler(name: str, logger, state, planner,
                   rng: Optional[random.Random] = None):
     factory = _BUILTIN.get(name)
+    if factory is None and name.endswith("-tpu"):
+        _register_tpu_factories()
+        factory = _BUILTIN.get(name)
     if factory is None:
         raise ValueError(f"unknown scheduler '{name}'")
     return factory(logger, state, planner, rng=rng)

@@ -147,7 +147,6 @@ class GenericScheduler:
     def _process(self) -> bool:
         """One scheduling attempt; returns True when done."""
         self.job = self.state.job_by_id(self.eval.job_id)
-        num_tgs = len(self.job.task_groups) if self.job else 0
         self.queued_allocs = {}
 
         self.plan = self.eval.make_plan(self.job)

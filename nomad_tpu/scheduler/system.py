@@ -26,7 +26,6 @@ from .context import EvalContext
 from .stack import SystemStack
 from .util import (
     ALLOC_LOST,
-    ALLOC_NODE_TAINTED,
     ALLOC_NOT_NEEDED,
     ALLOC_UPDATING,
     AllocTuple,

@@ -1,0 +1,183 @@
+"""TPU-backed scheduler factories ("service-tpu", "batch-tpu").
+
+The north-star design (BASELINE.json): identical control flow to the
+GenericScheduler — same reconciliation, same blocked-eval/rolling
+semantics, same plan shape — but computePlacements runs as one dense
+JAX program instead of per-node iterators. In-place updates and
+sticky-disk preferences stay host-side (SURVEY.md section 7 hard
+parts); exact port numbers are assigned host-side on the chosen nodes;
+the plan applier re-verifies every node so kernel approximations cost
+retries, not correctness.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..structs import (
+    Allocation,
+    AllocMetric,
+    NetworkIndex,
+    NetworkResource,
+    Resources,
+    consts,
+)
+from ..utils.ids import generate_uuid
+from .generic import GenericScheduler
+from .util import AllocTuple, ready_nodes_in_dcs
+
+
+class BatchedTPUScheduler(GenericScheduler):
+    """GenericScheduler whose bulk placement loop runs on the TPU."""
+
+    def __init__(self, logger, state, planner, batch: bool,
+                 rng: Optional[random.Random] = None):
+        super().__init__(logger, state, planner, batch=batch, rng=rng)
+
+    def _compute_placements(self, place: List[AllocTuple]) -> None:
+        import jax
+
+        from ..models.matrix import ClusterMatrix
+        from ..ops.binpack import (
+            PlacementConfig,
+            make_asks,
+            make_node_state,
+            placement_program_jit,
+        )
+        from .stack import (
+            BATCH_JOB_ANTI_AFFINITY_PENALTY,
+            SERVICE_JOB_ANTI_AFFINITY_PENALTY,
+        )
+
+        # Sticky-disk placements keep the host path (they pin to one node).
+        sticky: List[AllocTuple] = []
+        bulk: List[AllocTuple] = []
+        for missing in place:
+            if self._find_preferred_node(missing) is not None:
+                sticky.append(missing)
+            else:
+                bulk.append(missing)
+        if sticky:
+            super()._compute_placements(sticky)
+        if not bulk:
+            return
+
+        matrix = ClusterMatrix(self.state, self.job, self.plan)
+        tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
+        placements = [tg_indices[m.task_group.name] for m in bulk]
+
+        state = make_node_state(
+            matrix.capacity, matrix.sched_capacity, matrix.util,
+            matrix.bw_avail, matrix.bw_used, matrix.ports_free,
+            matrix.job_count, matrix.tg_count, matrix.feasible, matrix.node_ok,
+        )
+        asks = make_asks(*matrix.build_asks(placements))
+        penalty = (
+            BATCH_JOB_ANTI_AFFINITY_PENALTY
+            if self.batch
+            else SERVICE_JOB_ANTI_AFFINITY_PENALTY
+        )
+        config = PlacementConfig(anti_affinity_penalty=penalty)
+        key = jax.random.PRNGKey(self.rng.getrandbits(31))
+
+        choices, scores, _ = placement_program_jit(state, asks, key, config)
+        choices = np.asarray(choices)
+        scores = np.asarray(scores)
+
+        # Host-side exact port assignment per chosen node, incremental.
+        net_indexes: Dict[str, NetworkIndex] = {}
+
+        for j, missing in enumerate(bulk):
+            choice = int(choices[j])
+            node = matrix.nodes[choice] if 0 <= choice < matrix.n_real else None
+
+            metrics = AllocMetric()
+            metrics.nodes_evaluated = matrix.n_real
+            metrics.nodes_available = matrix.nodes_by_dc
+
+            if node is None:
+                self._record_placement_failure(missing, matrix, metrics)
+                continue
+
+            metrics.score_node(node, "binpack", float(scores[j]))
+            task_resources = self._offer_networks(
+                missing, node, net_indexes, matrix
+            )
+            if task_resources is None:
+                # Dense port-count approximation missed a real collision:
+                # fall back to the exact host path for this placement.
+                super()._compute_placements([missing])
+                continue
+
+            alloc = Allocation(
+                id=generate_uuid(),
+                eval_id=self.eval.id,
+                name=missing.name,
+                job_id=self.job.id,
+                task_group=missing.task_group.name,
+                metrics=metrics,
+                node_id=node.id,
+                task_resources=task_resources,
+                desired_status=consts.ALLOC_DESIRED_RUN,
+                client_status=consts.ALLOC_CLIENT_PENDING,
+                shared_resources=Resources(
+                    disk_mb=missing.task_group.ephemeral_disk.size_mb
+                ),
+            )
+            if missing.alloc is not None:
+                alloc.previous_allocation = missing.alloc.id
+            self.plan.append_alloc(alloc)
+
+    # ------------------------------------------------------------------
+
+    def _record_placement_failure(self, missing: AllocTuple, matrix, metrics) -> None:
+        name = missing.task_group.name
+        if self.failed_tg_allocs and name in self.failed_tg_allocs:
+            self.failed_tg_allocs[name].coalesced_failures += 1
+            return
+        gi = {tg.name: i for i, tg in enumerate(self.job.task_groups)}[name]
+        infeasible = int(matrix.n_real - matrix.feasible[: matrix.n_real, gi].sum())
+        metrics.nodes_filtered = infeasible
+        metrics.nodes_exhausted = matrix.n_real - infeasible
+        if self.failed_tg_allocs is None:
+            self.failed_tg_allocs = {}
+        self.failed_tg_allocs[name] = metrics
+        # Feed the blocked-eval machinery per-class eligibility from the mask.
+        elig = self.ctx.eligibility
+        for i, node in enumerate(matrix.nodes):
+            if node.computed_class:
+                elig.set_task_group_eligibility(
+                    bool(matrix.feasible[i, gi]), name, node.computed_class
+                )
+
+    def _offer_networks(self, missing: AllocTuple, node, net_indexes, matrix):
+        """Exact per-task network offers on the kernel-chosen node.
+        Returns {task: Resources} or None if a port can't be assigned."""
+        idx = net_indexes.get(node.id)
+        if idx is None:
+            idx = NetworkIndex()
+            idx.set_node(node)
+            idx.add_allocs(matrix._proposed_allocs(node.id))
+            net_indexes[node.id] = idx
+
+        task_resources: Dict[str, Resources] = {}
+        staged: List[NetworkResource] = []
+        for task in missing.task_group.tasks:
+            resources = task.resources.copy()
+            if resources.networks:
+                ask = resources.networks[0]
+                offer, err = idx.assign_network(ask, self.rng)
+                if offer is None:
+                    # Roll back this alloc's staged reservations? They were
+                    # added to idx; rebuild the index from scratch next time.
+                    net_indexes.pop(node.id, None)
+                    return None
+                idx.add_reserved(offer)
+                staged.append(offer)
+                resources.networks = [offer]
+            task_resources[task.name] = resources
+        return task_resources

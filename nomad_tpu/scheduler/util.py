@@ -442,7 +442,3 @@ def adjust_queued_allocations(
                 continue
             if allocation.task_group in queued_allocs:
                 queued_allocs[allocation.task_group] -= 1
-
-
-def shuffle_nodes(rng, nodes: List[Node]) -> None:
-    rng.shuffle(nodes)

@@ -29,16 +29,12 @@ class Version:
         self.segments = tuple(segs)
         self.prerelease = m.group(2) or ""
 
-    def _cmp_key(self) -> Tuple:
-        # A prerelease sorts before the release itself.
-        return (self.segments, 0 if not self.prerelease else -1, self.prerelease)
-
     def __lt__(self, other: "Version") -> bool:
         if self.segments != other.segments:
             return self.segments < other.segments
         if bool(self.prerelease) != bool(other.prerelease):
             return bool(self.prerelease)  # prerelease < release
-        return self.prerelease < other.prerelease
+        return _prerelease_lt(self.prerelease, other.prerelease)
 
     def __eq__(self, other) -> bool:
         return (
@@ -61,6 +57,22 @@ class Version:
 
     def __repr__(self):
         return f"Version({self.raw!r})"
+
+
+def _prerelease_lt(a: str, b: str) -> bool:
+    """Semver prerelease ordering: dot-separated identifiers compare
+    per-identifier, numeric ones numerically and below alphanumeric
+    (so rc.9 < rc.10 and beta.2 < beta.11)."""
+    for ai, bi in zip(a.split("."), b.split(".")):
+        a_num, b_num = ai.isdigit(), bi.isdigit()
+        if a_num and b_num:
+            if int(ai) != int(bi):
+                return int(ai) < int(bi)
+        elif a_num != b_num:
+            return a_num  # numeric identifiers sort below alphanumeric
+        elif ai != bi:
+            return ai < bi
+    return len(a.split(".")) < len(b.split("."))
 
 
 _CONSTRAINT_RE = re.compile(r"^\s*(=|!=|>=|<=|>|<|~>)?\s*([^\s,]+)\s*$")

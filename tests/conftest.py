@@ -1,18 +1,20 @@
 """Test configuration.
 
-JAX runs on a virtual 8-device CPU mesh so multi-chip sharding paths are
-exercised without TPU hardware (the driver separately dry-runs the
+JAX runs on a virtual 8-device CPU mesh so multi-chip sharding paths
+are exercised without TPU hardware (the driver separately dry-runs the
 multi-chip path via __graft_entry__.dryrun_multichip).
+
+The axon sitecustomize pins jax_platforms to "axon,cpu", so plain
+JAX_PLATFORMS=cpu in the environment is not enough — override the
+config before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

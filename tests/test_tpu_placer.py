@@ -1,0 +1,348 @@
+"""TPU placement backend tests: kernel behavior + CPU/TPU differential
+parity (the BASELINE gate: identical plan-apply success rate on the
+same snapshots)."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import Constraint, consts, new_eval
+
+
+def seed_nodes(h, count, dc="dc1"):
+    nodes = []
+    for _ in range(count):
+        n = mock.node()
+        n.datacenter = dc
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return nodes
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def test_kernel_basic_placement():
+    import jax
+
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        make_asks,
+        make_node_state,
+        placement_program_jit,
+    )
+
+    n, g = 8, 1
+    state = make_node_state(
+        capacity=np.tile([4000, 8192, 100000, 150], (n, 1)),
+        sched_capacity=np.tile([3900, 7936, 96000, 150], (n, 1)),
+        util=np.tile([100.0, 256.0, 4096.0, 0.0], (n, 1)),
+        bw_avail=np.full(n, 1000.0),
+        bw_used=np.full(n, 1.0),
+        ports_free=np.full(n, 40000.0),
+        job_count=np.zeros(n, np.int32),
+        tg_count=np.zeros((n, g), np.int32),
+        feasible=np.ones((n, g), bool),
+        node_ok=np.ones(n, bool),
+    )
+    asks = make_asks(
+        resources=np.tile([500, 256, 150, 0], (4, 1)),
+        bw=np.full(4, 50.0),
+        ports=np.full(4, 2.0),
+        tg_index=np.zeros(4, np.int32),
+        active=np.ones(4, bool),
+        job_distinct_hosts=False,
+        tg_distinct_hosts=np.zeros(g, bool),
+    )
+    config = PlacementConfig(anti_affinity_penalty=10.0)
+    choices, scores, final = placement_program_jit(
+        state, asks, jax.random.PRNGKey(0), config
+    )
+    choices = np.asarray(choices)
+    assert (choices >= 0).all()
+    # anti-affinity spreads the 4 placements over 4 distinct nodes
+    assert len(set(choices.tolist())) == 4
+    # state was carried: each chosen node's util grew by the ask
+    assert float(np.asarray(final.util)[choices[0], 0]) == 600.0
+
+
+def test_kernel_respects_capacity_and_feasibility():
+    import jax
+
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        make_asks,
+        make_node_state,
+        placement_program_jit,
+    )
+
+    n, g = 4, 1
+    feasible = np.ones((n, g), bool)
+    feasible[0, 0] = False  # node 0 constrained away
+    state = make_node_state(
+        capacity=np.tile([1000, 1000, 1000, 0], (n, 1)),
+        sched_capacity=np.tile([1000, 1000, 1000, 0], (n, 1)),
+        util=np.zeros((n, 4)),
+        bw_avail=np.full(n, 100.0),
+        bw_used=np.zeros(n),
+        ports_free=np.full(n, 100.0),
+        job_count=np.zeros(n, np.int32),
+        tg_count=np.zeros((n, g), np.int32),
+        feasible=feasible,
+        node_ok=np.ones(n, bool),
+    )
+    # each ask consumes a whole node; 5 asks > 3 feasible nodes
+    asks = make_asks(
+        resources=np.tile([1000, 1000, 1000, 0], (5, 1)),
+        bw=np.zeros(5),
+        ports=np.zeros(5),
+        tg_index=np.zeros(5, np.int32),
+        active=np.ones(5, bool),
+        job_distinct_hosts=False,
+        tg_distinct_hosts=np.zeros(g, bool),
+    )
+    config = PlacementConfig(anti_affinity_penalty=10.0)
+    choices, _, _ = placement_program_jit(state, asks, jax.random.PRNGKey(1), config)
+    choices = np.asarray(choices).tolist()
+    placed = [c for c in choices if c >= 0]
+    assert len(placed) == 3
+    assert 0 not in placed  # infeasible node never chosen
+    assert choices[3] == -1 and choices[4] == -1
+
+
+def test_kernel_distinct_hosts():
+    import jax
+
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        make_asks,
+        make_node_state,
+        placement_program_jit,
+    )
+
+    n, g = 3, 1
+    state = make_node_state(
+        capacity=np.tile([10000, 10000, 10000, 0], (n, 1)),
+        sched_capacity=np.tile([10000, 10000, 10000, 0], (n, 1)),
+        util=np.zeros((n, 4)),
+        bw_avail=np.full(n, 1e6),
+        bw_used=np.zeros(n),
+        ports_free=np.full(n, 100.0),
+        job_count=np.zeros(n, np.int32),
+        tg_count=np.zeros((n, g), np.int32),
+        feasible=np.ones((n, g), bool),
+        node_ok=np.ones(n, bool),
+    )
+    asks = make_asks(
+        resources=np.tile([10, 10, 10, 0], (5, 1)),
+        bw=np.zeros(5),
+        ports=np.zeros(5),
+        tg_index=np.zeros(5, np.int32),
+        active=np.ones(5, bool),
+        job_distinct_hosts=True,
+        tg_distinct_hosts=np.zeros(g, bool),
+    )
+    config = PlacementConfig(anti_affinity_penalty=10.0)
+    choices, _, _ = placement_program_jit(state, asks, jax.random.PRNGKey(2), config)
+    choices = np.asarray(choices).tolist()
+    placed = [c for c in choices if c >= 0]
+    assert len(placed) == 3  # one per host, then exhausted
+    assert len(set(placed)) == 3
+
+
+def test_kernel_batched_vmap():
+    import jax
+
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        batched_placement_program,
+        make_asks,
+        make_node_state,
+    )
+
+    b, n, g, k = 4, 8, 1, 3
+
+    def stack(tree):
+        return jax.tree.map(lambda x: np.broadcast_to(x, (b,) + x.shape).copy(), tree)
+
+    state = make_node_state(
+        capacity=np.tile([4000, 8192, 100000, 150], (n, 1)),
+        sched_capacity=np.tile([3900, 7936, 96000, 150], (n, 1)),
+        util=np.tile([100.0, 256.0, 4096.0, 0.0], (n, 1)),
+        bw_avail=np.full(n, 1000.0),
+        bw_used=np.zeros(n),
+        ports_free=np.full(n, 40000.0),
+        job_count=np.zeros(n, np.int32),
+        tg_count=np.zeros((n, g), np.int32),
+        feasible=np.ones((n, g), bool),
+        node_ok=np.ones(n, bool),
+    )
+    asks = make_asks(
+        resources=np.tile([500, 256, 150, 0], (k, 1)),
+        bw=np.zeros(k),
+        ports=np.zeros(k),
+        tg_index=np.zeros(k, np.int32),
+        active=np.ones(k, bool),
+        job_distinct_hosts=False,
+        tg_distinct_hosts=np.zeros(g, bool),
+    )
+    states = stack(state)
+    asks_b = stack(asks)
+    keys = jax.random.split(jax.random.PRNGKey(3), b)
+    choices, scores, _ = batched_placement_program(
+        states, asks_b, keys, PlacementConfig(anti_affinity_penalty=10.0)
+    )
+    assert np.asarray(choices).shape == (b, k)
+    assert (np.asarray(choices) >= 0).all()
+
+
+# ------------------------------------------------------- scheduler parity
+
+
+def run_with(h, sched_name, job, trigger=consts.EVAL_TRIGGER_JOB_REGISTER):
+    h.process(sched_name, new_eval(h.state.job_by_id(job.id), trigger))
+
+
+def test_tpu_scheduler_job_register_parity():
+    h_cpu, h_tpu = Harness(seed=50), Harness(seed=50)
+    job = mock.job()
+    for h in (h_cpu, h_tpu):
+        for _ in range(10):
+            h.state.upsert_node(h.next_index(), mock.node())
+        h.state.upsert_job(h.next_index(), job.copy())
+
+    run_with(h_cpu, "service", job)
+    run_with(h_tpu, "service-tpu", job)
+
+    cpu_allocs = h_cpu.state.allocs_by_job(job.id)
+    tpu_allocs = h_tpu.state.allocs_by_job(job.id)
+    assert len(cpu_allocs) == len(tpu_allocs) == 10
+    assert {a.name for a in cpu_allocs} == {a.name for a in tpu_allocs}
+    # both assigned real dynamic ports
+    for a in tpu_allocs:
+        net = a.task_resources["web"].networks[0]
+        for p in net.dynamic_ports:
+            assert consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT
+    h_tpu.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    assert h_tpu.evals[0].queued_allocations == {"web": 0}
+
+
+def test_tpu_scheduler_constraint_and_capacity_parity():
+    """Mixed cluster: only some nodes feasible, capacity for only part of
+    the ask -> CPU and TPU place identical counts and fail identically."""
+    h_cpu, h_tpu = Harness(seed=51), Harness(seed=51)
+    job = mock.job()
+    job.task_groups[0].count = 30
+    for h in (h_cpu, h_tpu):
+        for i in range(6):
+            n = mock.node()
+            if i >= 3:
+                n.attributes["kernel.name"] = "windows"
+                n.compute_class()
+            h.state.upsert_node(h.next_index(), n)
+        h.state.upsert_job(h.next_index(), job.copy())
+
+    run_with(h_cpu, "service", job)
+    run_with(h_tpu, "service-tpu", job)
+
+    cpu_allocs = h_cpu.state.allocs_by_job(job.id)
+    tpu_allocs = h_tpu.state.allocs_by_job(job.id)
+    # identical placement capacity on both paths
+    assert len(cpu_allocs) == len(tpu_allocs)
+    assert {a.node_id for a in tpu_allocs} <= {
+        n.id for n in h_tpu.state.nodes() if n.attributes["kernel.name"] == "linux"
+    }
+    cpu_q = h_cpu.evals[0].queued_allocations["web"]
+    tpu_q = h_tpu.evals[0].queued_allocations["web"]
+    assert cpu_q == tpu_q
+    # both created blocked evals for the remainder
+    assert len(h_cpu.create_evals) == len(h_tpu.create_evals) == 1
+
+
+def test_tpu_scheduler_distinct_hosts():
+    h = Harness(seed=52)
+    for _ in range(4):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.constraints.append(Constraint(operand="distinct_hosts"))
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+    run_with(h, "service-tpu", job)
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 4
+    assert len({a.node_id for a in out}) == 4
+
+
+def test_tpu_scheduler_node_down_replan():
+    h = Harness(seed=53)
+    nodes = [mock.node() for _ in range(3)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    run_with(h, "service-tpu", job)
+    allocs = h.state.allocs_by_job(job.id)
+    victim = allocs[0].node_id
+    h.state.update_node_status(h.next_index(), victim, consts.NODE_STATUS_DOWN)
+
+    h2 = Harness(state=h.state, seed=54)
+    h2._next_index = h._next_index
+    run_with(h2, "service-tpu", job, consts.EVAL_TRIGGER_NODE_UPDATE)
+    live = [a for a in h2.state.allocs_by_job(job.id) if not a.terminal_status()]
+    assert len(live) == 2
+    assert all(a.node_id != victim for a in live)
+
+
+def test_tpu_scheduler_sticky_disk_falls_back_to_host_path():
+    h = Harness(seed=55)
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].ephemeral_disk.sticky = True
+    h.state.upsert_job(h.next_index(), job)
+    sjob = h.state.job_by_id(job.id)
+    a = mock.alloc()
+    a.job = sjob
+    a.job_id = sjob.id
+    a.node_id = nodes[2].id
+    a.name = f"{sjob.name}.web[0]"
+    a.task_group = "web"
+    a.client_status = consts.ALLOC_CLIENT_FAILED
+    a.desired_status = consts.ALLOC_DESIRED_STOP
+    h.state.upsert_allocs(h.next_index(), [a])
+
+    run_with(h, "service-tpu", job)
+    placed = [x for lst in h.plans[-1].node_allocation.values() for x in lst]
+    assert len(placed) == 1
+    assert placed[0].node_id == nodes[2].id
+
+
+def test_tpu_plans_pass_plan_verification():
+    """The differential gate: every TPU plan must survive the same
+    AllocsFit verification the plan applier runs per node."""
+    from nomad_tpu.structs import allocs_fit, remove_allocs
+
+    h = Harness(seed=56)
+    for _ in range(5):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 20
+    h.state.upsert_job(h.next_index(), job)
+    snap_before = h.state.snapshot()
+    run_with(h, "service-tpu", job)
+
+    plan = h.plans[-1]
+    for node_id, placed in plan.node_allocation.items():
+        node = snap_before.node_by_id(node_id)
+        existing = snap_before.allocs_by_node_terminal(node_id, False)
+        updates = plan.node_update.get(node_id, [])
+        proposed = remove_allocs(existing, updates) + placed
+        for a in proposed:
+            if a.job is None:
+                a.job = plan.job
+        fit, dim, _ = allocs_fit(node, proposed)
+        assert fit, f"TPU plan failed verification on {node_id}: {dim}"
