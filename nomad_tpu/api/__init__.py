@@ -1,0 +1,4 @@
+from .http import HTTPServer
+from .client import Client
+
+__all__ = ["HTTPServer", "Client"]

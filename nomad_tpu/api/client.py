@@ -1,0 +1,219 @@
+"""Python SDK mirroring the HTTP API.
+
+Reference: api/ (Go SDK, api.go:140 NewClient + per-resource clients),
+including blocking-query support (QueryOptions:20).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..structs import Allocation, Evaluation, Job, Node
+from ..utils.codec import from_dict, to_dict
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class Client:
+    def __init__(self, address: str, timeout: float = 305.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.system = System(self)
+        self.agent = Agent(self)
+
+    # ------------------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Tuple[Any, int]:
+        url = self.address + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read() or b"null")
+                index = int(resp.headers.get("X-Nomad-Index") or 0)
+                return payload, index
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                message = str(e)
+            raise APIError(e.code, message) from None
+
+    def get(self, path: str, params: Optional[Dict] = None) -> Tuple[Any, int]:
+        return self._request("GET", path, params=params)
+
+    def put(self, path: str, body: Any = None, params: Optional[Dict] = None):
+        return self._request("PUT", path, body=body, params=params)
+
+    def delete(self, path: str) -> Tuple[Any, int]:
+        return self._request("DELETE", path)
+
+
+def _query_params(index: Optional[int], wait: Optional[float]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    if index is not None:
+        params["index"] = str(index)
+    if wait is not None:
+        params["wait"] = str(wait)
+    return params
+
+
+class Jobs:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def register(self, job: Job) -> str:
+        out, _ = self.c.put("/v1/jobs", {"job": to_dict(job)})
+        return out["eval_id"]
+
+    def list(self, index: Optional[int] = None, wait: Optional[float] = None):
+        return self.c.get("/v1/jobs", _query_params(index, wait))
+
+    def info(self, job_id: str, index: Optional[int] = None,
+             wait: Optional[float] = None) -> Tuple[Job, int]:
+        out, idx = self.c.get(f"/v1/job/{job_id}", _query_params(index, wait))
+        return from_dict(Job, out), idx
+
+    def deregister(self, job_id: str) -> str:
+        out, _ = self.c.delete(f"/v1/job/{job_id}")
+        return out["eval_id"]
+
+    def allocations(self, job_id: str, index: Optional[int] = None,
+                    wait: Optional[float] = None):
+        return self.c.get(f"/v1/job/{job_id}/allocations", _query_params(index, wait))
+
+    def evaluations(self, job_id: str):
+        out, idx = self.c.get(f"/v1/job/{job_id}/evaluations")
+        return [from_dict(Evaluation, e) for e in out], idx
+
+    def evaluate(self, job_id: str) -> str:
+        out, _ = self.c.put(f"/v1/job/{job_id}/evaluate")
+        return out["eval_id"]
+
+    def plan(self, job: Job, diff: bool = False) -> dict:
+        out, _ = self.c.put(
+            f"/v1/job/{job.id}/plan", {"job": to_dict(job), "diff": diff}
+        )
+        return out
+
+    def periodic_force(self, job_id: str) -> str:
+        out, _ = self.c.put(f"/v1/job/{job_id}/periodic/force")
+        return out["child_job_id"]
+
+    def summary(self, job_id: str):
+        return self.c.get(f"/v1/job/{job_id}/summary")
+
+
+class Nodes:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def list(self, index: Optional[int] = None, wait: Optional[float] = None):
+        return self.c.get("/v1/nodes", _query_params(index, wait))
+
+    def info(self, node_id: str) -> Tuple[Node, int]:
+        out, idx = self.c.get(f"/v1/node/{node_id}")
+        return from_dict(Node, out), idx
+
+    def allocations(self, node_id: str, secret: str = "",
+                    index: Optional[int] = None, wait: Optional[float] = None):
+        params = _query_params(index, wait)
+        if secret:
+            params["secret"] = secret
+        out, idx = self.c.get(f"/v1/node/{node_id}/allocations", params)
+        return [from_dict(Allocation, a) for a in out], idx
+
+    def drain(self, node_id: str, drain: bool = True) -> None:
+        self.c.put(f"/v1/node/{node_id}/drain", {"drain": drain})
+
+    def register(self, node: Node) -> float:
+        out, _ = self.c.put(f"/v1/node/{node.id}/register", {"node": to_dict(node)})
+        return out["heartbeat_ttl"]
+
+    def heartbeat(self, node_id: str, secret_id: str = "") -> float:
+        out, _ = self.c.put(
+            f"/v1/node/{node_id}/heartbeat", {"secret_id": secret_id}
+        )
+        return out["heartbeat_ttl"]
+
+    def update_status(self, node_id: str, status: str) -> float:
+        out, _ = self.c.put(f"/v1/node/{node_id}/status", {"status": status})
+        return out["heartbeat_ttl"]
+
+    def update_allocs(self, node_id: str, allocs: List[Allocation]) -> int:
+        out, _ = self.c.put(
+            f"/v1/node/{node_id}/allocs",
+            {"allocs": [to_dict(a) for a in allocs]},
+        )
+        return out["index"]
+
+
+class Allocations:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def list(self, index: Optional[int] = None, wait: Optional[float] = None):
+        return self.c.get("/v1/allocations", _query_params(index, wait))
+
+    def info(self, alloc_id: str) -> Tuple[Allocation, int]:
+        out, idx = self.c.get(f"/v1/allocation/{alloc_id}")
+        return from_dict(Allocation, out), idx
+
+
+class Evaluations:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def list(self):
+        out, idx = self.c.get("/v1/evaluations")
+        return [from_dict(Evaluation, e) for e in out], idx
+
+    def info(self, eval_id: str, index: Optional[int] = None,
+             wait: Optional[float] = None) -> Tuple[Evaluation, int]:
+        out, idx = self.c.get(f"/v1/evaluation/{eval_id}", _query_params(index, wait))
+        return from_dict(Evaluation, out), idx
+
+    def allocations(self, eval_id: str):
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations")
+
+
+class System:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def garbage_collect(self) -> None:
+        self.c.put("/v1/system/gc")
+
+
+class Agent:
+    def __init__(self, client: Client):
+        self.c = client
+
+    def self(self) -> dict:
+        out, _ = self.c.get("/v1/agent/self")
+        return out
+
+    def leader(self) -> str:
+        out, _ = self.c.get("/v1/status/leader")
+        return out

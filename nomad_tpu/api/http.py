@@ -1,0 +1,386 @@
+"""HTTP API: /v1/* routes with blocking-query support.
+
+Reference: command/agent/http.go:103-138 (routes) and the blocking-query
+protocol (rpc.go:334 blockingRPC): `?index=N&wait=Ns` long-polls until
+the watched scope passes index N or the wait expires; responses carry
+X-Nomad-Index.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..state import watch
+from ..structs import Allocation, Evaluation, Job, Node
+from ..utils.codec import from_dict, to_dict
+
+MAX_BLOCKING_WAIT = 300.0  # rpc.go:34
+DEFAULT_BLOCKING_WAIT = 300.0
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class HTTPServer:
+    """Embeds the server; serves the public API on localhost."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self):
+                try:
+                    body = api.handle(self)
+                except HTTPError as e:
+                    self._reply(e.status, {"error": e.message})
+                except (ValueError, PermissionError) as e:
+                    status = 403 if isinstance(e, PermissionError) else 400
+                    self._reply(status, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": str(e)})
+                else:
+                    index = api.server.fsm.state.latest_index()
+                    self._reply(200, body, index)
+
+            def _reply(self, status, body, index=None):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_PUT = do_POST = do_DELETE = _dispatch
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"http://{host}:{self._httpd.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-api", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------------
+
+    def handle(self, req) -> Any:
+        parsed = urllib.parse.urlparse(req.path)
+        path = parsed.path.rstrip("/")
+        query = urllib.parse.parse_qs(parsed.query)
+        method = req.command
+        body = None
+        length = int(req.headers.get("Content-Length") or 0)
+        if length:
+            body = json.loads(req.rfile.read(length))
+
+        route_handlers: List[Tuple[str, Callable]] = [
+            (r"^/v1/jobs$", self._jobs),
+            (r"^/v1/job/(?P<job_id>[^/]+)$", self._job),
+            (r"^/v1/job/(?P<job_id>[^/]+)/allocations$", self._job_allocations),
+            (r"^/v1/job/(?P<job_id>[^/]+)/evaluations$", self._job_evaluations),
+            (r"^/v1/job/(?P<job_id>[^/]+)/evaluate$", self._job_evaluate),
+            (r"^/v1/job/(?P<job_id>[^/]+)/plan$", self._job_plan),
+            (r"^/v1/job/(?P<job_id>[^/]+)/periodic/force$", self._job_periodic_force),
+            (r"^/v1/job/(?P<job_id>[^/]+)/summary$", self._job_summary),
+            (r"^/v1/nodes$", self._nodes),
+            (r"^/v1/node/(?P<node_id>[^/]+)$", self._node),
+            (r"^/v1/node/(?P<node_id>[^/]+)/allocations$", self._node_allocations),
+            (r"^/v1/node/(?P<node_id>[^/]+)/drain$", self._node_drain),
+            (r"^/v1/node/(?P<node_id>[^/]+)/register$", self._node_register),
+            (r"^/v1/node/(?P<node_id>[^/]+)/heartbeat$", self._node_heartbeat),
+            (r"^/v1/node/(?P<node_id>[^/]+)/status$", self._node_status),
+            (r"^/v1/node/(?P<node_id>[^/]+)/allocs$", self._node_update_allocs),
+            (r"^/v1/allocations$", self._allocations),
+            (r"^/v1/allocation/(?P<alloc_id>[^/]+)$", self._allocation),
+            (r"^/v1/evaluations$", self._evaluations),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)$", self._evaluation),
+            (r"^/v1/evaluation/(?P<eval_id>[^/]+)/allocations$", self._eval_allocations),
+            (r"^/v1/status/leader$", self._status_leader),
+            (r"^/v1/status/peers$", self._status_peers),
+            (r"^/v1/agent/self$", self._agent_self),
+            (r"^/v1/system/gc$", self._system_gc),
+        ]
+        for pattern, handler in route_handlers:
+            m = re.match(pattern, path)
+            if m:
+                return handler(method, query, body, **m.groupdict())
+        raise HTTPError(404, f"no handler for {path!r}")
+
+    # ------------------------------------------------------------------
+
+    def _blocking(self, query, items, run: Callable[[], Any]) -> Any:
+        """Blocking-query wrapper: re-run until the state index passes
+        ?index=N or the wait expires."""
+        min_index = int(query.get("index", ["0"])[0])
+        wait = min(
+            float(query.get("wait", [DEFAULT_BLOCKING_WAIT])[0]), MAX_BLOCKING_WAIT
+        )
+        state = self.server.fsm.state
+        if min_index <= 0:
+            return run()
+        deadline = time.monotonic() + wait
+        while True:
+            ev = state.watch(items)
+            if state.latest_index() > min_index:
+                state.stop_watch(items, ev)
+                return run()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                state.stop_watch(items, ev)
+                return run()
+            ev.wait(min(remaining, 1.0))
+            state.stop_watch(items, ev)
+
+    # ------------------------------------------------------------- jobs
+
+    def _jobs(self, method, query, body):
+        if method in ("PUT", "POST"):
+            job = from_dict(Job, body.get("job", body))
+            eval_id, index = self.server.job_register(job)
+            return {"eval_id": eval_id, "index": index}
+        state = self.server.fsm.state
+        prefix = query.get("prefix", [""])[0]
+        return self._blocking(
+            query,
+            [watch.table("jobs")],
+            lambda: [
+                _job_stub(j)
+                for j in state.jobs()
+                if j.id.startswith(prefix)
+            ],
+        )
+
+    def _job(self, method, query, body, job_id):
+        if method == "DELETE":
+            eval_id = self.server.job_deregister(job_id)
+            return {"eval_id": eval_id or ""}
+        if method in ("PUT", "POST"):
+            job = from_dict(Job, body.get("job", body))
+            if job.id != job_id:
+                raise HTTPError(400, "job ID does not match URL")
+            eval_id, index = self.server.job_register(job)
+            return {"eval_id": eval_id, "index": index}
+        state = self.server.fsm.state
+
+        def run():
+            job = state.job_by_id(job_id)
+            if job is None:
+                raise HTTPError(404, f"job {job_id!r} not found")
+            return to_dict(job)
+
+        return self._blocking(query, [watch.job(job_id)], run)
+
+    def _job_allocations(self, method, query, body, job_id):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.alloc_job(job_id)],
+            lambda: [a.stub() for a in state.allocs_by_job(job_id)],
+        )
+
+    def _job_evaluations(self, method, query, body, job_id):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.table("evals")],
+            lambda: [to_dict(e) for e in state.evals_by_job(job_id)],
+        )
+
+    def _job_evaluate(self, method, query, body, job_id):
+        return {"eval_id": self.server.job_evaluate(job_id)}
+
+    def _job_plan(self, method, query, body, job_id):
+        job = from_dict(Job, body.get("job", body))
+        result = self.server.job_plan(job, diff=bool(body.get("diff")))
+        return {
+            "annotations": to_dict(result["annotations"]),
+            "failed_tg_allocs": to_dict(result["failed_tg_allocs"]),
+            "index": result["index"],
+        }
+
+    def _job_periodic_force(self, method, query, body, job_id):
+        child = self.server.periodic_force(job_id)
+        return {"child_job_id": child}
+
+    def _job_summary(self, method, query, body, job_id):
+        state = self.server.fsm.state
+
+        def run():
+            summary = state.job_summary_by_id(job_id)
+            if summary is None:
+                raise HTTPError(404, f"job {job_id!r} not found")
+            return to_dict(summary)
+
+        return self._blocking(query, [watch.job_summary(job_id)], run)
+
+    # ------------------------------------------------------------ nodes
+
+    def _nodes(self, method, query, body):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.table("nodes")],
+            lambda: [_node_stub(n) for n in state.nodes()],
+        )
+
+    def _node(self, method, query, body, node_id):
+        state = self.server.fsm.state
+
+        def run():
+            node = state.node_by_id(node_id)
+            if node is None:
+                raise HTTPError(404, f"node {node_id!r} not found")
+            return to_dict(node)
+
+        return self._blocking(query, [watch.node(node_id)], run)
+
+    def _node_allocations(self, method, query, body, node_id):
+        state = self.server.fsm.state
+        secret = query.get("secret", [""])[0]
+        node = state.node_by_id(node_id)
+        if secret and (node is None or node.secret_id != secret):
+            raise HTTPError(403, "node secret ID does not match")
+        return self._blocking(
+            query,
+            [watch.alloc_node(node_id)],
+            lambda: [to_dict(a) for a in state.allocs_by_node(node_id)],
+        )
+
+    def _node_drain(self, method, query, body, node_id):
+        drain = (body or {}).get("drain", True)
+        self.server.node_update_drain(node_id, drain)
+        return {"index": self.server.fsm.state.latest_index()}
+
+    def _node_register(self, method, query, body, node_id):
+        node = from_dict(Node, body["node"])
+        ttl = self.server.node_register(node)
+        return {"heartbeat_ttl": ttl}
+
+    def _node_heartbeat(self, method, query, body, node_id):
+        ttl = self.server.node_heartbeat(node_id, (body or {}).get("secret_id", ""))
+        return {"heartbeat_ttl": ttl}
+
+    def _node_status(self, method, query, body, node_id):
+        ttl = self.server.node_update_status(node_id, body["status"])
+        return {"heartbeat_ttl": ttl}
+
+    def _node_update_allocs(self, method, query, body, node_id):
+        allocs = [from_dict(Allocation, a) for a in body["allocs"]]
+        index = self.server.node_update_allocs(allocs)
+        return {"index": index}
+
+    # ----------------------------------------------------- allocs/evals
+
+    def _allocations(self, method, query, body):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.table("allocs")],
+            lambda: [a.stub() for a in state.allocs()],
+        )
+
+    def _allocation(self, method, query, body, alloc_id):
+        state = self.server.fsm.state
+
+        def run():
+            alloc = state.alloc_by_id(alloc_id)
+            if alloc is None:
+                raise HTTPError(404, f"alloc {alloc_id!r} not found")
+            return to_dict(alloc)
+
+        return self._blocking(query, [watch.alloc(alloc_id)], run)
+
+    def _evaluations(self, method, query, body):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.table("evals")],
+            lambda: [to_dict(e) for e in state.evals()],
+        )
+
+    def _evaluation(self, method, query, body, eval_id):
+        state = self.server.fsm.state
+
+        def run():
+            ev = state.eval_by_id(eval_id)
+            if ev is None:
+                raise HTTPError(404, f"eval {eval_id!r} not found")
+            return to_dict(ev)
+
+        return self._blocking(query, [watch.eval_item(eval_id)], run)
+
+    def _eval_allocations(self, method, query, body, eval_id):
+        state = self.server.fsm.state
+        return self._blocking(
+            query,
+            [watch.alloc_eval(eval_id)],
+            lambda: [a.stub() for a in state.allocs_by_eval(eval_id)],
+        )
+
+    # ----------------------------------------------------------- system
+
+    def _status_leader(self, method, query, body):
+        return self.addr if self.server.is_leader() else ""
+
+    def _status_peers(self, method, query, body):
+        return [self.addr]
+
+    def _agent_self(self, method, query, body):
+        return {"stats": self.server.stats(), "config": to_dict(self.server.config)}
+
+    def _system_gc(self, method, query, body):
+        self.server.force_gc()
+        return {}
+
+
+def _job_stub(job: Job) -> dict:
+    return {
+        "id": job.id,
+        "parent_id": job.parent_id,
+        "name": job.name,
+        "type": job.type,
+        "priority": job.priority,
+        "status": job.status,
+        "status_description": job.status_description,
+        "create_index": job.create_index,
+        "modify_index": job.modify_index,
+        "job_modify_index": job.job_modify_index,
+    }
+
+
+def _node_stub(node: Node) -> dict:
+    return {
+        "id": node.id,
+        "datacenter": node.datacenter,
+        "name": node.name,
+        "node_class": node.node_class,
+        "drain": node.drain,
+        "status": node.status,
+        "status_description": node.status_description,
+        "create_index": node.create_index,
+        "modify_index": node.modify_index,
+    }

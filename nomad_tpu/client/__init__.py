@@ -1,0 +1,3 @@
+from .mock_client import MockClient
+
+__all__ = ["MockClient"]

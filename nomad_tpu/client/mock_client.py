@@ -1,0 +1,134 @@
+"""MockClient: an in-process client agent stand-in.
+
+Registers a node, heartbeats, watches its allocations (the client pull
+model, reference client/client.go:1125 watchAllocations keyed on
+alloc_modify_index), and drives alloc client status pending -> running
+(-> complete for batch). The real client agent (fingerprints, task
+runners, drivers) lands in stage 6; this is the smallest thing that
+exercises eval -> plan -> commit -> client status end-to-end
+(SURVEY.md section 7 step 3).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import mock
+from ..state import watch
+from ..structs import Node, TaskState, consts
+
+
+class MockClient:
+    def __init__(self, server, node: Optional[Node] = None,
+                 complete_after: Optional[float] = None):
+        self.server = server
+        self.node = node or mock.node()
+        # How long a "task" runs before completing (batch semantics);
+        # None means run forever (service semantics).
+        self.complete_after = complete_after
+        self._stop = threading.Event()
+        self._threads = []
+        self._seen_index: Dict[str, int] = {}  # alloc id -> alloc_modify_index
+        self._started_at: Dict[str, float] = {}
+        self.heartbeat_ttl = 0.0
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.node.status = consts.NODE_STATUS_INIT
+        self.server.node_register(self.node)
+        self.heartbeat_ttl = self.server.node_update_status(
+            self.node.id, consts.NODE_STATUS_READY
+        )
+        for target in (self._heartbeat_loop, self._watch_allocs):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            interval = max(self.heartbeat_ttl / 2.0, 0.05)
+            if self._stop.wait(interval):
+                return
+            try:
+                self.heartbeat_ttl = self.server.node_heartbeat(
+                    self.node.id, self.node.secret_id
+                )
+            except Exception:
+                pass
+
+    def _watch_allocs(self) -> None:
+        """Long-poll on this node's alloc scope; sync changed allocs'
+        client status back (client.go:1125/runAllocs:1285)."""
+        state = self.server.fsm.state
+        items = [watch.alloc_node(self.node.id)]
+        while not self._stop.is_set():
+            ev = state.watch(items)
+            self._sync_once()
+            ev.wait(0.2)
+            state.stop_watch(items, ev)
+
+    def _sync_once(self) -> None:
+        state = self.server.fsm.state
+        updates = []
+        now = time.time()
+        for alloc in state.allocs_by_node(self.node.id):
+            seen = self._seen_index.get(alloc.id, -1)
+            task_names = (
+                [t.name for t in alloc.job.lookup_task_group(alloc.task_group).tasks]
+                if alloc.job and alloc.job.lookup_task_group(alloc.task_group)
+                else ["task"]
+            )
+            if alloc.desired_status == consts.ALLOC_DESIRED_RUN:
+                if alloc.client_status == consts.ALLOC_CLIENT_PENDING:
+                    updated = alloc.copy()
+                    updated.client_status = consts.ALLOC_CLIENT_RUNNING
+                    updated.task_states = {
+                        name: TaskState(state=consts.TASK_STATE_RUNNING)
+                        for name in task_names
+                    }
+                    updates.append(updated)
+                    self._started_at[alloc.id] = now
+                elif (
+                    alloc.client_status == consts.ALLOC_CLIENT_RUNNING
+                    and self.complete_after is not None
+                    and now - self._started_at.get(alloc.id, now)
+                    >= self.complete_after
+                ):
+                    updated = alloc.copy()
+                    updated.client_status = consts.ALLOC_CLIENT_COMPLETE
+                    updated.task_states = {
+                        name: TaskState(state=consts.TASK_STATE_DEAD, failed=False)
+                        for name in task_names
+                    }
+                    updates.append(updated)
+            elif alloc.desired_status in (
+                consts.ALLOC_DESIRED_STOP,
+                consts.ALLOC_DESIRED_EVICT,
+            ):
+                if alloc.client_status in (
+                    consts.ALLOC_CLIENT_PENDING,
+                    consts.ALLOC_CLIENT_RUNNING,
+                ):
+                    updated = alloc.copy()
+                    updated.client_status = consts.ALLOC_CLIENT_COMPLETE
+                    updated.task_states = {
+                        name: TaskState(state=consts.TASK_STATE_DEAD, failed=False)
+                        for name in task_names
+                    }
+                    updates.append(updated)
+            self._seen_index[alloc.id] = alloc.alloc_modify_index
+        if updates:
+            try:
+                self.server.node_update_allocs(updates)
+            except Exception:
+                pass

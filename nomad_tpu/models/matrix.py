@@ -102,17 +102,9 @@ class ClusterMatrix:
     # ------------------------------------------------------------------
 
     def _proposed_allocs(self, node_id: str) -> List[Allocation]:
-        existing = self.state.allocs_by_node_terminal(node_id, False)
-        if self.plan is None:
-            return existing
-        proposed = existing
-        updates = self.plan.node_update.get(node_id, [])
-        if updates:
-            proposed = remove_allocs(existing, updates)
-        by_id = {a.id: a for a in proposed}
-        for alloc in self.plan.node_allocation.get(node_id, []):
-            by_id[alloc.id] = alloc
-        return list(by_id.values())
+        from ..scheduler.util import proposed_allocs_for_node
+
+        return proposed_allocs_for_node(self.state, self.plan, node_id)
 
     def _build(self) -> None:
         n, g = self.n, self.g

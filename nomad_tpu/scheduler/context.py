@@ -107,14 +107,7 @@ class EvalContext:
 
     def proposed_allocs(self, node_id: str) -> List[Allocation]:
         """Allocations that would exist on the node if the current plan
-        commits: live allocs, minus planned evictions, plus planned
-        placements (in-place updates override by alloc id)."""
-        existing = self.state.allocs_by_node_terminal(node_id, False)
-        proposed = existing
-        updates = self.plan.node_update.get(node_id, [])
-        if updates:
-            proposed = remove_allocs(existing, updates)
-        by_id = {a.id: a for a in proposed}
-        for alloc in self.plan.node_allocation.get(node_id, []):
-            by_id[alloc.id] = alloc
-        return list(by_id.values())
+        commits (shared semantics in util.proposed_allocs_for_node)."""
+        from .util import proposed_allocs_for_node
+
+        return proposed_allocs_for_node(self.state, self.plan, node_id)

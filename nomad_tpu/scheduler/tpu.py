@@ -28,15 +28,11 @@ from ..structs import (
 )
 from ..utils.ids import generate_uuid
 from .generic import GenericScheduler
-from .util import AllocTuple, ready_nodes_in_dcs
+from .util import AllocTuple
 
 
 class BatchedTPUScheduler(GenericScheduler):
     """GenericScheduler whose bulk placement loop runs on the TPU."""
-
-    def __init__(self, logger, state, planner, batch: bool,
-                 rng: Optional[random.Random] = None):
-        super().__init__(logger, state, planner, batch=batch, rng=rng)
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         import jax
@@ -63,6 +59,16 @@ class BatchedTPUScheduler(GenericScheduler):
                 bulk.append(missing)
         if sticky:
             super()._compute_placements(sticky)
+        # A TG that already failed (e.g. in the sticky host path) only
+        # coalesces from here on — same invariant as the host loop
+        # (generic_sched.go:444-447).
+        remaining: List[AllocTuple] = []
+        for missing in bulk:
+            if self.failed_tg_allocs and missing.task_group.name in self.failed_tg_allocs:
+                self.failed_tg_allocs[missing.task_group.name].coalesced_failures += 1
+            else:
+                remaining.append(missing)
+        bulk = remaining
         if not bulk:
             return
 
@@ -92,6 +98,12 @@ class BatchedTPUScheduler(GenericScheduler):
         net_indexes: Dict[str, NetworkIndex] = {}
 
         for j, missing in enumerate(bulk):
+            # Coalesce once the TG has failed, even if the kernel found a
+            # node for a later ask of that TG (host-loop invariant).
+            if self.failed_tg_allocs and missing.task_group.name in self.failed_tg_allocs:
+                self.failed_tg_allocs[missing.task_group.name].coalesced_failures += 1
+                continue
+
             choice = int(choices[j])
             node = matrix.nodes[choice] if 0 <= choice < matrix.n_real else None
 
@@ -100,7 +112,9 @@ class BatchedTPUScheduler(GenericScheduler):
             metrics.nodes_available = matrix.nodes_by_dc
 
             if node is None:
-                self._record_placement_failure(missing, matrix, metrics)
+                self._record_placement_failure(
+                    missing, matrix, metrics, tg_indices
+                )
                 continue
 
             metrics.score_node(node, "binpack", float(scores[j]))
@@ -134,12 +148,11 @@ class BatchedTPUScheduler(GenericScheduler):
 
     # ------------------------------------------------------------------
 
-    def _record_placement_failure(self, missing: AllocTuple, matrix, metrics) -> None:
+    def _record_placement_failure(
+        self, missing: AllocTuple, matrix, metrics, tg_indices: Dict[str, int]
+    ) -> None:
         name = missing.task_group.name
-        if self.failed_tg_allocs and name in self.failed_tg_allocs:
-            self.failed_tg_allocs[name].coalesced_failures += 1
-            return
-        gi = {tg.name: i for i, tg in enumerate(self.job.task_groups)}[name]
+        gi = tg_indices[name]
         infeasible = int(matrix.n_real - matrix.feasible[: matrix.n_real, gi].sum())
         metrics.nodes_filtered = infeasible
         metrics.nodes_exhausted = matrix.n_real - infeasible
@@ -165,19 +178,17 @@ class BatchedTPUScheduler(GenericScheduler):
             net_indexes[node.id] = idx
 
         task_resources: Dict[str, Resources] = {}
-        staged: List[NetworkResource] = []
         for task in missing.task_group.tasks:
             resources = task.resources.copy()
             if resources.networks:
                 ask = resources.networks[0]
                 offer, err = idx.assign_network(ask, self.rng)
                 if offer is None:
-                    # Roll back this alloc's staged reservations? They were
-                    # added to idx; rebuild the index from scratch next time.
+                    # Drop the partially-updated index; it is rebuilt
+                    # from the plan on next use.
                     net_indexes.pop(node.id, None)
                     return None
                 idx.add_reserved(offer)
-                staged.append(offer)
                 resources.networks = [offer]
             task_resources[task.name] = resources
         return task_resources

@@ -66,6 +66,27 @@ class DiffResult:
         )
 
 
+def proposed_allocs_for_node(state, plan: Optional[Plan], node_id: str) -> List[Allocation]:
+    """Allocations that would exist on the node if the plan commits:
+    live allocs minus planned evictions plus planned placements,
+    placements overriding by alloc id (context.go:108 ProposedAllocs).
+    Shared by the eval context, the dense matrix builder, and the plan
+    applier's verification."""
+    from ..structs import remove_allocs
+
+    existing = state.allocs_by_node_terminal(node_id, False)
+    proposed = existing
+    if plan is not None:
+        updates = plan.node_update.get(node_id, [])
+        if updates:
+            proposed = remove_allocs(existing, updates)
+        by_id = {a.id: a for a in proposed}
+        for alloc in plan.node_allocation.get(node_id, []):
+            by_id[alloc.id] = alloc
+        proposed = list(by_id.values())
+    return proposed
+
+
 def materialize_task_groups(job: Optional[Job]) -> Dict[str, TaskGroup]:
     """Count-expand each task group to named slots '<job>.<tg>[<i>]'."""
     out: Dict[str, TaskGroup] = {}

@@ -1,0 +1,23 @@
+from .broker import EvalBroker
+from .blocked import BlockedEvals
+from .config import ServerConfig
+from .fsm import FSM, DevLog
+from .plan_apply import PlanApplier, evaluate_node_plan
+from .plan_queue import PlanQueue
+from .server import Server
+from .timetable import TimeTable
+from .worker import Worker
+
+__all__ = [
+    "EvalBroker",
+    "BlockedEvals",
+    "ServerConfig",
+    "FSM",
+    "DevLog",
+    "PlanApplier",
+    "evaluate_node_plan",
+    "PlanQueue",
+    "Server",
+    "TimeTable",
+    "Worker",
+]

@@ -1,0 +1,314 @@
+"""EvalBroker: leader-only, in-memory, at-least-once evaluation queue.
+
+Reference: nomad/eval_broker.go:43 — per-scheduler-type priority heaps,
+per-job serialization (a job is claimed at enqueue time; later evals
+wait in a per-job blocked heap until the outstanding one is Acked),
+unack tracking with Nack timers, a delivery limit routing poison evals
+to the `_failed` queue, and wait-time evals.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import Evaluation
+from ..utils.ids import generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+
+class _Heap:
+    """Max-priority, FIFO-within-priority eval heap."""
+
+    def __init__(self):
+        self._items: List[Tuple[int, int, Evaluation]] = []
+        self._counter = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._items, (-ev.priority, next(self._counter), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._items:
+            return None
+        return heapq.heappop(self._items)[2]
+
+    def peek_priority(self) -> Optional[int]:
+        if not self._items:
+            return None
+        return -self._items[0][0]
+
+    def __len__(self):
+        return len(self._items)
+
+    def evals(self) -> List[Evaluation]:
+        return [item[2] for item in self._items]
+
+
+class _Unack:
+    __slots__ = ("eval", "token", "timer", "nack_timer_paused")
+
+    def __init__(self, ev: Evaluation, token: str, timer):
+        self.eval = ev
+        self.token = token
+        self.timer = timer
+        self.nack_timer_paused = False
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+
+        self._evals: Dict[str, int] = {}  # known eval id -> dequeue count
+        self._ready: Dict[str, _Heap] = {}  # by scheduler type
+        self._unack: Dict[str, _Unack] = {}
+        self._job_evals: Dict[str, str] = {}  # job claim: job_id -> eval id
+        self._blocked: Dict[str, _Heap] = {}  # per-job wait heaps
+        self._wait_timers: Dict[str, threading.Timer] = {}
+        # Evals the scheduler re-submitted (reblock) while outstanding;
+        # processed on Ack (eval_broker.go:171-182 requeue).
+        self._requeue: Dict[str, Evaluation] = {}
+
+    # ------------------------------------------------------------------
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            for unack in self._unack.values():
+                unack.timer.cancel()
+            for timer in self._wait_timers.values():
+                timer.cancel()
+            self._evals.clear()
+            self._ready.clear()
+            self._unack.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._wait_timers.clear()
+            self._requeue.clear()
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+
+    def enqueue(self, ev: Evaluation, token: str = "") -> None:
+        with self._lock:
+            self._process_enqueue(ev, token)
+
+    def enqueue_all(self, evals: List[Evaluation]) -> None:
+        # One critical section so unblocking dequeuers see the full,
+        # highest-priority-first picture (eval_broker.go:155-163).
+        with self._lock:
+            for ev in evals:
+                self._process_enqueue(ev, "")
+
+    def _process_enqueue(self, ev: Evaluation, token: str) -> None:
+        if ev.id in self._evals:
+            if not token:
+                return
+            # Reblocked by its scheduler while outstanding: run again
+            # after the Ack.
+            unack = self._unack.get(ev.id)
+            if unack is not None and unack.token == token:
+                self._requeue[token] = ev
+            return
+        if self._enabled:
+            self._evals[ev.id] = 0
+        if ev.wait and ev.wait > 0:
+            timer = threading.Timer(ev.wait, self._wait_done, args=(ev,))
+            timer.daemon = True
+            self._wait_timers[ev.id] = timer
+            timer.start()
+            return
+        self._enqueue_locked(ev, ev.type)
+
+    def _wait_done(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._wait_timers.pop(ev.id, None)
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        # Per-job serialization: the job is claimed by the first eval;
+        # later ones wait in the per-job blocked heap until Ack.
+        claimed = self._job_evals.get(ev.job_id, "")
+        if not claimed:
+            self._job_evals[ev.job_id] = ev.id
+        elif claimed != ev.id:
+            self._blocked.setdefault(ev.job_id, _Heap()).push(ev)
+            return
+        self._ready.setdefault(queue, _Heap()).push(ev)
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+
+    def dequeue(
+        self, schedulers: List[str], timeout: Optional[float] = None
+    ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval for any of
+        the given scheduler types. Returns (eval, token) or (None, "")."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return None, ""
+                ev = self._scan_for_schedulers(schedulers)
+                if ev is not None:
+                    return self._dequeue_locked(ev)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def _scan_for_schedulers(self, schedulers: List[str]) -> Optional[Evaluation]:
+        best_queue = None
+        best_priority = -1
+        for sched in schedulers:
+            heap = self._ready.get(sched)
+            if heap is None:
+                continue
+            prio = heap.peek_priority()
+            if prio is not None and prio > best_priority:
+                best_priority = prio
+                best_queue = sched
+        if best_queue is None:
+            return None
+        return self._ready[best_queue].pop()
+
+    def _dequeue_locked(self, ev: Evaluation) -> Tuple[Evaluation, str]:
+        token = generate_uuid()
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        timer = threading.Timer(self.nack_timeout, self._nack_timeout, args=(ev.id, token))
+        timer.daemon = True
+        self._unack[ev.id] = _Unack(ev, token, timer)
+        timer.start()
+        return ev, token
+
+    def _nack_timeout(self, eval_id: str, token: str) -> None:
+        """Nack timer fired: the worker died or stalled; redeliver."""
+        try:
+            self.nack(eval_id, token)
+        except ValueError:
+            pass  # already acked/nacked
+
+    # ------------------------------------------------------------------
+
+    def _check_token(self, eval_id: str, token: str) -> _Unack:
+        unack = self._unack.get(eval_id)
+        if unack is None or unack.token != token:
+            raise ValueError(f"token does not match for eval {eval_id!r}")
+        return unack
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            return unack.token if unack else None
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            unack = self._check_token(eval_id, token)
+            unack.timer.cancel()
+            del self._unack[eval_id]
+            self._evals.pop(eval_id, None)
+            job_id = unack.eval.job_id
+            if self._job_evals.get(job_id) == eval_id:
+                del self._job_evals[job_id]
+            # Promote the next blocked eval for this job.
+            blocked = self._blocked.get(job_id)
+            if blocked:
+                nxt = blocked.pop()
+                if not len(blocked):
+                    del self._blocked[job_id]
+                if nxt is not None:
+                    self._enqueue_locked(nxt, nxt.type)
+            # Process a reblock submitted while this eval was outstanding.
+            requeued = self._requeue.pop(token, None)
+            if requeued is not None:
+                self._process_enqueue(requeued, "")
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            unack = self._check_token(eval_id, token)
+            unack.timer.cancel()
+            del self._unack[eval_id]
+            self._requeue.pop(token, None)
+            ev = unack.eval
+            # The job claim stays with this eval; redeliver it, or park
+            # it on the failed queue past the delivery limit.
+            if self._evals.get(ev.id, 0) >= self.delivery_limit:
+                self._enqueue_locked(ev, FAILED_QUEUE)
+            else:
+                self._enqueue_locked(ev, ev.type)
+
+    def pause_nack_timeout(self, eval_id: str, token: str) -> None:
+        """Stop the redelivery clock while the plan sits in the plan
+        queue (plan_endpoint.go:16)."""
+        with self._lock:
+            unack = self._check_token(eval_id, token)
+            unack.timer.cancel()
+            unack.nack_timer_paused = True
+
+    def resume_nack_timeout(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            unack = self._check_token(eval_id, token)
+            if unack.nack_timer_paused:
+                timer = threading.Timer(
+                    self.nack_timeout, self._nack_timeout, args=(eval_id, token)
+                )
+                timer.daemon = True
+                unack.timer = timer
+                unack.nack_timer_paused = False
+                timer.start()
+
+    # ------------------------------------------------------------------
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(
+                len(h) for q, h in self._ready.items() if q != FAILED_QUEUE
+            )
+
+    def unacked_count(self) -> int:
+        with self._lock:
+            return len(self._unack)
+
+    def blocked_count(self) -> int:
+        with self._lock:
+            return sum(len(h) for h in self._blocked.values())
+
+    def waiting_count(self) -> int:
+        with self._lock:
+            return len(self._wait_timers)
+
+    def failed_evals(self) -> List[Evaluation]:
+        """Evals past the delivery limit (reaped by the leader,
+        leader.go:369)."""
+        with self._lock:
+            heap = self._ready.get(FAILED_QUEUE)
+            return heap.evals() if heap else []
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "total_ready": self.ready_count(),
+            "total_unacked": self.unacked_count(),
+            "total_blocked": self.blocked_count(),
+            "total_waiting": self.waiting_count(),
+        }

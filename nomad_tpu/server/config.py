@@ -1,0 +1,55 @@
+"""Server configuration.
+
+Reference: nomad/config.go (defaults at :225-238) and
+command/agent/agent.go:129 (num_schedulers overlay).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ServerConfig:
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = ""
+    bootstrap_expect: int = 1
+
+    # Scheduling workers (reference default 1; the agent sets NumCPU).
+    num_schedulers: int = 1
+    # Which scheduler types this server's workers service.
+    enabled_schedulers: List[str] = field(
+        default_factory=lambda: ["service", "batch", "system", "_core"]
+    )
+    # Per-type factory overrides, e.g. {"service": "service-tpu"} routes
+    # service evals to the TPU placement backend (BASELINE north star:
+    # new factories, unchanged control plane).
+    scheduler_factories: Dict[str, str] = field(default_factory=dict)
+
+    # Eval broker (config.go:233-234)
+    eval_nack_timeout: float = 60.0
+    eval_delivery_limit: int = 3
+
+    # Heartbeats (config.go:235-238)
+    min_heartbeat_ttl: float = 10.0
+    max_heartbeats_per_second: float = 50.0
+    heartbeat_grace: float = 10.0
+
+    # GC (config.go:227-232)
+    eval_gc_interval: float = 300.0
+    eval_gc_threshold: float = 3600.0
+    job_gc_interval: float = 300.0
+    job_gc_threshold: float = 4 * 3600.0
+    node_gc_interval: float = 300.0
+    node_gc_threshold: float = 24 * 3600.0
+
+    # Plan verification pool size (plan_apply.go:48: NumCPU/2).
+    plan_verify_workers: int = 2
+
+    # Blocked-evals failed-eval unblock cadence (leader.go:441).
+    failed_eval_unblock_interval: float = 60.0
+
+    def factory_for(self, eval_type: str) -> str:
+        return self.scheduler_factories.get(eval_type, eval_type)

@@ -1,0 +1,202 @@
+"""FSM: applies replicated log entries to the state store, with
+leader-side hooks into the broker / blocked-evals / periodic services.
+
+Reference: nomad/fsm.go:44 (nomadFSM), :102 (Apply switch over the
+message types of structs.go:40-56), :506/:520 (Snapshot/Restore).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..state import PeriodicLaunch, StateStore
+from ..structs import Allocation, Evaluation, Job, Node, consts
+from .timetable import TimeTable
+
+# Log message types (structs.go:40-53)
+NODE_REGISTER = "node_register"
+NODE_DEREGISTER = "node_deregister"
+NODE_UPDATE_STATUS = "node_update_status"
+NODE_UPDATE_DRAIN = "node_update_drain"
+JOB_REGISTER = "job_register"
+JOB_DEREGISTER = "job_deregister"
+EVAL_UPDATE = "eval_update"
+EVAL_DELETE = "eval_delete"
+ALLOC_UPDATE = "alloc_update"
+ALLOC_CLIENT_UPDATE = "alloc_client_update"
+PERIODIC_LAUNCH = "periodic_launch"
+PERIODIC_LAUNCH_DELETE = "periodic_launch_delete"
+
+
+class FSM:
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("nomad_tpu.fsm")
+        self.state = StateStore()
+        self.timetable = TimeTable()
+        # Leader-only services, attached while this server is leader
+        # (fsm.go enqueues into the broker only on the leader).
+        self.broker = None
+        self.blocked_evals = None
+        self.periodic = None
+        self._handlers: Dict[str, Callable] = {
+            NODE_REGISTER: self._apply_node_register,
+            NODE_DEREGISTER: self._apply_node_deregister,
+            NODE_UPDATE_STATUS: self._apply_node_status,
+            NODE_UPDATE_DRAIN: self._apply_node_drain,
+            JOB_REGISTER: self._apply_job_register,
+            JOB_DEREGISTER: self._apply_job_deregister,
+            EVAL_UPDATE: self._apply_eval_update,
+            EVAL_DELETE: self._apply_eval_delete,
+            ALLOC_UPDATE: self._apply_alloc_update,
+            ALLOC_CLIENT_UPDATE: self._apply_alloc_client_update,
+            PERIODIC_LAUNCH: self._apply_periodic_launch,
+            PERIODIC_LAUNCH_DELETE: self._apply_periodic_launch_delete,
+        }
+
+    def apply(self, index: int, msg_type: str, payload: dict) -> object:
+        self.timetable.witness(index)
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise ValueError(f"unknown log message type {msg_type!r}")
+        return handler(index, payload)
+
+    # ------------------------------------------------------------ nodes
+
+    def _apply_node_register(self, index: int, payload: dict):
+        node: Node = payload["node"]
+        self.state.upsert_node(index, node)
+        # New capacity may unblock waiting evals.
+        if self.blocked_evals is not None and node.status == consts.NODE_STATUS_READY:
+            stored = self.state.node_by_id(node.id)
+            self.blocked_evals.unblock(stored.computed_class, index)
+        return None
+
+    def _apply_node_deregister(self, index: int, payload: dict):
+        self.state.delete_node(index, payload["node_id"])
+        return None
+
+    def _apply_node_status(self, index: int, payload: dict):
+        node_id, status = payload["node_id"], payload["status"]
+        self.state.update_node_status(index, node_id, status)
+        if self.blocked_evals is not None and status == consts.NODE_STATUS_READY:
+            node = self.state.node_by_id(node_id)
+            if node is not None:
+                self.blocked_evals.unblock(node.computed_class, index)
+        return None
+
+    def _apply_node_drain(self, index: int, payload: dict):
+        self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+        return None
+
+    # ------------------------------------------------------------- jobs
+
+    def _apply_job_register(self, index: int, payload: dict):
+        job: Job = payload["job"]
+        self.state.upsert_job(index, job)
+        if self.periodic is not None and job.is_periodic():
+            self.periodic.add(self.state.job_by_id(job.id))
+        return None
+
+    def _apply_job_deregister(self, index: int, payload: dict):
+        job_id = payload["job_id"]
+        self.state.delete_job(index, job_id)
+        if self.periodic is not None:
+            self.periodic.remove(job_id)
+            self.state.delete_periodic_launch(index, job_id)
+        if self.blocked_evals is not None:
+            self.blocked_evals.untrack(job_id)
+        return None
+
+    # ------------------------------------------------------------ evals
+
+    def _apply_eval_update(self, index: int, payload: dict):
+        evals: List[Evaluation] = payload["evals"]
+        self.state.upsert_evals(index, evals)
+        if self.broker is None:
+            return None
+        for ev in evals:
+            if ev.should_enqueue():
+                self.broker.enqueue(ev, payload.get("token", ""))
+            elif ev.should_block() and self.blocked_evals is not None:
+                stored = self.state.eval_by_id(ev.id)
+                self.blocked_evals.block(stored)
+        return None
+
+    def _apply_eval_delete(self, index: int, payload: dict):
+        self.state.delete_evals(index, payload["eval_ids"], payload["alloc_ids"])
+        return None
+
+    # ----------------------------------------------------------- allocs
+
+    def _apply_alloc_update(self, index: int, payload: dict):
+        allocs: List[Allocation] = payload["allocs"]
+        job = payload.get("job")
+        for alloc in allocs:
+            if alloc.job is None and job is not None:
+                alloc.job = job
+        self.state.upsert_allocs(index, allocs)
+        return None
+
+    def _apply_alloc_client_update(self, index: int, payload: dict):
+        allocs: List[Allocation] = payload["allocs"]
+        self.state.update_allocs_from_client(index, allocs)
+        # A terminal client status frees capacity: unblock by the node's
+        # computed class (fsm.go applyAllocClientUpdate -> Unblock).
+        if self.blocked_evals is not None:
+            for alloc in allocs:
+                if alloc.client_status in (
+                    consts.ALLOC_CLIENT_COMPLETE,
+                    consts.ALLOC_CLIENT_FAILED,
+                ):
+                    node = self.state.node_by_id(alloc.node_id)
+                    if node is not None:
+                        self.blocked_evals.unblock(node.computed_class, index)
+        return None
+
+    # --------------------------------------------------------- periodic
+
+    def _apply_periodic_launch(self, index: int, payload: dict):
+        self.state.upsert_periodic_launch(
+            index, PeriodicLaunch(id=payload["job_id"], launch=payload["launch"])
+        )
+        return None
+
+    def _apply_periodic_launch_delete(self, index: int, payload: dict):
+        self.state.delete_periodic_launch(index, payload["job_id"])
+        return None
+
+    # --------------------------------------------------------- snapshot
+
+    def snapshot_data(self) -> dict:
+        return self.state.persist()
+
+    def restore(self, data: dict) -> None:
+        self.state = StateStore.restore(data)
+
+
+class DevLog:
+    """Single-node, in-memory replicated-log stand-in: applies entries
+    synchronously to the local FSM (the reference's dev mode uses
+    raft.InmemStore with a single peer, server.go:657-663). The raft
+    implementation (stage 5) replaces this behind the same interface."""
+
+    def __init__(self, fsm: FSM):
+        self.fsm = fsm
+        self._lock = threading.Lock()
+        self._index = 0
+
+    def apply(self, msg_type: str, payload: dict) -> int:
+        with self._lock:
+            self._index += 1
+            index = self._index
+        self.fsm.apply(index, msg_type, payload)
+        return index
+
+    def last_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def barrier(self) -> int:
+        return self.last_index()

@@ -1,0 +1,140 @@
+"""Plan applier: the leader's serialization point for optimistic
+concurrency.
+
+Reference: nomad/plan_apply.go:41 — a long-lived leader loop that
+dequeues plans by priority, verifies each node's placements against the
+latest state (fanned out over a worker pool, plan_apply_pool.go:18),
+partially commits what fits, and hands workers a RefreshIndex when
+their snapshot went stale. Pipelining: plan N+1 is evaluated against an
+optimistic snapshot while plan N's commit is in flight
+(plan_apply.go:19-39).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import Allocation, Plan, PlanResult, allocs_fit, consts, remove_allocs
+from .fsm import ALLOC_UPDATE
+from .plan_queue import PendingPlan, PlanQueue
+
+
+def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> bool:
+    """Whether the plan's changes to one node can be applied against the
+    given state (plan_apply.go:318 evaluateNodePlan)."""
+    if not plan.node_allocation.get(node_id):
+        return True  # evictions only: always safe
+
+    node = snapshot.node_by_id(node_id)
+    if node is None:
+        return False
+    if node.status != consts.NODE_STATUS_READY or node.drain:
+        return False
+
+    from ..scheduler.util import proposed_allocs_for_node
+
+    proposed = proposed_allocs_for_node(snapshot, plan, node_id)
+    fit, _, _ = allocs_fit(node, proposed)
+    return fit
+
+
+class PlanApplier:
+    """Consumes the plan queue; runs as a leader-only thread."""
+
+    def __init__(self, plan_queue: PlanQueue, fsm, log, pool_size: int = 2,
+                 logger: Optional[logging.Logger] = None):
+        self.plan_queue = plan_queue
+        self.fsm = fsm
+        self.log = log
+        self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
+        self.pool = ThreadPoolExecutor(
+            max_workers=max(pool_size, 1), thread_name_prefix="plan-eval"
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="plan-applier", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.plan_queue.dequeue(timeout=0.25)
+            if pending is None:
+                continue
+            try:
+                result = self._apply_one(pending.plan)
+                pending.respond(result, None)
+            except Exception as e:  # noqa: BLE001 - fail the one plan
+                self.logger.exception("plan apply failed")
+                pending.respond(None, e)
+
+    # ------------------------------------------------------------------
+
+    def _apply_one(self, plan: Plan) -> PlanResult:
+        snapshot = self.fsm.state.snapshot()
+        result = self._evaluate_plan(snapshot, plan)
+        if result.is_no_op():
+            return result
+        alloc_index = self._commit(plan, result)
+        result.alloc_index = alloc_index
+        return result
+
+    def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
+        """Per-node verification with partial commit
+        (plan_apply.go:194 evaluatePlan)."""
+        result = PlanResult(
+            node_update=dict(plan.node_update),
+            node_allocation=dict(plan.node_allocation),
+        )
+
+        node_ids = set(plan.node_update) | set(plan.node_allocation)
+        futures = {
+            node_id: self.pool.submit(evaluate_node_plan, snapshot, plan, node_id)
+            for node_id in node_ids
+        }
+        for node_id, fut in futures.items():
+            if fut.result():
+                continue
+            # This node's changes don't fit anymore.
+            if plan.all_at_once:
+                # Gang commit: reject everything, force a refresh.
+                result.node_update = {}
+                result.node_allocation = {}
+                result.refresh_index = snapshot.latest_index()
+                return result
+            result.node_update.pop(node_id, None)
+            result.node_allocation.pop(node_id, None)
+            result.refresh_index = snapshot.latest_index()
+        return result
+
+    def _commit(self, plan: Plan, result: PlanResult) -> int:
+        allocs: List[Allocation] = []
+        for update_list in result.node_update.values():
+            allocs.extend(update_list)
+        for alloc_list in result.node_allocation.values():
+            allocs.extend(alloc_list)
+        index = self.log.apply(
+            ALLOC_UPDATE, {"allocs": allocs, "job": plan.job}
+        )
+        # Stamp indexes onto the result's alloc objects the way the Go
+        # store mutates shared pointers — workers count fresh placements
+        # by create_index == alloc_index (scheduler/util.py).
+        for alloc_list in result.node_allocation.values():
+            for alloc in alloc_list:
+                stored = self.fsm.state.alloc_by_id(alloc.id)
+                if stored is not None:
+                    alloc.create_index = stored.create_index
+                    alloc.modify_index = stored.modify_index
+        return index

@@ -1,0 +1,446 @@
+"""Server: composition of the control plane + RPC-endpoint methods.
+
+Reference: nomad/server.go:69 (Server, NewServer:169), leader-only
+services (leader.go:108 establishLeadership), and the RPC endpoints
+(job_endpoint.go, node_endpoint.go, eval_endpoint.go, plan_endpoint.go,
+alloc_endpoint.go). In dev mode a single in-process server is its own
+leader over a DevLog; the raft log replaces DevLog behind the same
+apply() interface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..scheduler import register_scheduler
+from ..structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+    consts,
+    new_eval,
+)
+from ..utils.ids import generate_uuid
+from . import fsm as fsm_msgs
+from .blocked import BlockedEvals
+from .broker import EvalBroker
+from .config import ServerConfig
+from .core_gc import CoreScheduler
+from .fsm import FSM, DevLog
+from .heartbeat import HeartbeatTimers
+from .periodic import PeriodicDispatch
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .worker import Worker
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.logger = logging.getLogger("nomad_tpu.server")
+
+        self.fsm = FSM()
+        self.log = DevLog(self.fsm)
+        self.broker = EvalBroker(
+            self.config.eval_nack_timeout, self.config.eval_delivery_limit
+        )
+        self.blocked_evals = BlockedEvals(self.broker.enqueue_all)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(
+            self.plan_queue, self.fsm, self.log,
+            pool_size=self.config.plan_verify_workers,
+        )
+        self.heartbeats = HeartbeatTimers(self)
+        self.periodic = PeriodicDispatch(self)
+        self.workers: List[Worker] = []
+        self._leader = False
+        self._shutdown = False
+        self._gc_threads: List[threading.Timer] = []
+
+        self._register_core_scheduler()
+
+    def _register_core_scheduler(self) -> None:
+        server = self
+
+        def factory(logger, state, planner, rng=None):
+            return CoreScheduler(logger, state, planner, rng=rng, server=server)
+
+        register_scheduler("_core", factory)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Dev mode: single server, immediately leader."""
+        for i in range(self.config.num_schedulers):
+            worker = Worker(self, i)
+            self.workers.append(worker)
+            worker.start()
+        self.establish_leadership()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self.revoke_leadership()
+        for w in self.workers:
+            w.stop()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def establish_leadership(self) -> None:
+        """Enable leader-only services and restore their state
+        (leader.go:108)."""
+        self._leader = True
+        self.plan_queue.set_enabled(True)
+        self.plan_applier.start()
+        self.broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.fsm.broker = self.broker
+        self.fsm.blocked_evals = self.blocked_evals
+        self.fsm.periodic = self.periodic
+        self.periodic.set_enabled(True)
+        self.heartbeats.set_enabled(True)
+        self.heartbeats.initialize()
+        self._restore_evals()
+        self._restore_periodic()
+        self._schedule_gc()
+        # Pause 3/4 of the workers on the leader (leader.go:111-117).
+        if len(self.workers) > 1:
+            for w in self.workers[: len(self.workers) * 3 // 4]:
+                w.set_pause(True)
+
+    def revoke_leadership(self) -> None:
+        self._leader = False
+        for timer in self._gc_threads:
+            timer.cancel()
+        self._gc_threads = []
+        self.fsm.broker = None
+        self.fsm.blocked_evals = None
+        self.fsm.periodic = None
+        self.broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_applier.stop()
+        self.plan_queue.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.heartbeats.set_enabled(False)
+        for w in self.workers:
+            w.set_pause(False)
+
+    def _restore_evals(self) -> None:
+        """Re-seed broker/blocked-evals from state on failover
+        (leader.go:192 restoreEvals)."""
+        for ev in self.fsm.state.evals():
+            if ev.should_enqueue():
+                self.broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _restore_periodic(self) -> None:
+        for job in self.fsm.state.jobs_by_periodic(True):
+            self.periodic.add(job)
+
+    # ------------------------------------------------------------ jobs
+
+    def job_register(
+        self, job: Job, triggered_by: str = consts.EVAL_TRIGGER_JOB_REGISTER
+    ) -> Tuple[str, int]:
+        """Job.Register (job_endpoint.go:41): validate, commit the job,
+        then commit its evaluation (periodic parents get no eval)."""
+        job.canonicalize()
+        errors = job.validate()
+        if errors:
+            raise ValueError("; ".join(errors))
+        index = self.log.apply(fsm_msgs.JOB_REGISTER, {"job": job})
+
+        if job.is_periodic():
+            return "", index
+
+        stored = self.fsm.state.job_by_id(job.id)
+        ev = new_eval(stored, triggered_by)
+        self.eval_update([ev])
+        return ev.id, index
+
+    def job_deregister(self, job_id: str, create_eval: bool = True) -> Optional[str]:
+        job = self.fsm.state.job_by_id(job_id)
+        self.log.apply(fsm_msgs.JOB_DEREGISTER, {"job_id": job_id})
+        if not create_eval or job is None or job.is_periodic():
+            return None
+        ev = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority,
+            type=job.type,
+            triggered_by=consts.EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            job_modify_index=job.job_modify_index,
+            status=consts.EVAL_STATUS_PENDING,
+        )
+        self.eval_update([ev])
+        return ev.id
+
+    def job_evaluate(self, job_id: str) -> str:
+        """Job.Evaluate: force a new evaluation (job_endpoint.go:236)."""
+        job = self.fsm.state.job_by_id(job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if job.is_periodic():
+            raise ValueError("can't evaluate periodic job")
+        ev = new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER)
+        self.eval_update([ev])
+        return ev.id
+
+    def job_plan(self, job: Job, diff: bool = False) -> dict:
+        """Job.Plan dry-run (job_endpoint.go:545): run a real scheduler
+        against a snapshot through the Harness; nothing commits."""
+        from ..scheduler.testing import Harness
+
+        job.canonicalize()
+        errors = job.validate()
+        if errors:
+            raise ValueError("; ".join(errors))
+
+        # Shadow copy of state with the updated job injected at index+1;
+        # the real store is never written (job_endpoint.go:584).
+        from ..state import StateStore
+
+        snap_store = self.fsm.state
+        shadow_store = StateStore.restore(snap_store.persist())
+        shadow_store.upsert_job(snap_store.latest_index() + 1, job)
+        harness = Harness(state=shadow_store)
+        harness._next_index = shadow_store.latest_index() + 1
+
+        ev = new_eval(shadow_store.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER)
+        ev.annotate_plan = True
+
+        factory = self.config.factory_for(job.type)
+        from ..scheduler import new_scheduler
+
+        sched = new_scheduler(factory, self.logger, shadow_store.snapshot(), harness)
+        sched.process_eval(ev)
+
+        annotations = None
+        failed = {}
+        if harness.plans:
+            plan = harness.plans[-1]
+            if plan.annotations is not None:
+                annotations = plan.annotations
+            failed = plan.failed_tg_allocs
+        if harness.evals:
+            failed = harness.evals[-1].failed_tg_allocs or failed
+        return {
+            "annotations": annotations,
+            "failed_tg_allocs": failed,
+            "next_periodic_launch": (
+                job.periodic.next_launch(time.time()) if job.is_periodic() else None
+            ),
+            "index": snap_store.latest_index(),
+        }
+
+    # ----------------------------------------------------------- nodes
+
+    def node_register(self, node: Node) -> float:
+        """Node.Register (node_endpoint.go:51). Returns the heartbeat
+        TTL granted."""
+        if not node.id:
+            raise ValueError("missing node ID")
+        if not node.datacenter:
+            raise ValueError("missing datacenter")
+        if not node.secret_id:
+            node.secret_id = generate_uuid()
+        existing = self.fsm.state.node_by_id(node.id)
+        self.log.apply(fsm_msgs.NODE_REGISTER, {"node": node})
+        # Transitioning to ready re-schedules its jobs.
+        if existing is not None and existing.status != node.status:
+            self._create_node_evals(node.id)
+        return self.heartbeats.reset_timer(node.id)
+
+    def node_deregister(self, node_id: str) -> None:
+        self.log.apply(fsm_msgs.NODE_DEREGISTER, {"node_id": node_id})
+        self.heartbeats.clear_timer(node_id)
+
+    def node_update_status(self, node_id: str, status: str) -> float:
+        """Node.UpdateStatus (node_endpoint.go:272): commit the status,
+        fan out evals for every affected job."""
+        node = self.fsm.state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"node {node_id!r} not found")
+        if node.status != status:
+            self.log.apply(
+                fsm_msgs.NODE_UPDATE_STATUS,
+                {"node_id": node_id, "status": status},
+            )
+            self._create_node_evals(node_id)
+        if status == consts.NODE_STATUS_DOWN:
+            self.heartbeats.clear_timer(node_id)
+            return 0.0
+        return self.heartbeats.reset_timer(node_id)
+
+    def node_heartbeat(self, node_id: str, secret_id: str = "") -> float:
+        node = self.fsm.state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"node {node_id!r} not found")
+        if secret_id and node.secret_id != secret_id:
+            raise PermissionError("node secret ID does not match")
+        if node.status != consts.NODE_STATUS_READY:
+            return self.node_update_status(node_id, consts.NODE_STATUS_READY)
+        return self.heartbeats.reset_timer(node_id)
+
+    def node_update_drain(self, node_id: str, drain: bool) -> None:
+        """Node.UpdateDrain (node_endpoint.go:374)."""
+        node = self.fsm.state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"node {node_id!r} not found")
+        self.log.apply(
+            fsm_msgs.NODE_UPDATE_DRAIN, {"node_id": node_id, "drain": drain}
+        )
+        if drain:
+            self._create_node_evals(node_id)
+
+    def node_update_allocs(self, allocs: List[Allocation]) -> int:
+        """Node.UpdateAlloc: client-reported status sync
+        (node_endpoint.go:664)."""
+        return self.log.apply(fsm_msgs.ALLOC_CLIENT_UPDATE, {"allocs": allocs})
+
+    def _create_node_evals(self, node_id: str) -> List[str]:
+        """One eval per job with allocs on the node, plus every system
+        job (node_endpoint.go:812 createNodeEvals)."""
+        node = self.fsm.state.node_by_id(node_id)
+        node_index = node.modify_index if node else 0
+        evals: List[Evaluation] = []
+        seen_jobs = set()
+        for alloc in self.fsm.state.allocs_by_node(node_id):
+            if alloc.job_id in seen_jobs or alloc.job is None:
+                continue
+            seen_jobs.add(alloc.job_id)
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    priority=alloc.job.priority,
+                    type=alloc.job.type,
+                    triggered_by=consts.EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=alloc.job_id,
+                    job_modify_index=alloc.job.job_modify_index,
+                    node_id=node_id,
+                    node_modify_index=node_index,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        for job in self.fsm.state.jobs_by_scheduler(consts.JOB_TYPE_SYSTEM):
+            if job.id in seen_jobs:
+                continue
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=consts.EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=job.id,
+                    job_modify_index=job.job_modify_index,
+                    node_id=node_id,
+                    node_modify_index=node_index,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        if evals:
+            self.eval_update(evals)
+        return [e.id for e in evals]
+
+    # ----------------------------------------------------------- evals
+
+    def eval_update(self, evals: List[Evaluation], token: str = "") -> int:
+        return self.log.apply(
+            fsm_msgs.EVAL_UPDATE, {"evals": evals, "token": token}
+        )
+
+    def eval_dequeue(
+        self, schedulers: List[str], timeout: float
+    ) -> Tuple[Optional[Evaluation], str]:
+        return self.broker.dequeue(schedulers, timeout)
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        self.broker.ack(eval_id, token)
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        self.broker.nack(eval_id, token)
+
+    def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
+        return self.log.apply(
+            fsm_msgs.EVAL_DELETE, {"eval_ids": eval_ids, "alloc_ids": alloc_ids}
+        )
+
+    # ------------------------------------------------------------ plans
+
+    def plan_submit(self, plan: Plan) -> PlanResult:
+        """Plan.Submit (plan_endpoint.go:16). The eval token is the
+        split-brain guard: it must still be the outstanding token."""
+        token = self.broker.outstanding(plan.eval_id)
+        if token != plan.eval_token:
+            raise ValueError("plan's eval token does not match outstanding eval")
+        pending = self.plan_queue.enqueue(plan)
+        return pending.wait(timeout=30.0)
+
+    # --------------------------------------------------------- periodic
+
+    def periodic_launch_record(self, job_id: str, launch: float) -> None:
+        self.log.apply(
+            fsm_msgs.PERIODIC_LAUNCH, {"job_id": job_id, "launch": launch}
+        )
+
+    def periodic_force(self, job_id: str) -> Optional[str]:
+        return self.periodic.force_run(job_id)
+
+    # --------------------------------------------------------------- gc
+
+    def _core_eval(self, core_job_id: str) -> Evaluation:
+        return Evaluation(
+            id=generate_uuid(),
+            priority=consts.CORE_JOB_PRIORITY,
+            type=consts.JOB_TYPE_CORE,
+            triggered_by=consts.EVAL_TRIGGER_SCHEDULED,
+            job_id=core_job_id,
+            status=consts.EVAL_STATUS_PENDING,
+        )
+
+    def force_gc(self) -> None:
+        """System.GC endpoint (system_endpoint.go:16)."""
+        self.broker.enqueue(self._core_eval(consts.CORE_JOB_FORCE_GC))
+
+    def _schedule_gc(self) -> None:
+        """Leader GC timers enqueue core-job evals on their intervals
+        (leader.go schedulePeriodic)."""
+
+        def tick(core_job: str, interval: float):
+            if not self._leader or self._shutdown:
+                return
+            self.broker.enqueue(self._core_eval(core_job))
+            timer = threading.Timer(interval, tick, args=(core_job, interval))
+            timer.daemon = True
+            self._gc_threads.append(timer)
+            timer.start()
+
+        for core_job, interval in (
+            (consts.CORE_JOB_EVAL_GC, self.config.eval_gc_interval),
+            (consts.CORE_JOB_JOB_GC, self.config.job_gc_interval),
+            (consts.CORE_JOB_NODE_GC, self.config.node_gc_interval),
+        ):
+            timer = threading.Timer(interval, tick, args=(core_job, interval))
+            timer.daemon = True
+            self._gc_threads.append(timer)
+            timer.start()
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "leader": self._leader,
+            "last_index": self.log.last_index(),
+            "broker": self.broker.stats(),
+            "blocked_evals": self.blocked_evals.stats(),
+            "plan_queue_depth": self.plan_queue.depth(),
+            "heartbeat_timers": self.heartbeats.count(),
+            "num_workers": len(self.workers),
+        }

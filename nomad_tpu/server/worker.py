@@ -1,0 +1,149 @@
+"""Scheduling worker: dequeue -> wait-for-index -> invoke scheduler ->
+submit plan -> ack.
+
+Reference: nomad/worker.go:50 — the worker implements the scheduler's
+Planner interface (worker.go:285-483): plans go through the leader's
+plan queue; a RefreshIndex response makes the worker catch its local
+state up and hand the scheduler a fresh snapshot.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..scheduler import new_scheduler
+from ..structs import Evaluation, Plan, PlanResult, consts
+
+DEQUEUE_TIMEOUT = 0.5
+BACKOFF_BASE = 0.02
+BACKOFF_LIMIT = 2.0
+
+
+class Worker:
+    def __init__(self, server, worker_id: int):
+        self.server = server
+        self.id = worker_id
+        self.logger = logging.getLogger(f"nomad_tpu.worker.{worker_id}")
+        self._stop = threading.Event()
+        self._paused = False
+        self._pause_lock = threading.Lock()
+        self._pause_cond = threading.Condition(self._pause_lock)
+        self._thread: Optional[threading.Thread] = None
+        # Current eval context for the Planner interface
+        self._eval: Optional[Evaluation] = None
+        self._token: str = ""
+        self.rng = random.Random()
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name=f"worker-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.set_pause(False)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def set_pause(self, paused: bool) -> None:
+        """Leader parks 3/4 of its workers to give CPU to the plan
+        applier (leader.go:108-117, worker.go:82-98)."""
+        with self._pause_lock:
+            self._paused = paused
+            self._pause_cond.notify_all()
+
+    def _check_paused(self) -> None:
+        with self._pause_lock:
+            while self._paused and not self._stop.is_set():
+                self._pause_cond.wait(0.5)
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._check_paused()
+            ev, token = self.server.eval_dequeue(
+                self.server.config.enabled_schedulers, DEQUEUE_TIMEOUT
+            )
+            if ev is None:
+                continue
+            if not self._wait_for_index(ev.modify_index, timeout=5.0):
+                self.server.eval_nack(ev.id, token)
+                continue
+            self._eval, self._token = ev, token
+            try:
+                self._invoke_scheduler(ev)
+            except Exception:
+                self.logger.exception("eval %s failed", ev.id)
+                self._safe_nack(ev.id, token)
+                continue
+            try:
+                self.server.eval_ack(ev.id, token)
+            except ValueError:
+                pass  # nack timer fired concurrently
+
+    def _safe_nack(self, eval_id: str, token: str) -> None:
+        try:
+            self.server.eval_nack(eval_id, token)
+        except ValueError:
+            pass
+
+    def _wait_for_index(self, index: int, timeout: float) -> bool:
+        """Local FSM catch-up with exponential backoff
+        (worker.go:214,503)."""
+        deadline = time.monotonic() + timeout
+        backoff = BACKOFF_BASE
+        while self.server.fsm.state.latest_index() < index:
+            if self._stop.is_set() or time.monotonic() > deadline:
+                return False
+            time.sleep(backoff)
+            backoff = min(backoff * 2, BACKOFF_LIMIT)
+        return True
+
+    def _invoke_scheduler(self, ev: Evaluation) -> None:
+        snapshot = self.server.fsm.state.snapshot()
+        factory = self.server.config.factory_for(ev.type)
+        sched = new_scheduler(factory, self.logger, snapshot, self, rng=self.rng)
+        sched.process_eval(ev)
+
+    # ------------------------------------------------ Planner interface
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        plan.eval_token = self._token
+        # The Nack clock stops while the plan waits in the queue
+        # (plan_endpoint.go:16).
+        self.server.broker.pause_nack_timeout(self._eval.id, self._token)
+        try:
+            result = self.server.plan_submit(plan)
+        finally:
+            try:
+                self.server.broker.resume_nack_timeout(self._eval.id, self._token)
+            except ValueError:
+                pass
+        if result.refresh_index:
+            # Stale snapshot: catch up and hand back fresh state.
+            self._wait_for_index(result.refresh_index, timeout=5.0)
+            return result, self.server.fsm.state.snapshot()
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.eval_update([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.snapshot_index = self.server.fsm.state.latest_index()
+        self.server.eval_update([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        token = self.server.broker.outstanding(ev.id)
+        if token != self._token:
+            raise ValueError(f"eval {ev.id!r} is not outstanding")
+        ev.snapshot_index = self.server.fsm.state.latest_index()
+        self.server.eval_update([ev], token=self._token)

@@ -1,0 +1,169 @@
+"""EvalBroker tests (mirror nomad/eval_broker_test.go scenarios)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.broker import FAILED_QUEUE, EvalBroker
+
+
+def make_eval(job_id=None, priority=50, type="service", wait=0.0):
+    ev = mock.eval()
+    ev.priority = priority
+    ev.type = type
+    ev.wait = wait
+    if job_id:
+        ev.job_id = job_id
+    return ev
+
+
+def test_enqueue_dequeue_ack():
+    b = EvalBroker(nack_timeout=5.0)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    assert b.ready_count() == 1
+    out, token = b.dequeue(["service"], timeout=0.1)
+    assert out.id == ev.id and token
+    assert b.unacked_count() == 1
+    b.ack(ev.id, token)
+    assert b.unacked_count() == 0
+    assert b.ready_count() == 0
+
+
+def test_dequeue_priority_order():
+    b = EvalBroker()
+    b.set_enabled(True)
+    low = make_eval(priority=10)
+    high = make_eval(priority=90)
+    b.enqueue(low)
+    b.enqueue(high)
+    out, t = b.dequeue(["service"], timeout=0.1)
+    assert out.id == high.id
+    b.ack(out.id, t)
+
+
+def test_dequeue_timeout_empty():
+    b = EvalBroker()
+    b.set_enabled(True)
+    t0 = time.monotonic()
+    out, token = b.dequeue(["service"], timeout=0.15)
+    assert out is None and token == ""
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_dequeue_filters_scheduler_type():
+    b = EvalBroker()
+    b.set_enabled(True)
+    b.enqueue(make_eval(type="batch"))
+    out, _ = b.dequeue(["service"], timeout=0.1)
+    assert out is None
+    out, t = b.dequeue(["batch"], timeout=0.1)
+    assert out is not None
+    b.ack(out.id, t)
+
+
+def test_per_job_serialization():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e1 = make_eval(job_id="job-1")
+    e2 = make_eval(job_id="job-1")
+    b.enqueue(e1)
+    b.enqueue(e2)  # same job: must wait for e1's ack
+    assert b.ready_count() == 1
+    assert b.blocked_count() == 1
+    out, token = b.dequeue(["service"], timeout=0.1)
+    assert out.id == e1.id
+    none, _ = b.dequeue(["service"], timeout=0.05)
+    assert none is None
+    b.ack(e1.id, token)
+    out2, token2 = b.dequeue(["service"], timeout=0.1)
+    assert out2.id == e2.id
+    b.ack(e2.id, token2)
+
+
+def test_nack_redelivers():
+    b = EvalBroker()
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    out, token = b.dequeue(["service"], timeout=0.1)
+    b.nack(ev.id, token)
+    out2, token2 = b.dequeue(["service"], timeout=0.1)
+    assert out2.id == ev.id
+    assert token2 != token
+    b.ack(ev.id, token2)
+
+
+def test_delivery_limit_routes_to_failed_queue():
+    b = EvalBroker(delivery_limit=2)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    for _ in range(2):
+        out, token = b.dequeue(["service"], timeout=0.1)
+        assert out is not None
+        b.nack(ev.id, token)
+    assert [e.id for e in b.failed_evals()] == [ev.id]
+    # failed evals are only dequeued by the failed queue consumers
+    out, _ = b.dequeue(["service"], timeout=0.05)
+    assert out is None
+    out, t = b.dequeue([FAILED_QUEUE], timeout=0.05)
+    assert out is not None
+    b.ack(ev.id, t)
+
+
+def test_nack_timeout_auto_redelivers():
+    b = EvalBroker(nack_timeout=0.1)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    out, token = b.dequeue(["service"], timeout=0.1)
+    time.sleep(0.25)  # let the nack timer fire
+    out2, token2 = b.dequeue(["service"], timeout=0.5)
+    assert out2.id == ev.id
+    with pytest.raises(ValueError):
+        b.ack(ev.id, token)  # old token no longer valid
+    b.ack(ev.id, token2)
+
+
+def test_pause_nack_timeout():
+    b = EvalBroker(nack_timeout=0.15)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    out, token = b.dequeue(["service"], timeout=0.1)
+    b.pause_nack_timeout(ev.id, token)
+    time.sleep(0.3)  # timer would have fired
+    assert b.outstanding(ev.id) == token  # still ours
+    b.resume_nack_timeout(ev.id, token)
+    b.ack(ev.id, token)
+
+
+def test_wait_eval_delayed():
+    b = EvalBroker()
+    b.set_enabled(True)
+    ev = make_eval(wait=0.15)
+    b.enqueue(ev)
+    assert b.waiting_count() == 1
+    out, _ = b.dequeue(["service"], timeout=0.05)
+    assert out is None
+    out, t = b.dequeue(["service"], timeout=0.5)
+    assert out is not None and out.id == ev.id
+    b.ack(ev.id, t)
+
+
+def test_disabled_broker_drops():
+    b = EvalBroker()
+    b.enqueue(make_eval())
+    assert b.ready_count() == 0
+
+
+def test_dedup_enqueue():
+    b = EvalBroker()
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    b.enqueue(ev)
+    assert b.ready_count() == 1

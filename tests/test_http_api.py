@@ -1,0 +1,146 @@
+"""HTTP API + SDK tests (mirror command/agent/*_endpoint_test.go and
+api/ black-box tests)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import Client, HTTPServer
+from nomad_tpu.api.client import APIError
+from nomad_tpu.client import MockClient
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def api():
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    client = Client(http.addr, timeout=10.0)
+    mc = MockClient(server)
+    mc.start()
+    yield client, server
+    mc.stop()
+    http.stop()
+    server.shutdown()
+
+
+def test_job_lifecycle_over_http(api):
+    client, server = api
+    job = mock.job()
+    job.task_groups[0].count = 2
+
+    eval_id = client.jobs.register(job)
+    assert eval_id
+
+    # eval completes and allocs appear
+    assert wait_until(
+        lambda: client.evaluations.info(eval_id)[0].status
+        == consts.EVAL_STATUS_COMPLETE
+    )
+    allocs, idx = client.jobs.allocations(job.id)
+    assert len(allocs) == 2
+    assert idx > 0
+
+    out, _ = client.jobs.info(job.id)
+    assert out.id == job.id
+
+    jobs, _ = client.jobs.list()
+    assert any(j["id"] == job.id for j in jobs)
+
+    summary, _ = client.jobs.summary(job.id)
+    assert "web" in summary["summary"]
+
+    evals, _ = client.jobs.evaluations(job.id)
+    assert any(e.id == eval_id for e in evals)
+
+    # deregister
+    client.jobs.deregister(job.id)
+    with pytest.raises(APIError) as excinfo:
+        wait_until(lambda: client.jobs.info(job.id) and False, timeout=2.0)
+    assert excinfo.value.status == 404
+
+
+def test_blocking_query_fires_on_change(api):
+    client, server = api
+    job = mock.job()
+    job.task_groups[0].count = 1
+    client.jobs.register(job)
+    assert wait_until(lambda: len(client.jobs.allocations(job.id)[0]) == 1)
+
+    _, idx = client.jobs.allocations(job.id)
+    results = {}
+
+    def blocker():
+        # long-poll: returns when a new alloc change lands
+        t0 = time.monotonic()
+        out, new_idx = client.jobs.allocations(job.id, index=idx, wait=5.0)
+        results["elapsed"] = time.monotonic() - t0
+        results["index"] = new_idx
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    time.sleep(0.3)
+    client.jobs.evaluate(job.id)  # may or may not change allocs
+    server.job_deregister(job.id)  # definitely stops the alloc
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert results["index"] > idx
+    assert results["elapsed"] < 5.0  # returned before the full wait
+
+
+def test_nodes_over_http(api):
+    client, server = api
+    nodes, _ = client.nodes.list()
+    assert len(nodes) == 1
+    node, _ = client.nodes.info(nodes[0]["id"])
+    assert node.status == consts.NODE_STATUS_READY
+
+    client.nodes.drain(node.id, True)
+    assert wait_until(
+        lambda: client.nodes.info(node.id)[0].drain is True
+    )
+    client.nodes.drain(node.id, False)
+
+    # secret-gated alloc listing (node_endpoint.go:585 GetClientAllocs)
+    with pytest.raises(APIError) as excinfo:
+        client.nodes.allocations(node.id, secret="wrong")
+    assert excinfo.value.status == 403
+
+
+def test_plan_over_http(api):
+    client, server = api
+    job = mock.job()
+    job.task_groups[0].count = 3
+    out = client.jobs.plan(job)
+    assert out["annotations"]["desired_tg_updates"]["web"]["place"] == 3
+    with pytest.raises(APIError):
+        client.jobs.info(job.id)  # dry run committed nothing
+
+
+def test_agent_and_system_endpoints(api):
+    client, server = api
+    info = client.agent.self()
+    assert info["stats"]["leader"] is True
+    assert client.agent.leader() != ""
+    client.system.garbage_collect()  # should not raise
+
+
+def test_unknown_route_404(api):
+    client, server = api
+    with pytest.raises(APIError) as excinfo:
+        client.get("/v1/bogus")
+    assert excinfo.value.status == 404
