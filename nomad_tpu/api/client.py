@@ -59,6 +59,8 @@ class Client:
             except Exception:  # noqa: BLE001
                 message = str(e)
             raise APIError(e.code, message) from None
+        except urllib.error.URLError as e:
+            raise APIError(0, f"failed to reach agent at {self.address}: {e.reason}") from None
 
     def get(self, path: str, params: Optional[Dict] = None) -> Tuple[Any, int]:
         return self._request("GET", path, params=params)

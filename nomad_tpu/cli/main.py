@@ -1,0 +1,504 @@
+"""Command-line interface.
+
+Reference: command/ + commands.go:28-146 — run/plan/status/stop/
+validate/init/inspect/node-status/node-drain/alloc-status/eval-status/
+agent-info and the agent entrypoint. Talks to the agent over the HTTP
+SDK; `agent -dev` runs an in-process server+client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from ..api.client import APIError, Client
+from ..utils.codec import to_dict
+
+EXAMPLE_JOB = '''\
+# Example job file (reference: command/init.go)
+job "example" {
+  datacenters = ["dc1"]
+  type = "service"
+
+  update {
+    stagger = "10s"
+    max_parallel = 1
+  }
+
+  group "cache" {
+    count = 1
+
+    restart {
+      attempts = 10
+      interval = "5m"
+      delay = "25s"
+      mode = "delay"
+    }
+
+    ephemeral_disk {
+      size = 300
+    }
+
+    task "redis" {
+      driver = "exec"
+
+      config {
+        command = "/bin/sleep"
+        args = ["3600"]
+      }
+
+      resources {
+        cpu = 500
+        memory = 256
+
+        network {
+          mbits = 10
+          port "db" {}
+        }
+      }
+    }
+  }
+}
+'''
+
+
+def _client(args) -> Client:
+    address = args.address or os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+    return Client(address, timeout=30.0)
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    all_rows = [header] + rows
+    widths = [max(len(str(r[i])) for r in all_rows) for i in range(len(header))]
+    lines = []
+    for r in all_rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(lines)
+
+
+def _short(ident: str) -> str:
+    return ident[:8] if ident else ""
+
+
+def _monitor_eval(client: Client, eval_id: str, timeout: float = 60.0) -> int:
+    """Poll the eval until terminal; print placement results
+    (command/monitor.go)."""
+    deadline = time.monotonic() + timeout
+    printed_blocked = False
+    while time.monotonic() < deadline:
+        ev, _ = client.evaluations.info(eval_id)
+        if ev.status in ("complete", "failed", "canceled"):
+            print(f'Evaluation "{_short(eval_id)}" finished with status "{ev.status}"')
+            if ev.failed_tg_allocs:
+                for tg, metric in ev.failed_tg_allocs.items():
+                    print(f"\nTask Group {tg!r} (failed to place all allocations):")
+                    for constraint, count in metric.constraint_filtered.items():
+                        print(f"  * Constraint {constraint!r} filtered {count} nodes")
+                    for dim, count in metric.dimension_exhausted.items():
+                        print(f"  * Resources exhausted on {count} nodes: {dim}")
+                    if metric.nodes_evaluated == 0:
+                        print("  * No nodes were eligible for evaluation")
+                if ev.blocked_eval and not printed_blocked:
+                    print(
+                        f'\nEvaluation "{_short(ev.blocked_eval)}" waiting for '
+                        "additional capacity to place remainder"
+                    )
+            return 0 if ev.status == "complete" else 1
+        time.sleep(0.2)
+    print(f"Timed out waiting for evaluation {_short(eval_id)}")
+    return 1
+
+
+# ------------------------------------------------------------- commands
+
+
+def cmd_init(args) -> int:
+    path = "example.nomad"
+    if os.path.exists(path):
+        print(f"Job file {path!r} already exists", file=sys.stderr)
+        return 1
+    with open(path, "w") as f:
+        f.write(EXAMPLE_JOB)
+    print(f"Example job file written to {path}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from ..jobspec import parse_file
+
+    try:
+        job = parse_file(args.file)
+        errors = job.validate()
+    except (ValueError, OSError) as e:
+        print(f"Error validating job: {e}", file=sys.stderr)
+        return 1
+    if errors:
+        for err in errors:
+            print(f"Validation error: {err}", file=sys.stderr)
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from ..jobspec import parse_file
+
+    job = parse_file(args.file)
+    client = _client(args)
+    eval_id = client.jobs.register(job)
+    if not eval_id:
+        print(f'Job "{job.id}" registered (periodic, no evaluation)')
+        return 0
+    print(f'==> Evaluation "{_short(eval_id)}" created for job "{job.id}"')
+    if args.detach:
+        print(eval_id)
+        return 0
+    return _monitor_eval(client, eval_id)
+
+
+def cmd_plan(args) -> int:
+    from ..jobspec import parse_file
+
+    job = parse_file(args.file)
+    client = _client(args)
+    result = client.jobs.plan(job, diff=True)
+    annotations = result.get("annotations") or {}
+    desired = (annotations.get("desired_tg_updates") or {}) if annotations else {}
+    print("+ Job:", job.id)
+    for tg, counts in desired.items():
+        parts = [
+            f"{name}: {count}"
+            for name, count in counts.items()
+            if count
+        ]
+        print(f"  Task Group {tg!r}: " + (", ".join(parts) or "no changes"))
+    failed = result.get("failed_tg_allocs") or {}
+    if failed:
+        print("\nPlacement failures:")
+        for tg, metric in failed.items():
+            print(f"  Task Group {tg!r}:")
+            for constraint, count in (metric.get("constraint_filtered") or {}).items():
+                print(f"    * Constraint {constraint!r} filtered {count} nodes")
+    else:
+        print("\nAll tasks successfully allocated.")
+    return 0
+
+
+def cmd_status(args) -> int:
+    client = _client(args)
+    if not args.job:
+        jobs, _ = client.jobs.list()
+        if not jobs:
+            print("No running jobs")
+            return 0
+        rows = [
+            [_stub["id"], _stub["type"], str(_stub["priority"]), _stub["status"]]
+            for _stub in jobs
+        ]
+        print(_fmt_table(rows, ["ID", "Type", "Priority", "Status"]))
+        return 0
+    try:
+        job, _ = client.jobs.info(args.job)
+    except APIError as e:
+        print(f"Error querying job: {e}", file=sys.stderr)
+        return 1
+    print(f"ID            = {job.id}")
+    print(f"Name          = {job.name}")
+    print(f"Type          = {job.type}")
+    print(f"Priority      = {job.priority}")
+    print(f"Datacenters   = {','.join(job.datacenters)}")
+    print(f"Status        = {job.status}")
+    print(f"Periodic      = {job.is_periodic()}")
+    summary, _ = client.jobs.summary(job.id)
+    print("\nSummary")
+    rows = [
+        [tg, str(s["queued"]), str(s["starting"]), str(s["running"]),
+         str(s["failed"]), str(s["complete"]), str(s["lost"])]
+        for tg, s in (summary.get("summary") or {}).items()
+    ]
+    print(_fmt_table(
+        rows, ["Task Group", "Queued", "Starting", "Running", "Failed",
+               "Complete", "Lost"]
+    ))
+    allocs, _ = client.jobs.allocations(job.id)
+    if allocs:
+        print("\nAllocations")
+        rows = [
+            [_short(a["id"]), _short(a["eval_id"]), _short(a["node_id"]),
+             a["task_group"], a["desired_status"], a["client_status"]]
+            for a in allocs
+        ]
+        print(_fmt_table(
+            rows, ["ID", "Eval ID", "Node ID", "Task Group", "Desired", "Status"]
+        ))
+    return 0
+
+
+def cmd_stop(args) -> int:
+    client = _client(args)
+    eval_id = client.jobs.deregister(args.job)
+    if not eval_id:
+        print(f'Job "{args.job}" deregistered')
+        return 0
+    print(f'==> Evaluation "{_short(eval_id)}" created for deregistration')
+    if args.detach:
+        return 0
+    return _monitor_eval(client, eval_id)
+
+
+def cmd_inspect(args) -> int:
+    client = _client(args)
+    job, _ = client.jobs.info(args.job)
+    print(json.dumps(to_dict(job), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    client = _client(args)
+    if not args.node:
+        nodes, _ = client.nodes.list()
+        rows = [
+            [_short(n["id"]), n["datacenter"], n["name"], n["node_class"],
+             str(n["drain"]), n["status"]]
+            for n in nodes
+        ]
+        print(_fmt_table(rows, ["ID", "DC", "Name", "Class", "Drain", "Status"]))
+        return 0
+    node, _ = client.nodes.info(args.node)
+    print(f"ID         = {node.id}")
+    print(f"Name       = {node.name}")
+    print(f"Class      = {node.node_class}")
+    print(f"DC         = {node.datacenter}")
+    print(f"Drain      = {node.drain}")
+    print(f"Status     = {node.status}")
+    if node.resources:
+        print(
+            f"Resources  = cpu:{node.resources.cpu}MHz "
+            f"mem:{node.resources.memory_mb}MB disk:{node.resources.disk_mb}MB"
+        )
+    drivers = sorted(
+        k.removeprefix("driver.")
+        for k in node.attributes
+        if k.startswith("driver.") and not k.endswith(".enable")
+    )
+    print(f"Drivers    = {','.join(drivers)}")
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    client = _client(args)
+    if not (args.enable or args.disable):
+        print("Either -enable or -disable is required", file=sys.stderr)
+        return 1
+    client.nodes.drain(args.node, drain=bool(args.enable))
+    state = "enabled" if args.enable else "disabled"
+    print(f"Node {_short(args.node)} drain {state}")
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    client = _client(args)
+    alloc, _ = client.allocations.info(args.alloc)
+    print(f"ID            = {alloc.id}")
+    print(f"Eval ID       = {_short(alloc.eval_id)}")
+    print(f"Name          = {alloc.name}")
+    print(f"Node ID       = {_short(alloc.node_id)}")
+    print(f"Job ID        = {alloc.job_id}")
+    print(f"Desired       = {alloc.desired_status}  {alloc.desired_description}")
+    print(f"Status        = {alloc.client_status}  {alloc.client_description}")
+    for task, state in alloc.task_states.items():
+        print(f"\nTask {task!r} is {state.state!r} (failed={state.failed})")
+        for event in state.events[-5:]:
+            details = []
+            if event.exit_code:
+                details.append(f"exit={event.exit_code}")
+            if event.driver_error:
+                details.append(event.driver_error)
+            if event.message:
+                details.append(event.message)
+            print(f"  {event.type}" + (f" ({', '.join(details)})" if details else ""))
+    metrics = alloc.metrics
+    if metrics is not None and args.verbose:
+        print("\nPlacement Metrics")
+        print(f"  Nodes evaluated: {metrics.nodes_evaluated}")
+        print(f"  Nodes filtered:  {metrics.nodes_filtered}")
+        print(f"  Nodes exhausted: {metrics.nodes_exhausted}")
+        for name, score in metrics.scores.items():
+            print(f"  Score {name}: {score:.3f}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    client = _client(args)
+    ev, _ = client.evaluations.info(args.eval)
+    print(f"ID                 = {ev.id}")
+    print(f"Status             = {ev.status}  {ev.status_description}")
+    print(f"Type               = {ev.type}")
+    print(f"Triggered By       = {ev.triggered_by}")
+    print(f"Job ID             = {ev.job_id}")
+    print(f"Priority           = {ev.priority}")
+    if ev.blocked_eval:
+        print(f"Blocked Eval       = {_short(ev.blocked_eval)}")
+    if ev.queued_allocations:
+        print(f"Queued Allocations = {ev.queued_allocations}")
+    if ev.failed_tg_allocs:
+        print("\nFailed Placements")
+        for tg, metric in ev.failed_tg_allocs.items():
+            print(f"Task Group {tg!r}:")
+            for constraint, count in metric.constraint_filtered.items():
+                print(f"  * Constraint {constraint!r} filtered {count} nodes")
+            for dim, count in metric.dimension_exhausted.items():
+                print(f"  * {dim} exhausted on {count} nodes")
+    return 0
+
+
+def cmd_agent_info(args) -> int:
+    client = _client(args)
+    info = client.agent.self()
+    print(json.dumps(info["stats"], indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_agent(args) -> int:
+    """Run a combined server+client agent (dev mode)."""
+    import logging
+
+    from ..api import HTTPServer
+    from ..client import ClientAgent, ClientConfig
+    from ..server import Server, ServerConfig
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.log_level == "DEBUG" else logging.INFO,
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+    )
+    if not args.dev:
+        print("only -dev mode is supported for now", file=sys.stderr)
+        return 1
+
+    scheduler_factories = {}
+    if args.tpu:
+        scheduler_factories = {"service": "service-tpu", "batch": "batch-tpu"}
+    server = Server(
+        ServerConfig(num_schedulers=args.num_schedulers,
+                     scheduler_factories=scheduler_factories)
+    )
+    server.start()
+    http = HTTPServer(server, host=args.bind, port=args.port)
+    http.start()
+    print(f"==> nomad-tpu agent started (dev mode)! HTTP: {http.addr}")
+    print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
+
+    client_agent = ClientAgent(
+        ClientConfig(
+            servers=[http.addr],
+            dev_mode=True,
+            options={"driver.raw_exec.enable": "1"},
+        )
+    )
+    client_agent.start()
+    print(f"    Client node: {client_agent.node.id}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("\n==> Caught interrupt, shutting down...")
+        client_agent.shutdown(destroy_allocs=True)
+        http.stop()
+        server.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------- main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="nomad-tpu", description="TPU-native cluster scheduler"
+    )
+    parser.add_argument("--address", default=None, help="agent HTTP address")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("agent", help="run an agent")
+    p.add_argument("-dev", dest="dev", action="store_true")
+    p.add_argument("-bind", dest="bind", default="127.0.0.1")
+    p.add_argument("-port", dest="port", type=int, default=4646)
+    p.add_argument("-num-schedulers", dest="num_schedulers", type=int, default=2)
+    p.add_argument("-tpu", dest="tpu", action="store_true",
+                   help="route service/batch evals to the TPU backend")
+    p.add_argument("-log-level", dest="log_level", default="INFO")
+    p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("init", help="create an example job file")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("validate", help="validate a job file")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser("run", help="run a job")
+    p.add_argument("file")
+    p.add_argument("-detach", dest="detach", action="store_true")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("plan", help="dry-run a job update")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_plan)
+
+    p = sub.add_parser("status", help="display job status")
+    p.add_argument("job", nargs="?")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("stop", help="stop a job")
+    p.add_argument("job")
+    p.add_argument("-detach", dest="detach", action="store_true")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("inspect", help="dump a job's definition")
+    p.add_argument("job")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("node-status", help="display node status")
+    p.add_argument("node", nargs="?")
+    p.set_defaults(fn=cmd_node_status)
+
+    p = sub.add_parser("node-drain", help="toggle node drain mode")
+    p.add_argument("node")
+    p.add_argument("-enable", dest="enable", action="store_true")
+    p.add_argument("-disable", dest="disable", action="store_true")
+    p.set_defaults(fn=cmd_node_drain)
+
+    p = sub.add_parser("alloc-status", help="display allocation status")
+    p.add_argument("alloc")
+    p.add_argument("-verbose", dest="verbose", action="store_true")
+    p.set_defaults(fn=cmd_alloc_status)
+
+    p = sub.add_parser("eval-status", help="display evaluation status")
+    p.add_argument("eval")
+    p.set_defaults(fn=cmd_eval_status)
+
+    p = sub.add_parser("agent-info", help="display agent stats")
+    p.set_defaults(fn=cmd_agent_info)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError) as e:
+        # unreadable job files, parse errors, connection failures
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

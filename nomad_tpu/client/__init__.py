@@ -1,3 +1,5 @@
+from .agent import ClientAgent
+from .config import ClientConfig
 from .mock_client import MockClient
 
-__all__ = ["MockClient"]
+__all__ = ["ClientAgent", "ClientConfig", "MockClient"]

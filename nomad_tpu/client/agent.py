@@ -1,0 +1,263 @@
+"""Client agent: node registration, heartbeats, alloc watching, task
+execution.
+
+Reference: client/client.go:166 — setupNode:609 + fingerprints:696 +
+driver fingerprints:756, registerAndHeartbeat:812, long-poll
+watchAllocations:1125 (diff keyed on alloc_modify_index), runAllocs:1285,
+batched status sync allocSync:1050, state persistence saveState:531.
+Talks to the server over the HTTP API (the wire substrate here; the
+reference uses msgpack RPC).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.client import APIError, Client as APIClient
+from ..structs import Allocation, Node, Resources, consts
+from ..utils.ids import generate_uuid
+from .alloc_runner import AllocRunner
+from .config import ClientConfig
+from .drivers import DRIVER_REGISTRY
+from .fingerprint import fingerprint_node
+
+ALLOC_SYNC_INTERVAL = 0.2  # client.go allocSyncIntv (batched updates)
+
+
+class ClientAgent:
+    def __init__(self, config: ClientConfig, node: Optional[Node] = None):
+        self.config = config
+        self.logger = logging.getLogger("nomad_tpu.client")
+        if not config.servers:
+            raise ValueError("no servers configured")
+        self.api = APIClient(config.servers[0], timeout=330.0)
+
+        if not config.alloc_dir:
+            config.alloc_dir = tempfile.mkdtemp(prefix="nomad_tpu_allocs_")
+        if not config.state_dir:
+            config.state_dir = tempfile.mkdtemp(prefix="nomad_tpu_state_")
+
+        self.node = node or Node()
+        self._setup_node()
+        # Restore a persisted node identity before first contact
+        # (client.go:496 restoreState).
+        self._restore_state()
+
+        self.alloc_runners: Dict[str, AllocRunner] = {}
+        self._runners_lock = threading.Lock()
+        self._dirty_allocs: Dict[str, Allocation] = {}
+        self._dirty_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.heartbeat_ttl = 1.0
+
+    # ------------------------------------------------------------------
+
+    def _setup_node(self) -> None:
+        node = self.node
+        if not node.id:
+            node.id = generate_uuid()
+        if not node.secret_id:
+            node.secret_id = generate_uuid()
+        node.datacenter = self.config.datacenter
+        node.node_class = self.config.node_class
+        node.meta.update(self.config.meta)
+        if node.resources is None:
+            node.resources = Resources()
+        if self.config.reserved is not None:
+            node.reserved = self.config.reserved
+        # Client options become attributes drivers can gate on, e.g.
+        # driver.raw_exec.enable (config "options", client/config).
+        for k, v in self.config.options.items():
+            node.attributes[k] = v
+        fingerprint_node(node)
+        if self.config.node_name:
+            node.name = self.config.node_name
+        # Driver fingerprints advertise availability.
+        whitelist = set(self.config.driver_whitelist)
+        for name, cls in DRIVER_REGISTRY.items():
+            if whitelist and name not in whitelist:
+                continue
+            try:
+                cls().fingerprint(node)
+            except Exception:
+                self.logger.exception("driver %s fingerprint failed", name)
+        node.status = consts.NODE_STATUS_INIT
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.heartbeat_ttl = self.api.nodes.register(self.node)
+        self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
+        for target, name in (
+            (self._heartbeat_loop, "heartbeat"),
+            (self._watch_allocations, "alloc-watch"),
+            (self._alloc_sync_loop, "alloc-sync"),
+            (self._save_state_loop, "save-state"),
+        ):
+            t = threading.Thread(target=target, name=f"client-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self, destroy_allocs: bool = False) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=3.0)
+        if destroy_allocs:
+            with self._runners_lock:
+                runners = list(self.alloc_runners.values())
+            for r in runners:
+                r.destroy()
+        self._save_state()
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            interval = max(self.heartbeat_ttl / 2.0, 0.05)
+            if self._stop.wait(interval):
+                return
+            try:
+                self.heartbeat_ttl = self.api.nodes.heartbeat(
+                    self.node.id, self.node.secret_id
+                )
+            except APIError as e:
+                if e.status == 0:
+                    continue  # agent unreachable: transient, retry next tick
+                # The server rejected the heartbeat (e.g. it lost our node
+                # after a restart): re-register.
+                self.logger.warning("heartbeat failed: %s", e)
+                try:
+                    self.heartbeat_ttl = self.api.nodes.register(self.node)
+                    self.api.nodes.update_status(
+                        self.node.id, consts.NODE_STATUS_READY
+                    )
+                except APIError:
+                    pass
+            except Exception:
+                pass  # unexpected; retry next tick
+
+    def _watch_allocations(self) -> None:
+        """Blocking-query loop on this node's allocations; apply the
+        diff (client.go:1125/1285)."""
+        index = 0
+        while not self._stop.is_set():
+            try:
+                allocs, new_index = self.api.nodes.allocations(
+                    self.node.id, secret=self.node.secret_id,
+                    index=index, wait=2.0,
+                )
+            except Exception:
+                if self._stop.wait(0.5):
+                    return
+                continue
+            index = max(new_index, index)
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, pulled: List[Allocation]) -> None:
+        pulled_ids = {a.id for a in pulled}
+        with self._runners_lock:
+            # removed: the server GC'd them
+            for alloc_id in list(self.alloc_runners):
+                if alloc_id not in pulled_ids:
+                    runner = self.alloc_runners.pop(alloc_id)
+                    threading.Thread(target=runner.destroy, daemon=True).start()
+            for alloc in pulled:
+                runner = self.alloc_runners.get(alloc.id)
+                if runner is not None:
+                    if alloc.alloc_modify_index > runner.alloc.alloc_modify_index:
+                        runner.update(alloc)
+                    continue
+                if alloc.terminal_status():
+                    continue
+                runner = AllocRunner(
+                    alloc, self.config.alloc_dir, self._mark_dirty,
+                    self.config.max_kill_timeout,
+                )
+                self.alloc_runners[alloc.id] = runner
+                runner.run()
+
+    def _mark_dirty(self, alloc: Allocation) -> None:
+        with self._dirty_lock:
+            self._dirty_allocs[alloc.id] = alloc
+
+    def _alloc_sync_loop(self) -> None:
+        """Batched client->server status updates (client.go:1050)."""
+        while not self._stop.wait(ALLOC_SYNC_INTERVAL):
+            self._flush_dirty()
+        self._flush_dirty()
+
+    def _flush_dirty(self) -> None:
+        with self._dirty_lock:
+            dirty = list(self._dirty_allocs.values())
+            self._dirty_allocs.clear()
+        if not dirty:
+            return
+        updates = []
+        for alloc in dirty:
+            sync = Allocation(
+                id=alloc.id,
+                client_status=alloc.client_status,
+                client_description=alloc.client_description,
+                task_states=alloc.task_states,
+            )
+            updates.append(sync)
+        try:
+            self.api.nodes.update_allocs(self.node.id, updates)
+        except Exception:
+            # Re-queue on failure
+            with self._dirty_lock:
+                for alloc in dirty:
+                    self._dirty_allocs.setdefault(alloc.id, alloc)
+
+    # ------------------------------------------------------------------
+
+    def _state_path(self) -> str:
+        return os.path.join(self.config.state_dir, "client_state.json")
+
+    def _save_state_loop(self) -> None:
+        interval = 1.0 if self.config.dev_mode else self.config.save_interval
+        while not self._stop.wait(interval):
+            self._save_state()
+
+    def _save_state(self) -> None:
+        state = {
+            "node_id": self.node.id,
+            "secret_id": self.node.secret_id,
+            "allocs": [
+                r.persist() for r in self.alloc_runners.values()
+            ],
+        }
+        tmp = self._state_path() + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self._state_path())
+        except OSError:
+            self.logger.exception("failed to save client state")
+
+    def _restore_state(self) -> None:
+        try:
+            with open(self._state_path()) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return
+        # Keep a stable node identity across restarts (client.go:496).
+        self.node.id = state.get("node_id") or self.node.id
+        self.node.secret_id = state.get("secret_id") or self.node.secret_id
+
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._runners_lock:
+            return {
+                "node_id": self.node.id,
+                "num_allocs": len(self.alloc_runners),
+                "heartbeat_ttl": self.heartbeat_ttl,
+            }

@@ -1,0 +1,139 @@
+"""AllocRunner: per-allocation supervisor.
+
+Reference: client/alloc_runner.go:95 — builds the AllocDir, runs one
+TaskRunner per task, aggregates task states into the alloc client
+status (setTaskState:365/syncStatus:345), and handles destroy/GC.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+from ..structs import Allocation, TaskState, consts, new_task_event
+from .allocdir import AllocDir
+from .task_runner import TaskRunner
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        alloc_root: str,
+        sync_cb: Callable[[Allocation], None],
+        max_kill_timeout: float = 30.0,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.alloc = alloc
+        self.sync_cb = sync_cb
+        self.max_kill_timeout = max_kill_timeout
+        self.logger = logger or logging.getLogger(
+            f"nomad_tpu.alloc.{alloc.id[:8]}"
+        )
+        self.alloc_dir = AllocDir(os.path.join(alloc_root, alloc.id))
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.task_states: Dict[str, TaskState] = {}
+        self._lock = threading.Lock()
+        self._destroyed = False
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) if self.alloc.job else None
+        if tg is None:
+            self.alloc.client_status = consts.ALLOC_CLIENT_FAILED
+            self.alloc.client_description = (
+                f"missing task group '{self.alloc.task_group}'"
+            )
+            self.sync_cb(self.alloc)
+            return
+
+        self.alloc_dir.build([t.name for t in tg.tasks])
+        for task in tg.tasks:
+            runner = TaskRunner(
+                self.alloc, task, self.alloc_dir, self._on_task_state,
+                self.max_kill_timeout,
+            )
+            self.task_runners[task.name] = runner
+            runner.start()
+
+    def _on_task_state(self, task_name: str, state: TaskState) -> None:
+        with self._lock:
+            # Copy: runner keeps mutating its own state object.
+            self.task_states[task_name] = TaskState(
+                state=state.state,
+                failed=state.failed,
+                events=list(state.events),
+            )
+            self._sync_status()
+
+    def _sync_status(self) -> None:
+        """Aggregate task states -> alloc client status
+        (alloc_runner.go:365-423)."""
+        states = self.task_states.values()
+        if any(s.state == consts.TASK_STATE_RUNNING for s in states):
+            status = consts.ALLOC_CLIENT_RUNNING
+        elif all(s.state == consts.TASK_STATE_DEAD for s in states) and states:
+            if any(s.failed for s in states):
+                status = consts.ALLOC_CLIENT_FAILED
+            else:
+                status = consts.ALLOC_CLIENT_COMPLETE
+        else:
+            status = consts.ALLOC_CLIENT_PENDING
+
+        # A failed task takes the whole alloc down (leader task logic is
+        # post-0.5; all tasks are peers here).
+        if status == consts.ALLOC_CLIENT_FAILED:
+            for name, runner in self.task_runners.items():
+                st = self.task_states.get(name)
+                if st is not None and st.state != consts.TASK_STATE_DEAD:
+                    runner.kill(new_task_event(consts.TASK_EVENT_KILLING))
+
+        self.alloc.client_status = status
+        self.alloc.task_states = dict(self.task_states)
+        self.sync_cb(self.alloc)
+
+    # ------------------------------------------------------------------
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new version of this alloc (desired status or
+        in-place task updates)."""
+        self.alloc.desired_status = alloc.desired_status
+        self.alloc.desired_description = alloc.desired_description
+        self.alloc.alloc_modify_index = alloc.alloc_modify_index
+        self.alloc.modify_index = alloc.modify_index
+        if alloc.job is not None:
+            self.alloc.job = alloc.job
+        if alloc.desired_status in (
+            consts.ALLOC_DESIRED_STOP,
+            consts.ALLOC_DESIRED_EVICT,
+        ):
+            self.kill_tasks()
+
+    def kill_tasks(self) -> None:
+        for runner in self.task_runners.values():
+            runner.kill()
+
+    def destroy(self) -> None:
+        if self._destroyed:
+            return
+        self._destroyed = True
+        self.kill_tasks()
+        for runner in self.task_runners.values():
+            runner.join(timeout=self.max_kill_timeout + 2.0)
+        self.alloc_dir.destroy()
+
+    def alive(self) -> bool:
+        return any(
+            s.state != consts.TASK_STATE_DEAD for s in self.task_states.values()
+        ) or not self.task_states
+
+    # ------------------------------------------------------------------
+
+    def persist(self) -> dict:
+        return {
+            "alloc_id": self.alloc.id,
+            "task_runners": [r.persist() for r in self.task_runners.values()],
+        }

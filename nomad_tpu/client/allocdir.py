@@ -1,0 +1,92 @@
+"""Allocation directories: shared alloc dir + per-task dirs.
+
+Reference: client/allocdir/alloc_dir.go:58 — shared `alloc/` (logs,
+tmp, data) and per-task dirs with `local/` and `secrets/`, plus the
+file APIs backing the HTTP fs endpoints (List/Stat/ReadAt:461-551).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+from typing import Dict, List, Optional
+
+SHARED_ALLOC_NAME = "alloc"
+SHARED_DIRS = ("data", "logs", "tmp")
+TASK_LOCAL = "local"
+TASK_SECRETS = "secrets"
+
+
+class AllocDir:
+    def __init__(self, root: str):
+        self.root = root
+        self.shared_dir = os.path.join(root, SHARED_ALLOC_NAME)
+        self.task_dirs: Dict[str, str] = {}
+
+    def build(self, task_names: List[str]) -> None:
+        os.makedirs(self.shared_dir, exist_ok=True)
+        for sub in SHARED_DIRS:
+            os.makedirs(os.path.join(self.shared_dir, sub), exist_ok=True)
+        for name in task_names:
+            task_dir = os.path.join(self.root, name)
+            os.makedirs(os.path.join(task_dir, TASK_LOCAL), exist_ok=True)
+            secrets = os.path.join(task_dir, TASK_SECRETS)
+            os.makedirs(secrets, exist_ok=True)
+            os.chmod(secrets, stat.S_IRWXU)
+            self.task_dirs[name] = task_dir
+
+    def log_dir(self) -> str:
+        return os.path.join(self.shared_dir, "logs")
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    # ------------------------------ file APIs (HTTP fs endpoints) -----
+
+    def _resolve(self, path: str) -> str:
+        full = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        if not full.startswith(os.path.normpath(self.root)):
+            raise PermissionError(f"path escapes alloc dir: {path!r}")
+        return full
+
+    def list_dir(self, path: str) -> List[dict]:
+        full = self._resolve(path)
+        out = []
+        for name in sorted(os.listdir(full)):
+            st = os.stat(os.path.join(full, name))
+            out.append(
+                {
+                    "name": name,
+                    "is_dir": stat.S_ISDIR(st.st_mode),
+                    "size": st.st_size,
+                    "mod_time": st.st_mtime,
+                }
+            )
+        return out
+
+    def stat_file(self, path: str) -> dict:
+        full = self._resolve(path)
+        st = os.stat(full)
+        return {
+            "name": os.path.basename(full),
+            "is_dir": stat.S_ISDIR(st.st_mode),
+            "size": st.st_size,
+            "mod_time": st.st_mtime,
+        }
+
+    def read_at(self, path: str, offset: int = 0, limit: Optional[int] = None) -> bytes:
+        full = self._resolve(path)
+        with open(full, "rb") as f:
+            f.seek(offset)
+            return f.read(limit if limit is not None else -1)
+
+    def disk_used_mb(self) -> float:
+        total = 0
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    pass
+        return total / (1024 * 1024)

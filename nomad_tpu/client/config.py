@@ -1,0 +1,33 @@
+"""Client agent configuration.
+
+Reference: client/config/config.go (drivers whitelist, reserved
+resources, node class/meta, state/alloc dirs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import Resources
+
+
+@dataclass
+class ClientConfig:
+    state_dir: str = ""  # persisted client state (restored on restart)
+    alloc_dir: str = ""  # root of per-allocation directories
+    servers: List[str] = field(default_factory=list)  # server HTTP addrs
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = ""
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    options: Dict[str, str] = field(default_factory=dict)
+    reserved: Optional[Resources] = None
+    # Only fingerprint/enable these drivers if set ("driver.whitelist").
+    driver_whitelist: List[str] = field(default_factory=list)
+    max_kill_timeout: float = 30.0
+    # How often client state is persisted (client.go:65).
+    save_interval: float = 60.0
+    # Dev mode: shorter intervals, temp dirs.
+    dev_mode: bool = False

@@ -1,0 +1,22 @@
+"""Task drivers.
+
+Reference: client/driver/ — Driver/DriverHandle interfaces
+(driver.go:49,103), fingerprint-based availability advertised as
+`driver.<name>` node attributes.
+"""
+
+from .base import Driver, DriverHandle, TaskContext, DRIVER_REGISTRY, new_driver
+from .mock import MockDriver
+from .raw_exec import RawExecDriver
+from .exec_driver import ExecDriver
+
+__all__ = [
+    "Driver",
+    "DriverHandle",
+    "TaskContext",
+    "DRIVER_REGISTRY",
+    "new_driver",
+    "MockDriver",
+    "RawExecDriver",
+    "ExecDriver",
+]

@@ -1,0 +1,83 @@
+"""Driver interfaces and registry.
+
+Reference: client/driver/driver.go:49 (Driver), :103 (DriverHandle).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Type
+
+from ...structs import Node, Task
+
+
+@dataclass
+class TaskContext:
+    alloc_id: str = ""
+    alloc_dir: str = ""  # alloc root
+    task_dir: str = ""  # this task's dir
+    log_dir: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    max_kill_timeout: float = 30.0
+
+
+@dataclass
+class WaitResult:
+    exit_code: int = 0
+    signal: int = 0
+    error: str = ""
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.error
+
+
+class DriverHandle:
+    """A running task instance."""
+
+    def id(self) -> str:
+        """Opaque handle id persisted for reattach after client restart
+        (task_runner.go:189)."""
+        raise NotImplementedError
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        """Block for task exit; None on timeout."""
+        raise NotImplementedError
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        raise NotImplementedError
+
+    def update(self, task: Task) -> None:
+        pass
+
+
+class Driver:
+    name = ""
+
+    def fingerprint(self, node: Node) -> bool:
+        """Advertise availability via `driver.<name>` attributes."""
+        raise NotImplementedError
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        raise NotImplementedError
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        """Reattach to a live task after client restart; None if gone."""
+        return None
+
+    def validate_config(self, task: Task) -> None:
+        pass
+
+
+DRIVER_REGISTRY: Dict[str, Type[Driver]] = {}
+
+
+def register_driver(cls: Type[Driver]) -> Type[Driver]:
+    DRIVER_REGISTRY[cls.name] = cls
+    return cls
+
+
+def new_driver(name: str) -> Driver:
+    cls = DRIVER_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"unknown driver {name!r}")
+    return cls()

@@ -1,0 +1,62 @@
+"""exec driver: command execution with best-effort isolation.
+
+Reference: client/driver/exec.go:326 + exec_linux.go (cgroup + chroot
+via the out-of-process executor). Here: own session + rlimits applied
+in the child via preexec; full cgroup/chroot isolation requires root
+and lands with the native executor.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import subprocess
+from typing import Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, register_driver
+from .raw_exec import ProcessHandle
+
+
+@register_driver
+class ExecDriver(Driver):
+    name = "exec"
+
+    def fingerprint(self, node: Node) -> bool:
+        if node.attributes.get("kernel.name", "linux") != "linux":
+            return False
+        node.attributes["driver.exec"] = "1"
+        return True
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise ValueError(f"missing command for task {task.name!r}")
+        args = [command] + [str(a) for a in cfg.get("args", [])]
+        env = dict(os.environ)
+        env.update(ctx.env)
+        stdout = open(os.path.join(ctx.log_dir, f"{task.name}.stdout.0"), "ab")
+        stderr = open(os.path.join(ctx.log_dir, f"{task.name}.stderr.0"), "ab")
+
+        mem_bytes = None
+        if task.resources is not None and task.resources.memory_mb:
+            mem_bytes = task.resources.memory_mb * 1024 * 1024
+
+        def preexec():
+            if mem_bytes is not None:
+                try:
+                    resource.setrlimit(resource.RLIMIT_AS, (mem_bytes, mem_bytes))
+                except (ValueError, OSError):
+                    pass
+
+        proc = subprocess.Popen(
+            args,
+            cwd=ctx.task_dir,
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+            start_new_session=True,
+            preexec_fn=preexec,
+        )
+        return ProcessHandle(proc, task.name)

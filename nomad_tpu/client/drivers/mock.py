@@ -1,0 +1,70 @@
+"""Mock driver: configurable fake for tests.
+
+Reference: client/driver/mock_driver.go:215 — config keys run_for /
+exit_code / start_error let tests script task behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ...structs import Node, Task
+from ...utils.ids import generate_uuid
+from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
+
+
+class MockHandle(DriverHandle):
+    def __init__(self, handle_id: str, run_for: float, exit_code: int):
+        self._id = handle_id
+        self.exit_code = exit_code
+        self._done = threading.Event()
+        self._result: Optional[WaitResult] = None
+        self._timer = threading.Timer(run_for, self._finish)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _finish(self) -> None:
+        self._result = WaitResult(exit_code=self.exit_code)
+        self._done.set()
+
+    def id(self) -> str:
+        return self._id
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        self._timer.cancel()
+        self._result = WaitResult(exit_code=0, signal=9)
+        self._done.set()
+
+
+_live_handles = {}
+
+
+@register_driver
+class MockDriver(Driver):
+    name = "mock_driver"
+
+    def fingerprint(self, node: Node) -> bool:
+        node.attributes["driver.mock_driver"] = "1"
+        return True
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        cfg = task.config or {}
+        if cfg.get("start_error"):
+            raise RuntimeError(str(cfg["start_error"]))
+        handle = MockHandle(
+            generate_uuid(),
+            float(cfg.get("run_for", 1e9)),
+            int(cfg.get("exit_code", 0)),
+        )
+        _live_handles[handle.id()] = handle
+        return handle
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        return _live_handles.get(handle_id)

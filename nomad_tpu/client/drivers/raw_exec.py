@@ -1,0 +1,93 @@
+"""raw_exec driver: run a command as a child process, no isolation.
+
+Reference: client/driver/raw_exec.go:312 — opt-in via client option
+driver.raw_exec.enable; stdout/stderr captured to the alloc log dir.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+from typing import Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
+
+
+class ProcessHandle(DriverHandle):
+    def __init__(self, proc: subprocess.Popen, task_name: str):
+        self.proc = proc
+        self.task_name = task_name
+        self._result: Optional[WaitResult] = None
+        self._done = threading.Event()
+        self._waiter = threading.Thread(target=self._wait_proc, daemon=True)
+        self._waiter.start()
+
+    def _wait_proc(self) -> None:
+        code = self.proc.wait()
+        if code < 0:
+            self._result = WaitResult(exit_code=0, signal=-code)
+        else:
+            self._result = WaitResult(exit_code=code)
+        self._done.set()
+
+    def id(self) -> str:
+        return f"{self.task_name}:{self.proc.pid}"
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        if self._done.is_set():
+            return
+        try:
+            # Signal the whole process group (we start with setsid).
+            os.killpg(self.proc.pid, signal.SIGINT)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        if not self._done.wait(kill_timeout):
+            try:
+                os.killpg(self.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                self.proc.kill()
+            self._done.wait(2.0)
+
+
+def launch_command(ctx: TaskContext, task: Task) -> subprocess.Popen:
+    cfg = task.config or {}
+    command = cfg.get("command")
+    if not command:
+        raise ValueError(f"missing command for task {task.name!r}")
+    args = [command] + [str(a) for a in cfg.get("args", [])]
+    env = dict(os.environ)
+    env.update(ctx.env)
+    stdout = open(os.path.join(ctx.log_dir, f"{task.name}.stdout.0"), "ab")
+    stderr = open(os.path.join(ctx.log_dir, f"{task.name}.stderr.0"), "ab")
+    return subprocess.Popen(
+        args,
+        cwd=ctx.task_dir,
+        env=env,
+        stdout=stdout,
+        stderr=stderr,
+        start_new_session=True,  # own process group for clean kills
+    )
+
+
+@register_driver
+class RawExecDriver(Driver):
+    name = "raw_exec"
+
+    def fingerprint(self, node: Node) -> bool:
+        # Opt-in only: no isolation (raw_exec.go fingerprint gate).
+        if node.attributes.get("driver.raw_exec.enable") != "1":
+            node.attributes.pop("driver.raw_exec", None)
+            return False
+        node.attributes["driver.raw_exec"] = "1"
+        return True
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        return ProcessHandle(launch_command(ctx, task), task.name)

@@ -1,0 +1,49 @@
+"""Task environment builder: the NOMAD_* variables.
+
+Reference: client/driver/env/env.go:487 — alloc dir, task dirs,
+resources, ports, meta, alloc/task identity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..structs import Allocation, Task
+
+
+def build_task_env(alloc: Allocation, task: Task, alloc_dir: str,
+                   task_dir: str, secrets_dir: str) -> Dict[str, str]:
+    env: Dict[str, str] = {
+        "NOMAD_ALLOC_DIR": alloc_dir,
+        "NOMAD_TASK_DIR": task_dir,
+        "NOMAD_SECRETS_DIR": secrets_dir,
+        "NOMAD_ALLOC_ID": alloc.id,
+        "NOMAD_ALLOC_NAME": alloc.name,
+        "NOMAD_ALLOC_INDEX": str(alloc.index()),
+        "NOMAD_TASK_NAME": task.name,
+        "NOMAD_GROUP_NAME": alloc.task_group,
+        "NOMAD_JOB_NAME": alloc.job.name if alloc.job else "",
+    }
+    resources = alloc.task_resources.get(task.name) or task.resources
+    if resources is not None:
+        env["NOMAD_CPU_LIMIT"] = str(resources.cpu)
+        env["NOMAD_MEMORY_LIMIT"] = str(resources.memory_mb)
+        for net in resources.networks:
+            env["NOMAD_IP"] = net.ip
+            for port in list(net.reserved_ports) + list(net.dynamic_ports):
+                label = port.label.upper().replace("-", "_")
+                env[f"NOMAD_PORT_{label}"] = str(port.value)
+                env[f"NOMAD_ADDR_{label}"] = f"{net.ip}:{port.value}"
+    # job/task/group meta, upper-cased (env.go meta handling)
+    metas = []
+    if alloc.job is not None:
+        metas.append(alloc.job.meta)
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        if tg is not None:
+            metas.append(tg.meta)
+    metas.append(task.meta)
+    for meta in metas:
+        for k, v in (meta or {}).items():
+            env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = v
+    env.update(task.env or {})
+    return env

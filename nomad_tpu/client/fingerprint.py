@@ -1,0 +1,121 @@
+"""Node fingerprinting: fill attributes/resources from the host.
+
+Reference: client/fingerprint/ (registry fingerprint.go:38-76; arch,
+cpu + MHz, memory, storage, host, network). Reads /proc and os APIs —
+no third-party deps.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import platform
+import shutil
+import socket
+from typing import Callable, Dict, List
+
+from ..structs import NetworkResource, Node, Resources
+
+
+def fingerprint_arch(node: Node) -> bool:
+    node.attributes["cpu.arch"] = platform.machine()
+    node.attributes["arch"] = platform.machine()
+    return True
+
+
+def fingerprint_cpu(node: Node) -> bool:
+    cores = multiprocessing.cpu_count()
+    node.attributes["cpu.numcores"] = str(cores)
+    mhz = 1000.0
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+    except (OSError, ValueError):
+        pass
+    node.attributes["cpu.frequency"] = str(int(mhz))
+    total = int(cores * mhz)
+    node.attributes["cpu.totalcompute"] = str(total)
+    if node.resources.cpu == 0:
+        node.resources.cpu = total
+    return True
+
+
+def fingerprint_memory(node: Node) -> bool:
+    total_mb = 1024
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total_mb = int(line.split()[1]) // 1024
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    node.attributes["memory.totalbytes"] = str(total_mb * 1024 * 1024)
+    if node.resources.memory_mb == 0:
+        node.resources.memory_mb = total_mb
+    return True
+
+
+def fingerprint_storage(node: Node) -> bool:
+    path = node.attributes.get("unique.storage.volume", "/")
+    try:
+        usage = shutil.disk_usage(path)
+        free_mb = usage.free // (1024 * 1024)
+    except OSError:
+        free_mb = 1024
+    node.attributes["unique.storage.bytesfree"] = str(free_mb * 1024 * 1024)
+    if node.resources.disk_mb == 0:
+        node.resources.disk_mb = free_mb
+    return True
+
+
+def fingerprint_host(node: Node) -> bool:
+    node.attributes["kernel.name"] = platform.system().lower()
+    node.attributes["kernel.version"] = platform.release()
+    node.attributes["os.name"] = platform.system().lower()
+    node.attributes["os.version"] = platform.version()
+    node.attributes["unique.hostname"] = socket.gethostname()
+    if not node.name:
+        node.name = socket.gethostname()
+    return True
+
+
+def fingerprint_network(node: Node) -> bool:
+    ip = "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+    except OSError:
+        pass
+    node.attributes["unique.network.ip-address"] = ip
+    if not node.resources.networks:
+        node.resources.networks = [
+            NetworkResource(device="eth0", cidr=f"{ip}/32", ip=ip, mbits=1000)
+        ]
+    return True
+
+
+BUILTIN_FINGERPRINTS: List[Callable[[Node], bool]] = [
+    fingerprint_arch,
+    fingerprint_cpu,
+    fingerprint_memory,
+    fingerprint_storage,
+    fingerprint_host,
+    fingerprint_network,
+]
+
+
+def fingerprint_node(node: Node) -> List[str]:
+    """Run all fingerprints; returns the list that applied."""
+    if node.resources is None:
+        node.resources = Resources()
+    applied = []
+    for fp in BUILTIN_FINGERPRINTS:
+        if fp(node):
+            applied.append(fp.__name__.removeprefix("fingerprint_"))
+    return applied

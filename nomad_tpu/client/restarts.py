@@ -1,0 +1,57 @@
+"""Restart policy tracker.
+
+Reference: client/restarts.go:221 — a budget of `attempts` restarts per
+`interval`; on exhaustion mode 'fail' stops the task, mode 'delay'
+waits out the remainder of the interval and resets the budget.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Tuple
+
+from ..structs import RestartPolicy, consts
+
+# Decision outcomes
+NO_RESTART = "no-restart"
+RESTART = "restart"
+
+JITTER_FRACTION = 0.25  # client/restarts.go jitter
+
+
+class RestartTracker:
+    def __init__(self, policy: RestartPolicy, job_type: str):
+        self.policy = policy
+        self.batch = job_type == consts.JOB_TYPE_BATCH
+        self.count = 0
+        self.start_time = time.time()
+
+    def _jitter(self, base: float) -> float:
+        return base + random.random() * JITTER_FRACTION * base
+
+    def next_restart(self, exit_successful: bool) -> Tuple[str, float]:
+        """Decide what happens after a task exit: (decision, wait)."""
+        # Service tasks always restart on success-exit too (they should
+        # never exit); batch tasks that succeed are done.
+        if self.batch and exit_successful:
+            return NO_RESTART, 0.0
+
+        now = time.time()
+        if self.policy.interval and now - self.start_time > self.policy.interval:
+            self.count = 0
+            self.start_time = now
+
+        self.count += 1
+        if self.policy.attempts <= 0 or self.count <= self.policy.attempts:
+            return RESTART, self._jitter(self.policy.delay)
+
+        if self.policy.mode == consts.RESTART_POLICY_MODE_FAIL:
+            return NO_RESTART, 0.0
+        # delay mode: wait out the interval, then start a fresh budget.
+        remaining = max(
+            (self.start_time + self.policy.interval) - now, self.policy.delay
+        )
+        self.count = 0
+        self.start_time = now + remaining
+        return RESTART, self._jitter(remaining)

@@ -1,0 +1,193 @@
+"""TaskRunner: per-task lifecycle state machine.
+
+Reference: client/task_runner.go:123 — Run:298 (validate -> prestart ->
+start -> wait/restart loop), shouldRestart:560, killTask:605, event
+handling for Update/Kill, and persisted driver handle ids for reattach
+(RestoreState:189).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..structs import (
+    Allocation,
+    Task,
+    TaskEvent,
+    TaskState,
+    consts,
+    new_task_event,
+)
+from .allocdir import TASK_LOCAL, TASK_SECRETS, AllocDir
+from .drivers import new_driver
+from .drivers.base import TaskContext, WaitResult
+from .env import build_task_env
+from .restarts import NO_RESTART, RestartTracker
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        task: Task,
+        alloc_dir: AllocDir,
+        update_cb: Callable[[str, TaskState], None],
+        max_kill_timeout: float = 30.0,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.alloc = alloc
+        self.task = task
+        self.alloc_dir = alloc_dir
+        self.update_cb = update_cb
+        self.max_kill_timeout = max_kill_timeout
+        self.logger = logger or logging.getLogger(f"nomad_tpu.task.{task.name}")
+
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        self.restart_tracker = RestartTracker(
+            tg.restart_policy, alloc.job.type
+        )
+
+        self.state = TaskState()
+        self.handle = None
+        self.handle_id = ""
+        self._kill = threading.Event()
+        self._destroy_event: Optional[TaskEvent] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"task-{self.alloc.id[:8]}-{self.task.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def kill(self, event: Optional[TaskEvent] = None) -> None:
+        with self._lock:
+            self._destroy_event = event or new_task_event(consts.TASK_EVENT_KILLING)
+        self._kill.set()
+        if self.handle is not None:
+            kill_timeout = min(self.task.kill_timeout, self.max_kill_timeout)
+            try:
+                self.handle.kill(kill_timeout)
+            except Exception:
+                self.logger.exception("kill failed")
+
+    # ------------------------------------------------------------------
+
+    def _emit(self, state: str, event: Optional[TaskEvent] = None,
+              failed: Optional[bool] = None) -> None:
+        self.state.state = state
+        if failed is not None:
+            self.state.failed = failed
+        if event is not None:
+            self.state.events.append(event)
+            if len(self.state.events) > 10:  # bounded (structs.go maxTaskEvents)
+                self.state.events = self.state.events[-10:]
+        self.update_cb(self.task.name, self.state)
+
+    def run(self) -> None:
+        # validate
+        errors = self.task.validate()
+        if errors:
+            ev = new_task_event(consts.TASK_EVENT_FAILED_VALIDATION)
+            ev.validation_error = "; ".join(errors)
+            self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
+            return
+
+        task_dir = self.alloc_dir.task_dirs[self.task.name]
+        ctx = TaskContext(
+            alloc_id=self.alloc.id,
+            alloc_dir=self.alloc_dir.shared_dir,
+            task_dir=os.path.join(task_dir, TASK_LOCAL),
+            log_dir=self.alloc_dir.log_dir(),
+            env=build_task_env(
+                self.alloc, self.task, self.alloc_dir.shared_dir,
+                os.path.join(task_dir, TASK_LOCAL),
+                os.path.join(task_dir, TASK_SECRETS),
+            ),
+            max_kill_timeout=self.max_kill_timeout,
+        )
+
+        try:
+            driver = new_driver(self.task.driver)
+        except ValueError as e:
+            ev = new_task_event(consts.TASK_EVENT_DRIVER_FAILURE)
+            ev.driver_error = str(e)
+            self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
+            return
+
+        while not self._kill.is_set():
+            # start
+            try:
+                self.handle = driver.start(ctx, self.task)
+                self.handle_id = self.handle.id()
+            except Exception as e:  # noqa: BLE001 - driver start errors
+                ev = new_task_event(consts.TASK_EVENT_DRIVER_FAILURE)
+                ev.driver_error = str(e)
+                self._emit(consts.TASK_STATE_PENDING, ev)
+                result = WaitResult(exit_code=-1, error=str(e))
+            else:
+                self._emit(consts.TASK_STATE_RUNNING, new_task_event(consts.TASK_EVENT_STARTED))
+                result = None
+                while result is None and not self._kill.is_set():
+                    result = self.handle.wait(timeout=0.25)
+                if result is None:
+                    # killed: wait for the handle to finish dying
+                    result = self.handle.wait(timeout=self.max_kill_timeout) or WaitResult(
+                        exit_code=-1, signal=9
+                    )
+
+            if self._kill.is_set():
+                with self._lock:
+                    destroy_ev = self._destroy_event
+                self._emit(
+                    consts.TASK_STATE_DEAD,
+                    destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
+                    failed=False,
+                )
+                return
+
+            # terminated: record and consult the restart policy
+            ev = new_task_event(consts.TASK_EVENT_TERMINATED)
+            ev.exit_code = result.exit_code
+            ev.signal = result.signal
+            ev.message = result.error
+            self._emit(consts.TASK_STATE_PENDING, ev)
+
+            decision, wait = self.restart_tracker.next_restart(result.successful())
+            if decision == NO_RESTART:
+                self._emit(
+                    consts.TASK_STATE_DEAD,
+                    new_task_event(consts.TASK_EVENT_NOT_RESTARTING),
+                    failed=not result.successful(),
+                )
+                return
+
+            restart_ev = new_task_event(consts.TASK_EVENT_RESTARTING)
+            restart_ev.start_delay = wait
+            self._emit(consts.TASK_STATE_PENDING, restart_ev)
+            if self._kill.wait(wait):
+                self._emit(consts.TASK_STATE_DEAD,
+                           new_task_event(consts.TASK_EVENT_KILLED), failed=False)
+                return
+
+    # ------------------------------------------------------------------
+
+    def persist(self) -> dict:
+        return {
+            "task": self.task.name,
+            "handle_id": self.handle_id,
+            "state": self.state.state,
+            "failed": self.state.failed,
+        }

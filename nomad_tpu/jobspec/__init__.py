@@ -1,0 +1,4 @@
+from .parse import parse, parse_file
+from .hcl import HCLParseError, parse_hcl
+
+__all__ = ["parse", "parse_file", "parse_hcl", "HCLParseError"]

@@ -1,0 +1,218 @@
+"""A small HCL (HashiCorp Configuration Language v1) reader.
+
+Supports the subset job specs use (reference jobspec/parse.go consumes
+hashicorp/hcl): blocks with 0+ string labels, `key = value` attributes,
+strings/numbers/bools/lists/objects, `#`, `//` and `/* */` comments.
+Repeated blocks accumulate into lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class HCLParseError(ValueError):
+    def __init__(self, message: str, line: int):
+        super().__init__(f"line {line}: {message}")
+        self.line = line
+
+
+# ---------------------------------------------------------------- lexer
+
+_PUNCT = {"{", "}", "[", "]", "=", ","}
+
+
+def _tokenize(src: str) -> List[Tuple[str, Any, int]]:
+    """Returns (kind, value, line) tokens. Kinds: punct, string, number,
+    bool, ident."""
+    tokens: List[Tuple[str, Any, int]] = []
+    i, n, line = 0, len(src), 1
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if c == "#" or src.startswith("//", i):
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if src.startswith("/*", i):
+            end = src.find("*/", i + 2)
+            if end == -1:
+                raise HCLParseError("unterminated block comment", line)
+            line += src.count("\n", i, end)
+            i = end + 2
+            continue
+        if c in _PUNCT:
+            tokens.append(("punct", c, line))
+            i += 1
+            continue
+        if c == '"':
+            j = i + 1
+            buf = []
+            while j < n and src[j] != '"':
+                if src[j] == "\\" and j + 1 < n:
+                    esc = src[j + 1]
+                    buf.append(
+                        {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(esc, esc)
+                    )
+                    j += 2
+                    continue
+                if src[j] == "\n":
+                    raise HCLParseError("newline in string", line)
+                buf.append(src[j])
+                j += 1
+            if j >= n:
+                raise HCLParseError("unterminated string", line)
+            tokens.append(("string", "".join(buf), line))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "-" and i + 1 < n and src[i + 1].isdigit()):
+            j = i + 1
+            while j < n and (src[j].isdigit() or src[j] in ".eE+-"):
+                # stop '-'/'+' unless part of exponent
+                if src[j] in "+-" and src[j - 1] not in "eE":
+                    break
+                j += 1
+            text = src[i:j]
+            try:
+                value: Any = int(text)
+            except ValueError:
+                try:
+                    value = float(text)
+                except ValueError:
+                    raise HCLParseError(f"bad number {text!r}", line) from None
+            tokens.append(("number", value, line))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] in "_.-"):
+                j += 1
+            word = src[i:j]
+            if word in ("true", "false"):
+                tokens.append(("bool", word == "true", line))
+            else:
+                tokens.append(("ident", word, line))
+            i = j
+            continue
+        raise HCLParseError(f"unexpected character {c!r}", line)
+    return tokens
+
+
+# --------------------------------------------------------------- parser
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, Any, int]]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def _peek(self) -> Optional[Tuple[str, Any, int]]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def _next(self) -> Tuple[str, Any, int]:
+        tok = self._peek()
+        if tok is None:
+            last_line = self.tokens[-1][2] if self.tokens else 0
+            raise HCLParseError("unexpected end of input", last_line)
+        self.pos += 1
+        return tok
+
+    def _expect_punct(self, which: str) -> None:
+        kind, value, line = self._next()
+        if kind != "punct" or value != which:
+            raise HCLParseError(f"expected {which!r}, got {value!r}", line)
+
+    def parse_body(self, until_brace: bool) -> Dict[str, Any]:
+        """A body is a sequence of `key = value` attrs and `key
+        ["label"...] { ... }` blocks. Repeated keys accumulate lists."""
+        out: Dict[str, Any] = {}
+        while True:
+            tok = self._peek()
+            if tok is None:
+                if until_brace:
+                    raise HCLParseError("missing closing '}'", self.tokens[-1][2])
+                return out
+            kind, value, line = tok
+            if kind == "punct" and value == "}":
+                if not until_brace:
+                    raise HCLParseError("unexpected '}'", line)
+                self._next()
+                return out
+            if kind not in ("ident", "string"):
+                raise HCLParseError(f"expected key, got {value!r}", line)
+            self._next()
+            key = value
+            self._parse_entry(out, key, line)
+
+    def _parse_entry(self, out: Dict[str, Any], key: str, line: int) -> None:
+        labels: List[str] = []
+        while True:
+            tok = self._peek()
+            if tok is None:
+                raise HCLParseError(f"dangling key {key!r}", line)
+            kind, value, tline = tok
+            if kind == "punct" and value == "=":
+                self._next()
+                self._store(out, key, self.parse_value())
+                return
+            if kind == "punct" and value == "{":
+                self._next()
+                body = self.parse_body(until_brace=True)
+                # labels nest: job "x" { } -> {"job": {"x": {...}}}
+                node: Any = body
+                for label in reversed(labels):
+                    node = {label: node}
+                self._store(out, key, node)
+                return
+            if kind == "string":
+                self._next()
+                labels.append(value)
+                continue
+            raise HCLParseError(
+                f"expected '=', '{{' or label after {key!r}, got {value!r}", tline
+            )
+
+    @staticmethod
+    def _store(out: Dict[str, Any], key: str, value: Any) -> None:
+        if key in out:
+            existing = out[key]
+            if isinstance(existing, list):
+                existing.append(value)
+            else:
+                out[key] = [existing, value]
+        else:
+            out[key] = value
+
+    def parse_value(self) -> Any:
+        kind, value, line = self._next()
+        if kind in ("string", "number", "bool"):
+            return value
+        if kind == "ident":
+            return value  # bare identifier treated as string
+        if kind == "punct" and value == "[":
+            items: List[Any] = []
+            while True:
+                tok = self._peek()
+                if tok is None:
+                    raise HCLParseError("unterminated list", line)
+                if tok[0] == "punct" and tok[1] == "]":
+                    self._next()
+                    return items
+                items.append(self.parse_value())
+                tok = self._peek()
+                if tok and tok[0] == "punct" and tok[1] == ",":
+                    self._next()
+        if kind == "punct" and value == "{":
+            return self.parse_body(until_brace=True)
+        raise HCLParseError(f"unexpected value {value!r}", line)
+
+
+def parse_hcl(src: str) -> Dict[str, Any]:
+    tokens = _tokenize(src)
+    return _Parser(tokens).parse_body(until_brace=False)

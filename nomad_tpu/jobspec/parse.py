@@ -1,0 +1,323 @@
+"""Job spec -> structs.Job translation.
+
+Reference: jobspec/parse.go:28 (Parse), :71 (ParseFile); block grammar
+:86-1202 (job/group/task/resources/network/constraint/restart/
+ephemeral_disk/artifact/template/service/check/update/periodic/vault/
+meta/logs) with strict key validation (checkHCLKeys:1202).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from ..structs import (
+    Constraint,
+    EphemeralDisk,
+    Job,
+    LogConfig,
+    NetworkResource,
+    PeriodicConfig,
+    Port,
+    Resources,
+    RestartPolicy,
+    Service,
+    ServiceCheck,
+    Task,
+    TaskArtifact,
+    TaskGroup,
+    Template,
+    UpdateStrategy,
+    Vault,
+    consts,
+)
+from .hcl import parse_hcl
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0,
+    "m": 60.0, "h": 3600.0,
+}
+
+
+def parse_duration(value: Any) -> float:
+    """Go-style durations: '30s', '10m', '1h30m', or bare numbers
+    (seconds)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    text = str(value).strip()
+    if not text:
+        return 0.0
+    matches = _DURATION_RE.findall(text)
+    if not matches or "".join(f"{n}{u}" for n, u in matches) != text:
+        raise ValueError(f"invalid duration {value!r}")
+    return sum(float(n) * _DURATION_UNITS[u] for n, u in matches)
+
+
+def _listify(value: Any) -> List[Any]:
+    if value is None:
+        return []
+    return value if isinstance(value, list) else [value]
+
+
+def _check_keys(block: Dict[str, Any], valid: List[str], context: str) -> None:
+    invalid = [k for k in block if k not in valid]
+    if invalid:
+        raise ValueError(f"invalid key(s) {invalid} in {context}")
+
+
+def parse(src: str) -> Job:
+    """Parse an HCL job spec into a Job."""
+    root = parse_hcl(src)
+    if "job" not in root:
+        raise ValueError("'job' block not found")
+    job_block = root["job"]
+    if not isinstance(job_block, dict) or len(job_block) != 1:
+        raise ValueError("exactly one job block with a name label required")
+    (job_id, body), = job_block.items()
+    return _parse_job(job_id, body)
+
+
+def parse_file(path: str) -> Job:
+    with open(path) as f:
+        return parse(f.read())
+
+
+def _parse_job(job_id: str, body: Dict[str, Any]) -> Job:
+    _check_keys(
+        body,
+        ["id", "name", "region", "all_at_once", "constraint", "datacenters",
+         "group", "meta", "periodic", "priority", "task", "type", "update",
+         "vault_token"],
+        f"job {job_id!r}",
+    )
+    job = Job(
+        id=body.get("id", job_id),
+        name=body.get("name", job_id),
+        region=body.get("region", "global"),
+        type=body.get("type", consts.JOB_TYPE_SERVICE),
+        priority=int(body.get("priority", consts.JOB_DEFAULT_PRIORITY)),
+        all_at_once=bool(body.get("all_at_once", False)),
+        datacenters=_listify(body.get("datacenters")),
+        vault_token=body.get("vault_token", ""),
+        meta={k: str(v) for k, v in (body.get("meta") or {}).items()},
+    )
+    job.constraints = _parse_constraints(body.get("constraint"))
+    if "update" in body:
+        u = body["update"]
+        _check_keys(u, ["stagger", "max_parallel"], "update")
+        job.update = UpdateStrategy(
+            stagger=parse_duration(u.get("stagger", 0)),
+            max_parallel=int(u.get("max_parallel", 0)),
+        )
+    if "periodic" in body:
+        p = body["periodic"]
+        _check_keys(p, ["cron", "prohibit_overlap", "enabled"], "periodic")
+        job.periodic = PeriodicConfig(
+            enabled=bool(p.get("enabled", True)),
+            spec=p.get("cron", ""),
+            prohibit_overlap=bool(p.get("prohibit_overlap", False)),
+        )
+
+    # groups; bare tasks at job level get an implicit group per task
+    # (parse.go behavior).
+    for name, group_body in _labeled_blocks(body.get("group")):
+        job.task_groups.append(_parse_group(name, group_body))
+    for name, task_body in _labeled_blocks(body.get("task")):
+        job.task_groups.append(
+            TaskGroup(name=name, count=1, tasks=[_parse_task(name, task_body)])
+        )
+    job.canonicalize()
+    return job
+
+
+def _labeled_blocks(node: Any):
+    """Yield (label, body) for possibly-repeated labeled blocks."""
+    if node is None:
+        return
+    for item in _listify(node):
+        if not isinstance(item, dict):
+            raise ValueError(f"expected labeled block, got {item!r}")
+        for label, body in item.items():
+            yield label, body
+
+
+def _parse_constraints(node: Any) -> List[Constraint]:
+    out = []
+    for block in _listify(node):
+        _check_keys(
+            block,
+            ["attribute", "operator", "value", "version", "regexp",
+             "distinct_hosts"],
+            "constraint",
+        )
+        c = Constraint(
+            ltarget=block.get("attribute", ""),
+            rtarget=str(block.get("value", "")),
+            operand=block.get("operator", "="),
+        )
+        if "version" in block:
+            c.operand = consts.CONSTRAINT_VERSION
+            c.rtarget = str(block["version"])
+        elif "regexp" in block:
+            c.operand = consts.CONSTRAINT_REGEX
+            c.rtarget = str(block["regexp"])
+        elif block.get("distinct_hosts"):
+            c.operand = consts.CONSTRAINT_DISTINCT_HOSTS
+        out.append(c)
+    return out
+
+
+def _parse_group(name: str, body: Dict[str, Any]) -> TaskGroup:
+    _check_keys(
+        body,
+        ["count", "constraint", "restart", "meta", "task", "ephemeral_disk"],
+        f"group {name!r}",
+    )
+    tg = TaskGroup(
+        name=name,
+        count=int(body.get("count", 1)),
+        meta={k: str(v) for k, v in (body.get("meta") or {}).items()},
+    )
+    tg.constraints = _parse_constraints(body.get("constraint"))
+    if "restart" in body:
+        r = body["restart"]
+        _check_keys(r, ["attempts", "interval", "delay", "mode"], "restart")
+        tg.restart_policy = RestartPolicy(
+            attempts=int(r.get("attempts", 0)),
+            interval=parse_duration(r.get("interval", 0)),
+            delay=parse_duration(r.get("delay", 0)),
+            mode=r.get("mode", consts.RESTART_POLICY_MODE_FAIL),
+        )
+    if "ephemeral_disk" in body:
+        d = body["ephemeral_disk"]
+        _check_keys(d, ["sticky", "migrate", "size"], "ephemeral_disk")
+        tg.ephemeral_disk = EphemeralDisk(
+            sticky=bool(d.get("sticky", False)),
+            migrate=bool(d.get("migrate", False)),
+            size_mb=int(d.get("size", 300)),
+        )
+    for task_name, task_body in _labeled_blocks(body.get("task")):
+        tg.tasks.append(_parse_task(task_name, task_body))
+    return tg
+
+
+def _parse_task(name: str, body: Dict[str, Any]) -> Task:
+    _check_keys(
+        body,
+        ["driver", "user", "config", "env", "service", "constraint", "meta",
+         "resources", "kill_timeout", "logs", "artifact", "template", "vault"],
+        f"task {name!r}",
+    )
+    task = Task(
+        name=name,
+        driver=body.get("driver", ""),
+        user=body.get("user", ""),
+        config=dict(body.get("config") or {}),
+        env={k: str(v) for k, v in (body.get("env") or {}).items()},
+        meta={k: str(v) for k, v in (body.get("meta") or {}).items()},
+        kill_timeout=parse_duration(body.get("kill_timeout", 5)),
+    )
+    task.constraints = _parse_constraints(body.get("constraint"))
+    if "resources" in body:
+        task.resources = _parse_resources(body["resources"])
+    if "logs" in body:
+        lg = body["logs"]
+        _check_keys(lg, ["max_files", "max_file_size"], "logs")
+        task.log_config = LogConfig(
+            max_files=int(lg.get("max_files", 10)),
+            max_file_size_mb=int(lg.get("max_file_size", 10)),
+        )
+    for svc in _listify(body.get("service")):
+        task.services.append(_parse_service(task.name, svc))
+    for art in _listify(body.get("artifact")):
+        _check_keys(art, ["source", "options", "destination"], "artifact")
+        task.artifacts.append(
+            TaskArtifact(
+                getter_source=art.get("source", ""),
+                getter_options={
+                    k: str(v) for k, v in (art.get("options") or {}).items()
+                },
+                relative_dest=art.get("destination", "local/"),
+            )
+        )
+    for tmpl in _listify(body.get("template")):
+        _check_keys(
+            tmpl,
+            ["source", "destination", "data", "change_mode", "change_signal",
+             "splay"],
+            "template",
+        )
+        task.templates.append(
+            Template(
+                source_path=tmpl.get("source", ""),
+                dest_path=tmpl.get("destination", ""),
+                embedded_tmpl=tmpl.get("data", ""),
+                change_mode=tmpl.get("change_mode", "restart"),
+                change_signal=tmpl.get("change_signal", ""),
+                splay=parse_duration(tmpl.get("splay", 5)),
+            )
+        )
+    if "vault" in body:
+        v = body["vault"]
+        _check_keys(v, ["policies", "env", "change_mode", "change_signal"], "vault")
+        task.vault = Vault(
+            policies=_listify(v.get("policies")),
+            env=bool(v.get("env", True)),
+            change_mode=v.get("change_mode", "restart"),
+            change_signal=v.get("change_signal", ""),
+        )
+    return task
+
+
+def _parse_resources(body: Dict[str, Any]) -> Resources:
+    _check_keys(body, ["cpu", "memory", "disk", "iops", "network"], "resources")
+    res = Resources(
+        cpu=int(body.get("cpu", Resources.DEFAULT_CPU)),
+        memory_mb=int(body.get("memory", Resources.DEFAULT_MEMORY_MB)),
+        disk_mb=int(body.get("disk", 0)),
+        iops=int(body.get("iops", 0)),
+    )
+    for net in _listify(body.get("network")):
+        _check_keys(net, ["mbits", "port"], "network")
+        nr = NetworkResource(mbits=int(net.get("mbits", 10)))
+        for label, port_body in _labeled_blocks(net.get("port")):
+            port_body = port_body or {}
+            _check_keys(port_body, ["static"], f"port {label!r}")
+            if "static" in port_body:
+                nr.reserved_ports.append(Port(label, int(port_body["static"])))
+            else:
+                nr.dynamic_ports.append(Port(label, 0))
+        res.networks.append(nr)
+    return res
+
+
+def _parse_service(task_name: str, body: Dict[str, Any]) -> Service:
+    _check_keys(body, ["name", "tags", "port", "check"], "service")
+    svc = Service(
+        name=body.get("name", f"{task_name}-service"),
+        port_label=str(body.get("port", "")),
+        tags=[str(t) for t in _listify(body.get("tags"))],
+    )
+    for check in _listify(body.get("check")):
+        _check_keys(
+            check,
+            ["name", "type", "command", "args", "path", "protocol", "port",
+             "interval", "timeout", "initial_status"],
+            "check",
+        )
+        svc.checks.append(
+            ServiceCheck(
+                name=check.get("name", f"{svc.name}-check"),
+                type=check.get("type", ""),
+                command=check.get("command", ""),
+                args=[str(a) for a in _listify(check.get("args"))],
+                path=check.get("path", ""),
+                protocol=check.get("protocol", ""),
+                port_label=str(check.get("port", "")),
+                interval=parse_duration(check.get("interval", 0)),
+                timeout=parse_duration(check.get("timeout", 0)),
+                initial_status=check.get("initial_status", ""),
+            )
+        )
+    return svc

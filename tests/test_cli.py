@@ -1,0 +1,162 @@
+"""CLI tests against a live dev agent (mirror command/*_test.go)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.cli.main import main
+from nomad_tpu.client import ClientAgent, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def agent(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    cfg = ClientConfig(
+        servers=[http.addr],
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        dev_mode=True,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    client_agent = ClientAgent(cfg)
+    client_agent.start()
+    yield http.addr, server
+    client_agent.shutdown(destroy_allocs=True)
+    http.stop()
+    server.shutdown()
+
+
+def run_cli(addr, *argv):
+    return main(["--address", addr, *argv])
+
+
+def test_init_validate(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    assert main(["init"]) == 0
+    assert os.path.exists("example.nomad")
+    assert main(["validate", "example.nomad"]) == 0
+    out = capsys.readouterr().out
+    assert "validation successful" in out
+
+
+def test_run_status_stop(agent, tmp_path, capsys):
+    addr, server = agent
+    spec = tmp_path / "job.nomad"
+    spec.write_text(
+        'job "cli-test" { datacenters = ["dc1"] type = "service" '
+        'group "g" { count = 2 task "t" { driver = "mock_driver" '
+        'config { run_for = 3600 } resources { cpu = 100 memory = 64 } } } }'
+    )
+    assert run_cli(addr, "run", str(spec)) == 0
+    out = capsys.readouterr().out
+    assert "finished with status \"complete\"" in out
+
+    assert wait_until(
+        lambda: all(
+            a.client_status == consts.ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job("cli-test")
+        )
+        and len(server.fsm.state.allocs_by_job("cli-test")) == 2
+    )
+
+    assert run_cli(addr, "status") == 0
+    assert "cli-test" in capsys.readouterr().out
+
+    assert run_cli(addr, "status", "cli-test") == 0
+    out = capsys.readouterr().out
+    assert "Task Group" in out and "running" in out
+
+    assert run_cli(addr, "stop", "cli-test") == 0
+
+
+def test_plan_shows_placements_and_failures(agent, tmp_path, capsys):
+    addr, server = agent
+    spec = tmp_path / "plan.nomad"
+    spec.write_text(
+        'job "plan-test" { datacenters = ["dc1"] '
+        'group "g" { count = 3 task "t" { driver = "mock_driver" '
+        'resources { cpu = 100 memory = 64 } } } }'
+    )
+    assert run_cli(addr, "plan", str(spec)) == 0
+    out = capsys.readouterr().out
+    assert "place: 3" in out
+    assert "All tasks successfully allocated" in out
+
+    bad = tmp_path / "bad.nomad"
+    bad.write_text(
+        'job "bad-plan" { datacenters = ["dc1"] '
+        'constraint { attribute = "${attr.kernel.name}" value = "plan9" } '
+        'group "g" { task "t" { driver = "mock_driver" '
+        'resources { cpu = 100 memory = 64 } } } }'
+    )
+    assert run_cli(addr, "plan", str(bad)) == 0
+    out = capsys.readouterr().out
+    assert "Placement failures" in out
+
+
+def test_node_commands(agent, capsys):
+    addr, server = agent
+    assert run_cli(addr, "node-status") == 0
+    out = capsys.readouterr().out
+    assert "ready" in out
+    node_id = server.fsm.state.nodes()[0].id
+
+    assert run_cli(addr, "node-status", node_id) == 0
+    out = capsys.readouterr().out
+    assert "mock_driver" in out
+
+    assert run_cli(addr, "node-drain", node_id, "-enable") == 0
+    assert wait_until(lambda: server.fsm.state.node_by_id(node_id).drain)
+    assert run_cli(addr, "node-drain", node_id, "-disable") == 0
+
+
+def test_alloc_and_eval_status(agent, tmp_path, capsys):
+    addr, server = agent
+    spec = tmp_path / "a.nomad"
+    spec.write_text(
+        'job "alloc-test" { datacenters = ["dc1"] '
+        'group "g" { task "t" { driver = "mock_driver" '
+        'config { run_for = 3600 } resources { cpu = 50 memory = 32 } } } }'
+    )
+    assert run_cli(addr, "run", str(spec)) == 0
+    capsys.readouterr()
+    assert wait_until(lambda: server.fsm.state.allocs_by_job("alloc-test"))
+    alloc = server.fsm.state.allocs_by_job("alloc-test")[0]
+
+    assert run_cli(addr, "alloc-status", alloc.id, "-verbose") == 0
+    out = capsys.readouterr().out
+    assert alloc.id in out
+    assert "Placement Metrics" in out
+
+    assert run_cli(addr, "eval-status", alloc.eval_id) == 0
+    out = capsys.readouterr().out
+    assert "complete" in out
+
+    assert run_cli(addr, "inspect", "alloc-test") == 0
+    assert '"id": "alloc-test"' in capsys.readouterr().out
+
+    assert run_cli(addr, "agent-info") == 0
+    assert '"leader": true' in capsys.readouterr().out
+
+
+def test_unknown_job_errors(agent, capsys):
+    addr, _ = agent
+    assert run_cli(addr, "status", "nope") == 1
+    assert "Error" in capsys.readouterr().err

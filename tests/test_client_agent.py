@@ -1,0 +1,202 @@
+"""Client agent tests: real task execution end-to-end (mirror
+client/client_test.go, task_runner_test.go, alloc_runner_test.go)."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.client import ClientAgent, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    cfg = ClientConfig(
+        servers=[http.addr],
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        options={"driver.raw_exec.enable": "1"},
+        dev_mode=True,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    agent.start()
+    yield server, agent
+    agent.shutdown(destroy_allocs=True)
+    http.stop()
+    server.shutdown()
+
+
+def mock_driver_job(run_for=1e9, exit_code=0, count=1, job_type="service"):
+    job = mock.job()
+    job.type = job_type
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for, "exit_code": exit_code}
+    task.resources.networks = []
+    if job_type == "batch":
+        tg.restart_policy.attempts = 0
+        tg.restart_policy.mode = "fail"
+    return job
+
+
+def test_client_registers_with_fingerprints(cluster):
+    server, agent = cluster
+    node = server.fsm.state.node_by_id(agent.node.id)
+    assert node is not None
+    assert node.status == consts.NODE_STATUS_READY
+    assert node.attributes.get("driver.mock_driver") == "1"
+    assert node.attributes.get("driver.raw_exec") == "1"
+    assert node.attributes.get("kernel.name") == "linux"
+    assert node.resources.cpu > 0 and node.resources.memory_mb > 0
+
+
+def test_service_job_runs_tasks(cluster):
+    server, agent = cluster
+    job = mock_driver_job()
+    server.job_register(job)
+    assert wait_until(
+        lambda: any(
+            a.client_status == consts.ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+    )
+    alloc = server.fsm.state.allocs_by_job(job.id)[0]
+    assert alloc.task_states["web"].state == consts.TASK_STATE_RUNNING
+    assert server.fsm.state.job_summary_by_id(job.id).summary["web"].running == 1
+
+
+def test_batch_job_completes(cluster):
+    server, agent = cluster
+    job = mock_driver_job(run_for=0.2, job_type="batch")
+    server.job_register(job)
+    assert wait_until(
+        lambda: all(
+            a.client_status == consts.ALLOC_CLIENT_COMPLETE
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+        and len(server.fsm.state.allocs_by_job(job.id)) == 1
+    )
+    alloc = server.fsm.state.allocs_by_job(job.id)[0]
+    assert alloc.task_states["web"].successful()
+    assert server.fsm.state.job_by_id(job.id).status == consts.JOB_STATUS_DEAD
+
+
+def test_raw_exec_runs_real_process(cluster):
+    server, agent = cluster
+    job = mock_driver_job(job_type="batch")
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", "echo hello-from-$NOMAD_TASK_NAME > $NOMAD_TASK_DIR/out.txt"],
+    }
+    server.job_register(job)
+    assert wait_until(
+        lambda: all(
+            a.client_status == consts.ALLOC_CLIENT_COMPLETE
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+        and len(server.fsm.state.allocs_by_job(job.id)) == 1
+    )
+    alloc = server.fsm.state.allocs_by_job(job.id)[0]
+    runner = agent.alloc_runners[alloc.id]
+    out = runner.alloc_dir.read_at(f"web/local/out.txt").decode()
+    assert out.strip() == "hello-from-web"
+    # stdout/stderr files exist in the shared log dir
+    logs = runner.alloc_dir.list_dir("alloc/logs")
+    assert any(f["name"] == "web.stdout.0" for f in logs)
+
+
+def test_failed_task_restarts_then_fails(cluster):
+    server, agent = cluster
+    job = mock_driver_job(run_for=0.05, exit_code=1, job_type="batch")
+    tg = job.task_groups[0]
+    tg.restart_policy.attempts = 2
+    tg.restart_policy.interval = 60.0
+    tg.restart_policy.delay = 0.05
+    tg.restart_policy.mode = "fail"
+    server.job_register(job)
+    assert wait_until(
+        lambda: any(
+            a.client_status == consts.ALLOC_CLIENT_FAILED
+            for a in server.fsm.state.allocs_by_job(job.id)
+        ),
+        timeout=15.0,
+    )
+    alloc = next(
+        a for a in server.fsm.state.allocs_by_job(job.id)
+        if a.client_status == consts.ALLOC_CLIENT_FAILED
+    )
+    ts = alloc.task_states["web"]
+    assert ts.failed
+    restarts = [e for e in ts.events if e.type == consts.TASK_EVENT_RESTARTING]
+    assert len(restarts) == 2  # the restart budget was consumed
+    assert any(e.type == consts.TASK_EVENT_NOT_RESTARTING for e in ts.events)
+
+
+def test_job_stop_kills_tasks(cluster):
+    server, agent = cluster
+    job = mock_driver_job()
+    server.job_register(job)
+    assert wait_until(
+        lambda: any(
+            a.client_status == consts.ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+    )
+    server.job_deregister(job.id)
+    assert wait_until(
+        lambda: all(
+            a.client_status in (consts.ALLOC_CLIENT_COMPLETE,)
+            for a in server.fsm.state.allocs_by_job(job.id)
+        ),
+        timeout=10.0,
+    )
+
+
+def test_client_state_persists_node_identity(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    try:
+        cfg = ClientConfig(
+            servers=[http.addr],
+            state_dir=str(tmp_path / "st"),
+            alloc_dir=str(tmp_path / "al"),
+            dev_mode=True,
+        )
+        os.makedirs(cfg.state_dir, exist_ok=True)
+        a1 = ClientAgent(cfg)
+        a1.start()
+        node_id = a1.node.id
+        a1.shutdown()
+
+        a2 = ClientAgent(cfg)
+        assert a2.node.id == node_id  # identity restored from disk
+        a2.start()
+        a2.shutdown()
+    finally:
+        http.stop()
+        server.shutdown()

@@ -1,0 +1,249 @@
+"""Jobspec parser tests (mirror jobspec/parse_test.go)."""
+
+import pytest
+
+from nomad_tpu.jobspec import HCLParseError, parse, parse_hcl
+from nomad_tpu.jobspec.parse import parse_duration
+from nomad_tpu.structs import consts
+
+FULL_SPEC = """
+# full example spec
+job "binstore-storagelocker" {
+  region = "global"
+  type = "service"
+  priority = 52
+  all_at_once = true
+  datacenters = ["us2", "eu1"]
+
+  meta {
+    foo = "bar"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.os}"
+    value = "windows"
+  }
+
+  update {
+    stagger = "60s"
+    max_parallel = 2
+  }
+
+  group "binsl" {
+    count = 5
+
+    restart {
+      attempts = 5
+      interval = "10m"
+      delay = "15s"
+      mode = "delay"
+    }
+
+    ephemeral_disk {
+      sticky = true
+      size = 150
+    }
+
+    constraint {
+      attribute = "${node.class}"
+      value = "fast"
+    }
+
+    task "binstore" {
+      driver = "docker"
+      user = "bob"
+
+      config {
+        image = "hashicorp/binstore"
+      }
+
+      env {
+        HELLO = "world"
+        LOREM = "ipsum"
+      }
+
+      service {
+        name = "binstore"
+        tags = ["foo", "bar"]
+        port = "http"
+
+        check {
+          name = "check-name"
+          type = "tcp"
+          interval = "10s"
+          timeout = "2s"
+        }
+      }
+
+      resources {
+        cpu = 500
+        memory = 128
+
+        network {
+          mbits = 100
+          port "one" { static = 1 }
+          port "three" { static = 3 }
+          port "http" {}
+          port "https" {}
+          port "admin" {}
+        }
+      }
+
+      kill_timeout = "22s"
+
+      logs {
+        max_files = 10
+        max_file_size = 100
+      }
+
+      artifact {
+        source = "http://foo.com/artifact"
+        options {
+          checksum = "md5:b8a4f3f72ecab0510a6a31e997461c5f"
+        }
+      }
+    }
+
+    task "storagelocker" {
+      driver = "java"
+
+      config {
+        jar_path = "local/x.jar"
+      }
+
+      resources {
+        cpu = 500
+        memory = 25
+      }
+
+      constraint {
+        attribute = "${attr.kernel.arch}"
+        value = "amd64"
+      }
+    }
+  }
+}
+"""
+
+
+def test_parse_full_spec():
+    job = parse(FULL_SPEC)
+    assert job.id == "binstore-storagelocker"
+    assert job.region == "global"
+    assert job.priority == 52
+    assert job.all_at_once is True
+    assert job.datacenters == ["us2", "eu1"]
+    assert job.meta == {"foo": "bar"}
+    assert len(job.constraints) == 1
+    assert job.constraints[0].ltarget == "${attr.kernel.os}"
+    assert job.update.stagger == 60.0
+    assert job.update.max_parallel == 2
+
+    assert len(job.task_groups) == 1
+    tg = job.task_groups[0]
+    assert tg.name == "binsl" and tg.count == 5
+    assert tg.restart_policy.attempts == 5
+    assert tg.restart_policy.interval == 600.0
+    assert tg.restart_policy.mode == "delay"
+    assert tg.ephemeral_disk.sticky and tg.ephemeral_disk.size_mb == 150
+
+    assert len(tg.tasks) == 2
+    task = tg.tasks[0]
+    assert task.name == "binstore"
+    assert task.driver == "docker"
+    assert task.user == "bob"
+    assert task.config["image"] == "hashicorp/binstore"
+    assert task.env == {"HELLO": "world", "LOREM": "ipsum"}
+    assert task.kill_timeout == 22.0
+    assert task.log_config.max_file_size_mb == 100
+    assert len(task.artifacts) == 1
+    assert task.artifacts[0].getter_options["checksum"].startswith("md5:")
+
+    res = task.resources
+    assert res.cpu == 500 and res.memory_mb == 128
+    net = res.networks[0]
+    assert net.mbits == 100
+    assert [p.label for p in net.reserved_ports] == ["one", "three"]
+    assert [p.value for p in net.reserved_ports] == [1, 3]
+    assert [p.label for p in net.dynamic_ports] == ["http", "https", "admin"]
+
+    svc = task.services[0]
+    assert svc.name == "binstore" and svc.port_label == "http"
+    assert svc.checks[0].interval == 10.0
+
+    task2 = tg.tasks[1]
+    assert task2.name == "storagelocker"
+    assert task2.constraints[0].rtarget == "amd64"
+
+
+def test_parse_periodic():
+    job = parse(
+        'job "p" { datacenters = ["dc1"] periodic { cron = "*/5 * * * *" '
+        "prohibit_overlap = true } "
+        'task "t" { driver = "exec" config { command = "/bin/true" } } }'
+    )
+    assert job.is_periodic()
+    assert job.periodic.spec == "*/5 * * * *"
+    assert job.periodic.prohibit_overlap is True
+
+
+def test_parse_constraint_sugar():
+    job = parse(
+        'job "c" { datacenters = ["dc1"] '
+        'constraint { attribute = "${attr.nomad.version}" version = ">= 0.4" } '
+        'constraint { distinct_hosts = true } '
+        'constraint { attribute = "${attr.os}" regexp = "^lin" } '
+        'task "t" { driver = "exec" config { command = "x" } } }'
+    )
+    ops = [c.operand for c in job.constraints]
+    assert ops == [consts.CONSTRAINT_VERSION, consts.CONSTRAINT_DISTINCT_HOSTS,
+                   consts.CONSTRAINT_REGEX]
+
+
+def test_bare_task_gets_implicit_group():
+    job = parse(
+        'job "solo" { datacenters = ["dc1"] '
+        'task "t" { driver = "exec" config { command = "/bin/true" } } }'
+    )
+    assert len(job.task_groups) == 1
+    assert job.task_groups[0].name == "t"
+    assert job.task_groups[0].count == 1
+
+
+def test_invalid_key_rejected():
+    with pytest.raises(ValueError, match="invalid key"):
+        parse('job "x" { bogus_key = true task "t" { driver = "exec" } }')
+
+
+def test_duration_parsing():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("10m") == 600.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration(5) == 5.0
+    with pytest.raises(ValueError):
+        parse_duration("10 parsecs")
+
+
+def test_hcl_comments_and_lists():
+    out = parse_hcl(
+        """
+        // line comment
+        /* block
+           comment */
+        key = "value"  # trailing
+        nums = [1, 2, 3]
+        nested { inner = true }
+        repeated { a = 1 }
+        repeated { a = 2 }
+        """
+    )
+    assert out["key"] == "value"
+    assert out["nums"] == [1, 2, 3]
+    assert out["nested"]["inner"] is True
+    assert [b["a"] for b in out["repeated"]] == [1, 2]
+
+
+def test_hcl_errors_carry_line_numbers():
+    with pytest.raises(HCLParseError, match="line 2"):
+        parse_hcl('ok = 1\nbad = "unterminated')
