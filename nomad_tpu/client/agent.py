@@ -227,12 +227,12 @@ class ClientAgent:
             self._save_state()
 
     def _save_state(self) -> None:
+        with self._runners_lock:
+            runners = list(self.alloc_runners.values())
         state = {
             "node_id": self.node.id,
             "secret_id": self.node.secret_id,
-            "allocs": [
-                r.persist() for r in self.alloc_runners.values()
-            ],
+            "allocs": [r.persist() for r in runners],
         }
         tmp = self._state_path() + ".tmp"
         try:

@@ -45,8 +45,11 @@ class AllocDir:
     # ------------------------------ file APIs (HTTP fs endpoints) -----
 
     def _resolve(self, path: str) -> str:
-        full = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
-        if not full.startswith(os.path.normpath(self.root)):
+        root = os.path.normpath(self.root)
+        full = os.path.normpath(os.path.join(root, path.lstrip("/")))
+        # Separator-boundary check: '/allocs/abc-evil' must not pass for
+        # root '/allocs/abc'.
+        if full != root and not full.startswith(root + os.sep):
             raise PermissionError(f"path escapes alloc dir: {path!r}")
         return full
 

@@ -8,14 +8,11 @@ and lands with the native executor.
 
 from __future__ import annotations
 
-import os
 import resource
-import subprocess
-from typing import Optional
 
 from ...structs import Node, Task
 from .base import Driver, DriverHandle, TaskContext, register_driver
-from .raw_exec import ProcessHandle
+from .raw_exec import ProcessHandle, launch_command
 
 
 @register_driver
@@ -29,16 +26,6 @@ class ExecDriver(Driver):
         return True
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
-        cfg = task.config or {}
-        command = cfg.get("command")
-        if not command:
-            raise ValueError(f"missing command for task {task.name!r}")
-        args = [command] + [str(a) for a in cfg.get("args", [])]
-        env = dict(os.environ)
-        env.update(ctx.env)
-        stdout = open(os.path.join(ctx.log_dir, f"{task.name}.stdout.0"), "ab")
-        stderr = open(os.path.join(ctx.log_dir, f"{task.name}.stderr.0"), "ab")
-
         mem_bytes = None
         if task.resources is not None and task.resources.memory_mb:
             mem_bytes = task.resources.memory_mb * 1024 * 1024
@@ -50,13 +37,6 @@ class ExecDriver(Driver):
                 except (ValueError, OSError):
                     pass
 
-        proc = subprocess.Popen(
-            args,
-            cwd=ctx.task_dir,
-            env=env,
-            stdout=stdout,
-            stderr=stderr,
-            start_new_session=True,
-            preexec_fn=preexec,
+        return ProcessHandle(
+            launch_command(ctx, task, preexec=preexec), task.name
         )
-        return ProcessHandle(proc, task.name)

@@ -57,7 +57,7 @@ class ProcessHandle(DriverHandle):
             self._done.wait(2.0)
 
 
-def launch_command(ctx: TaskContext, task: Task) -> subprocess.Popen:
+def launch_command(ctx: TaskContext, task: Task, preexec=None) -> subprocess.Popen:
     cfg = task.config or {}
     command = cfg.get("command")
     if not command:
@@ -74,6 +74,7 @@ def launch_command(ctx: TaskContext, task: Task) -> subprocess.Popen:
         stdout=stdout,
         stderr=stderr,
         start_new_session=True,  # own process group for clean kills
+        preexec_fn=preexec,
     )
 
 

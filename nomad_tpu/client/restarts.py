@@ -43,7 +43,9 @@ class RestartTracker:
             self.start_time = now
 
         self.count += 1
-        if self.policy.attempts <= 0 or self.count <= self.policy.attempts:
+        # attempts=0 means never restart (restarts.go: count > Attempts
+        # exhausts the budget).
+        if self.count <= self.policy.attempts:
             return RESTART, self._jitter(self.policy.delay)
 
         if self.policy.mode == consts.RESTART_POLICY_MODE_FAIL:

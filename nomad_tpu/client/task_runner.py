@@ -75,11 +75,12 @@ class TaskRunner:
     def kill(self, event: Optional[TaskEvent] = None) -> None:
         with self._lock:
             self._destroy_event = event or new_task_event(consts.TASK_EVENT_KILLING)
-        self._kill.set()
-        if self.handle is not None:
+            self._kill.set()
+            handle = self.handle  # run() re-kills if start() races us
+        if handle is not None:
             kill_timeout = min(self.task.kill_timeout, self.max_kill_timeout)
             try:
-                self.handle.kill(kill_timeout)
+                handle.kill(kill_timeout)
             except Exception:
                 self.logger.exception("kill failed")
 
@@ -130,8 +131,15 @@ class TaskRunner:
         while not self._kill.is_set():
             # start
             try:
-                self.handle = driver.start(ctx, self.task)
-                self.handle_id = self.handle.id()
+                handle = driver.start(ctx, self.task)
+                with self._lock:
+                    self.handle = handle
+                    self.handle_id = handle.id()
+                    killed_during_start = self._kill.is_set()
+                if killed_during_start:
+                    # kill() raced driver.start and found handle None;
+                    # re-issue so the process isn't orphaned.
+                    handle.kill(min(self.task.kill_timeout, self.max_kill_timeout))
             except Exception as e:  # noqa: BLE001 - driver start errors
                 ev = new_task_event(consts.TASK_EVENT_DRIVER_FAILURE)
                 ev.driver_error = str(e)

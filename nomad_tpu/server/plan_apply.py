@@ -55,18 +55,24 @@ class PlanApplier:
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._lifecycle = threading.Lock()  # start/stop can race on
+        # leadership flaps (raft elections)
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="plan-applier", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            self._stop.clear()
+            thread = threading.Thread(
+                target=self._run, name="plan-applier", daemon=True
+            )
+            thread.start()
+            self._thread = thread
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        with self._lifecycle:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,504 @@
+"""Raft consensus: leader election, log replication, commit.
+
+Reference: the reference embeds hashicorp/raft (nomad/server.go:634
+setupRaft, raft_rpc.go stream layer); this is a from-scratch
+implementation of the same protocol surface the control plane needs:
+randomized election timeouts, RequestVote/AppendEntries, majority
+commit, leadership-change notification driving the leader-only
+services, and write forwarding to the leader. Transports are
+pluggable: in-memory for in-process clusters/tests, TCP/JSON for
+multi-host.
+
+Not implemented (acceptable for the capability target): log
+compaction/snapshot install (the FSM has persist()/restore() ready) and
+dynamic membership change.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+HEARTBEAT_INTERVAL = 0.05
+ELECTION_TIMEOUT_MIN = 0.15
+ELECTION_TIMEOUT_MAX = 0.30
+APPLY_TIMEOUT = 10.0
+
+
+@dataclass
+class LogEntry:
+    term: int
+    index: int
+    msg_type: str
+    payload: Any
+
+
+class _ApplyWaiter:
+    __slots__ = ("event", "committed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.committed = False
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_id: Optional[str]):
+        super().__init__(f"not the leader (leader: {leader_id})")
+        self.leader_id = leader_id
+
+
+class Transport:
+    """RPC transport between raft peers."""
+
+    def request_vote(self, peer: str, args: dict) -> Optional[dict]:
+        raise NotImplementedError
+
+    def append_entries(self, peer: str, args: dict) -> Optional[dict]:
+        raise NotImplementedError
+
+    def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
+        raise NotImplementedError
+
+
+class InmemTransport(Transport):
+    """In-process transport: a shared registry of nodes. Supports
+    partitioning for failure tests."""
+
+    def __init__(self):
+        self.nodes: Dict[str, "RaftNode"] = {}
+        self.disconnected: set = set()
+
+    def register(self, node: "RaftNode") -> None:
+        self.nodes[node.node_id] = node
+
+    def disconnect(self, node_id: str) -> None:
+        self.disconnected.add(node_id)
+
+    def reconnect(self, node_id: str) -> None:
+        self.disconnected.discard(node_id)
+
+    def _reachable(self, a: str, b: str) -> bool:
+        return a not in self.disconnected and b not in self.disconnected
+
+    def request_vote(self, peer: str, args: dict) -> Optional[dict]:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["candidate_id"], peer):
+            return None
+        return node.handle_request_vote(args)
+
+    def append_entries(self, peer: str, args: dict) -> Optional[dict]:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["leader_id"], peer):
+            return None
+        return node.handle_append_entries(args)
+
+    def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
+        node = self.nodes.get(peer)
+        if node is None or peer in self.disconnected:
+            raise ConnectionError(f"peer {peer} unreachable")
+        return node.apply(msg_type, payload)
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        peers: List[str],
+        transport: Transport,
+        fsm_apply: Callable[[int, str, Any], Any],
+        on_leadership: Callable[[bool], None],
+    ):
+        self.node_id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        self.fsm_apply = fsm_apply
+        self.on_leadership = on_leadership
+        self.logger = logging.getLogger(f"nomad_tpu.raft.{node_id}")
+
+        self._lock = threading.RLock()
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[LogEntry] = []  # 1-indexed via helpers
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+
+        # leader volatile state
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._next_election_deadline()
+        # index -> (expected term, waiter); the commit must match the
+        # term or the write was superseded by another leader.
+        self._apply_waiters: Dict[int, Tuple[int, "_ApplyWaiter"]] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # Leadership gain/loss callbacks run on one dispatcher thread in
+        # FIFO order — a flap must never apply them reversed.
+        import queue as _queue
+
+        self._notify_queue: "_queue.Queue" = _queue.Queue()
+
+    # ------------------------------------------------------------------
+
+    def _notify_leadership(self, is_leader: bool) -> None:
+        self._notify_queue.put(is_leader)
+
+    def _run_notify(self) -> None:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                is_leader = self._notify_queue.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            try:
+                self.on_leadership(is_leader)
+            except Exception:
+                self.logger.exception("leadership callback failed")
+
+    def start(self) -> None:
+        for target, name in (
+            (self._run_election_timer, "election"),
+            (self._run_heartbeats, "heartbeat"),
+            (self._run_apply, "apply"),
+            (self._run_notify, "notify"),
+        ):
+            t = threading.Thread(
+                target=target, name=f"raft-{self.node_id}-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        was_leader = False
+        with self._lock:
+            was_leader = self.state == LEADER
+            self.state = FOLLOWER
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if was_leader:
+            self.on_leadership(False)  # dispatcher stopped; call direct
+
+    # ----------------------------------------------------- log helpers
+
+    def _last_log_index(self) -> int:
+        return self.log[-1].index if self.log else 0
+
+    def _last_log_term(self) -> int:
+        return self.log[-1].term if self.log else 0
+
+    def _entry_at(self, index: int) -> Optional[LogEntry]:
+        if index <= 0 or index > len(self.log):
+            return None
+        return self.log[index - 1]
+
+    @staticmethod
+    def _next_election_deadline() -> float:
+        return time.monotonic() + random.uniform(
+            ELECTION_TIMEOUT_MIN, ELECTION_TIMEOUT_MAX
+        )
+
+    # ------------------------------------------------------- RPC side
+
+    def handle_request_vote(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "vote_granted": False}
+            if term > self.current_term:
+                self._become_follower(term)
+            up_to_date = (args["last_log_term"], args["last_log_index"]) >= (
+                self._last_log_term(),
+                self._last_log_index(),
+            )
+            if self.voted_for in (None, args["candidate_id"]) and up_to_date:
+                self.voted_for = args["candidate_id"]
+                self._election_deadline = self._next_election_deadline()
+                return {"term": self.current_term, "vote_granted": True}
+            return {"term": self.current_term, "vote_granted": False}
+
+    def handle_append_entries(self, args: dict) -> dict:
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = args["leader_id"]
+            self._election_deadline = self._next_election_deadline()
+
+            prev_index = args["prev_log_index"]
+            prev_term = args["prev_log_term"]
+            if prev_index > 0:
+                entry = self._entry_at(prev_index)
+                if entry is None or entry.term != prev_term:
+                    return {"term": self.current_term, "success": False}
+
+            # Append, truncating conflicts.
+            for raw in args["entries"]:
+                entry = LogEntry(**raw) if isinstance(raw, dict) else raw
+                existing = self._entry_at(entry.index)
+                if existing is not None and existing.term != entry.term:
+                    del self.log[entry.index - 1 :]
+                    existing = None
+                if existing is None:
+                    self.log.append(entry)
+
+            if args["leader_commit"] > self.commit_index:
+                self.commit_index = min(
+                    args["leader_commit"], self._last_log_index()
+                )
+            return {"term": self.current_term, "success": True}
+
+    # ------------------------------------------------------ elections
+
+    def _become_follower(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        if term > self.current_term:
+            # One vote per term: voted_for only resets on a NEW term.
+            self.current_term = term
+            self.voted_for = None
+        if was_leader:
+            self._notify_leadership(False)
+
+    def _run_election_timer(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(0.02)
+            with self._lock:
+                if self.state == LEADER:
+                    continue
+                if time.monotonic() < self._election_deadline:
+                    continue
+                # timeout: stand for election
+                self.state = CANDIDATE
+                self.current_term += 1
+                self.voted_for = self.node_id
+                term = self.current_term
+                self._election_deadline = self._next_election_deadline()
+                last_idx, last_term = self._last_log_index(), self._last_log_term()
+            self._campaign(term, last_idx, last_term)
+
+    def _campaign(self, term: int, last_idx: int, last_term: int) -> None:
+        votes = 1
+        args = {
+            "term": term,
+            "candidate_id": self.node_id,
+            "last_log_index": last_idx,
+            "last_log_term": last_term,
+        }
+        for peer in self.peers:
+            resp = self.transport.request_vote(peer, args)
+            if resp is None:
+                continue
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._become_follower(resp["term"])
+                    return
+                if self.state != CANDIDATE or self.current_term != term:
+                    return
+            if resp["vote_granted"]:
+                votes += 1
+        if votes * 2 > len(self.peers) + 1:
+            with self._lock:
+                if self.state != CANDIDATE or self.current_term != term:
+                    return
+                self.state = LEADER
+                self.leader_id = self.node_id
+                nxt = self._last_log_index() + 1
+                self.next_index = {p: nxt for p in self.peers}
+                self.match_index = {p: 0 for p in self.peers}
+            self.logger.info("became leader for term %d", term)
+            self._broadcast_heartbeat()
+            self._notify_leadership(True)
+
+    # ------------------------------------------------------ leadership
+
+    def _run_heartbeats(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                is_leader = self.state == LEADER
+            if is_leader:
+                self._broadcast_heartbeat()
+            time.sleep(HEARTBEAT_INTERVAL)
+
+    def _broadcast_heartbeat(self) -> None:
+        for peer in self.peers:
+            self._replicate_to(peer)
+        self._advance_commit()
+
+    def _replicate_to(self, peer: str) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            next_idx = self.next_index.get(peer, self._last_log_index() + 1)
+            prev_idx = next_idx - 1
+            prev_entry = self._entry_at(prev_idx)
+            prev_term = prev_entry.term if prev_entry else 0
+            entries = [e for e in self.log[next_idx - 1 :]]
+            args = {
+                "term": self.current_term,
+                "leader_id": self.node_id,
+                "prev_log_index": prev_idx,
+                "prev_log_term": prev_term,
+                "entries": entries,
+                "leader_commit": self.commit_index,
+            }
+        resp = self.transport.append_entries(peer, args)
+        if resp is None:
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._become_follower(resp["term"])
+                return
+            if self.state != LEADER:
+                return
+            if resp["success"]:
+                if entries:
+                    self.match_index[peer] = entries[-1].index
+                    self.next_index[peer] = entries[-1].index + 1
+            else:
+                self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            for n in range(self._last_log_index(), self.commit_index, -1):
+                entry = self._entry_at(n)
+                if entry is None or entry.term != self.current_term:
+                    continue
+                votes = 1 + sum(
+                    1 for p in self.peers if self.match_index.get(p, 0) >= n
+                )
+                if votes * 2 > len(self.peers) + 1:
+                    self.commit_index = n
+                    break
+
+    # ----------------------------------------------------------- apply
+
+    def apply(self, msg_type: str, payload: Any) -> int:
+        """Append an entry; blocks until it is committed and applied
+        locally. Followers forward to the leader. Raises if the write
+        was superseded (lost leadership before commit)."""
+        with self._lock:
+            if self.state != LEADER:
+                leader = self.leader_id
+                if leader is None:
+                    raise NotLeaderError(None)
+                forward = True
+            else:
+                forward = False
+                index = self._last_log_index() + 1
+                term = self.current_term
+                entry = LogEntry(term, index, msg_type, payload)
+                self.log.append(entry)
+                waiter = _ApplyWaiter()
+                self._apply_waiters[index] = (term, waiter)
+        if forward:
+            return self.transport.forward_apply(leader, msg_type, payload)
+
+        # Actively drive replication while waiting: a dropped round
+        # otherwise stalls the commit until the next heartbeat tick.
+        deadline = time.monotonic() + APPLY_TIMEOUT
+        self._broadcast_heartbeat()
+        while not waiter.event.wait(0.05):
+            if time.monotonic() > deadline:
+                with self._lock:
+                    self._apply_waiters.pop(index, None)
+                raise TimeoutError(f"apply of index {index} timed out")
+            self._broadcast_heartbeat()
+        if not waiter.committed:
+            # A different leader committed a different entry here.
+            raise NotLeaderError(self.leader_id)
+        return index
+
+    def _run_apply(self) -> None:
+        while not self._stop.is_set():
+            applied_any = False
+            with self._lock:
+                while self.last_applied < self.commit_index:
+                    self.last_applied += 1
+                    entry = self._entry_at(self.last_applied)
+                    waiting = self._apply_waiters.pop(self.last_applied, None)
+                    if entry is not None:
+                        try:
+                            self.fsm_apply(entry.index, entry.msg_type, entry.payload)
+                        except Exception:
+                            self.logger.exception(
+                                "fsm apply failed at %d", entry.index
+                            )
+                    if waiting is not None:
+                        expected_term, waiter = waiting
+                        # Only ack the waiter if OUR entry committed; a
+                        # different term means the write was lost.
+                        waiter.committed = (
+                            entry is not None and entry.term == expected_term
+                        )
+                        waiter.event.set()
+                    applied_any = True
+            if not applied_any:
+                time.sleep(0.005)
+
+    # ------------------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def last_index(self) -> int:
+        with self._lock:
+            return self.last_applied
+
+    def barrier(self) -> int:
+        return self.last_index()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "term": self.current_term,
+                "leader": self.leader_id,
+                "commit_index": self.commit_index,
+                "last_applied": self.last_applied,
+                "log_len": len(self.log),
+            }
+
+
+class RaftLog:
+    """Adapter giving RaftNode the DevLog interface the Server uses.
+    Forwarded writes wait for the local FSM to catch up so endpoint code
+    can read its own writes (the reference forwards whole RPCs to the
+    leader, which reads there; here only the log write forwards)."""
+
+    def __init__(self, node: RaftNode):
+        self.node = node
+
+    def apply(self, msg_type: str, payload: Any) -> int:
+        index = self.node.apply(msg_type, payload)
+        deadline = time.monotonic() + APPLY_TIMEOUT
+        while self.node.last_index() < index:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"local fsm did not reach index {index} in time"
+                )
+            time.sleep(0.002)
+        return index
+
+    def last_index(self) -> int:
+        return self.node.last_index()
+
+    def barrier(self) -> int:
+        return self.node.barrier()

@@ -62,6 +62,13 @@ class Server:
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
+        # Multi-server mode (start_with_raft): consensus node + peer
+        # registry for leader-routed operations (the reference forwards
+        # RPCs to the leader, rpc.go:178).
+        self.raft = None
+        self.cluster: Optional[Dict[str, "Server"]] = None
+        self.node_id = self.config.node_name or "server-0"
+        self._leadership_lock = threading.Lock()
 
         self._register_core_scheduler()
 
@@ -83,9 +90,59 @@ class Server:
             worker.start()
         self.establish_leadership()
 
+    def start_with_raft(self, node_id: str, peers: List[str], transport,
+                        cluster: Dict[str, "Server"]) -> None:
+        """Multi-server mode: leadership follows raft elections."""
+        from .raft import RaftLog, RaftNode
+
+        self.node_id = node_id
+        self.cluster = cluster
+        cluster[node_id] = self
+        self.raft = RaftNode(
+            node_id, peers, transport, self.fsm.apply, self._leadership_changed
+        )
+        self.log = RaftLog(self.raft)
+        self.plan_applier.log = self.log
+        transport.register(self.raft)
+        for i in range(self.config.num_schedulers):
+            worker = Worker(self, i)
+            self.workers.append(worker)
+            worker.start()
+        self.raft.start()
+
+    def _leadership_changed(self, is_leader: bool) -> None:
+        # Serialized: elections can flap faster than the services
+        # start/stop.
+        with self._leadership_lock:
+            if is_leader:
+                self.establish_leadership()
+            else:
+                self.revoke_leadership()
+
+    def _leader_server(self) -> Optional["Server"]:
+        """The server object currently holding leadership (self in dev
+        mode). Leader-only operations route through this."""
+        if self._leader or self.cluster is None:
+            return self
+        leader_id = self.raft.leader_id if self.raft is not None else None
+        if leader_id is None:
+            return None
+        return self.cluster.get(leader_id)
+
+    def _reset_heartbeat(self, node_id: str) -> float:
+        leader = self._leader_server()
+        return leader.heartbeats.reset_timer(node_id) if leader is not None else 0.0
+
+    def _clear_heartbeat(self, node_id: str) -> None:
+        leader = self._leader_server()
+        if leader is not None:
+            leader.heartbeats.clear_timer(node_id)
+
     def shutdown(self) -> None:
         self._shutdown = True
         self.revoke_leadership()
+        if self.raft is not None:
+            self.raft.stop()
         for w in self.workers:
             w.stop()
 
@@ -256,11 +313,11 @@ class Server:
         # Transitioning to ready re-schedules its jobs.
         if existing is not None and existing.status != node.status:
             self._create_node_evals(node.id)
-        return self.heartbeats.reset_timer(node.id)
+        return self._reset_heartbeat(node.id)
 
     def node_deregister(self, node_id: str) -> None:
         self.log.apply(fsm_msgs.NODE_DEREGISTER, {"node_id": node_id})
-        self.heartbeats.clear_timer(node_id)
+        self._clear_heartbeat(node_id)
 
     def node_update_status(self, node_id: str, status: str) -> float:
         """Node.UpdateStatus (node_endpoint.go:272): commit the status,
@@ -275,9 +332,9 @@ class Server:
             )
             self._create_node_evals(node_id)
         if status == consts.NODE_STATUS_DOWN:
-            self.heartbeats.clear_timer(node_id)
+            self._clear_heartbeat(node_id)
             return 0.0
-        return self.heartbeats.reset_timer(node_id)
+        return self._reset_heartbeat(node_id)
 
     def node_heartbeat(self, node_id: str, secret_id: str = "") -> float:
         node = self.fsm.state.node_by_id(node_id)
@@ -287,7 +344,7 @@ class Server:
             raise PermissionError("node secret ID does not match")
         if node.status != consts.NODE_STATUS_READY:
             return self.node_update_status(node_id, consts.NODE_STATUS_READY)
-        return self.heartbeats.reset_timer(node_id)
+        return self._reset_heartbeat(node_id)
 
     def node_update_drain(self, node_id: str, drain: bool) -> None:
         """Node.UpdateDrain (node_endpoint.go:374)."""
@@ -359,13 +416,37 @@ class Server:
     def eval_dequeue(
         self, schedulers: List[str], timeout: float
     ) -> Tuple[Optional[Evaluation], str]:
-        return self.broker.dequeue(schedulers, timeout)
+        leader = self._leader_server()
+        if leader is None:
+            time.sleep(min(timeout, 0.2))
+            return None, ""
+        return leader.broker.dequeue(schedulers, timeout)
 
     def eval_ack(self, eval_id: str, token: str) -> None:
-        self.broker.ack(eval_id, token)
+        leader = self._leader_server()
+        if leader is None:
+            raise ValueError("no leader")
+        leader.broker.ack(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str) -> None:
-        self.broker.nack(eval_id, token)
+        leader = self._leader_server()
+        if leader is None:
+            raise ValueError("no leader")
+        leader.broker.nack(eval_id, token)
+
+    def eval_pause_nack(self, eval_id: str, token: str) -> None:
+        leader = self._leader_server()
+        if leader is not None:
+            leader.broker.pause_nack_timeout(eval_id, token)
+
+    def eval_resume_nack(self, eval_id: str, token: str) -> None:
+        leader = self._leader_server()
+        if leader is not None:
+            leader.broker.resume_nack_timeout(eval_id, token)
+
+    def eval_outstanding(self, eval_id: str) -> Optional[str]:
+        leader = self._leader_server()
+        return leader.broker.outstanding(eval_id) if leader is not None else None
 
     def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
         return self.log.apply(
@@ -377,10 +458,13 @@ class Server:
     def plan_submit(self, plan: Plan) -> PlanResult:
         """Plan.Submit (plan_endpoint.go:16). The eval token is the
         split-brain guard: it must still be the outstanding token."""
-        token = self.broker.outstanding(plan.eval_id)
+        leader = self._leader_server()
+        if leader is None:
+            raise ValueError("no leader to submit plan to")
+        token = leader.broker.outstanding(plan.eval_id)
         if token != plan.eval_token:
             raise ValueError("plan's eval token does not match outstanding eval")
-        pending = self.plan_queue.enqueue(plan)
+        pending = leader.plan_queue.enqueue(plan)
         return pending.wait(timeout=30.0)
 
     # --------------------------------------------------------- periodic
@@ -391,7 +475,10 @@ class Server:
         )
 
     def periodic_force(self, job_id: str) -> Optional[str]:
-        return self.periodic.force_run(job_id)
+        leader = self._leader_server()
+        if leader is None:
+            raise ValueError("no leader")
+        return leader.periodic.force_run(job_id)
 
     # --------------------------------------------------------------- gc
 
@@ -407,7 +494,10 @@ class Server:
 
     def force_gc(self) -> None:
         """System.GC endpoint (system_endpoint.go:16)."""
-        self.broker.enqueue(self._core_eval(consts.CORE_JOB_FORCE_GC))
+        leader = self._leader_server()
+        if leader is None:
+            raise ValueError("no leader")
+        leader.broker.enqueue(leader._core_eval(consts.CORE_JOB_FORCE_GC))
 
     def _schedule_gc(self) -> None:
         """Leader GC timers enqueue core-job evals on their intervals

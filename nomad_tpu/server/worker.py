@@ -120,12 +120,15 @@ class Worker:
         plan.eval_token = self._token
         # The Nack clock stops while the plan waits in the queue
         # (plan_endpoint.go:16).
-        self.server.broker.pause_nack_timeout(self._eval.id, self._token)
+        try:
+            self.server.eval_pause_nack(self._eval.id, self._token)
+        except ValueError:
+            pass
         try:
             result = self.server.plan_submit(plan)
         finally:
             try:
-                self.server.broker.resume_nack_timeout(self._eval.id, self._token)
+                self.server.eval_resume_nack(self._eval.id, self._token)
             except ValueError:
                 pass
         if result.refresh_index:
@@ -142,7 +145,7 @@ class Worker:
         self.server.eval_update([ev])
 
     def reblock_eval(self, ev: Evaluation) -> None:
-        token = self.server.broker.outstanding(ev.id)
+        token = self.server.eval_outstanding(ev.id)
         if token != self._token:
             raise ValueError(f"eval {ev.id!r} is not outstanding")
         ev.snapshot_index = self.server.fsm.state.latest_index()

@@ -1,0 +1,349 @@
+"""Raft consensus + multi-server cluster tests (mirror the reference's
+in-process multi-server pattern, testutil.WaitForLeader)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import MockClient
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.raft import InmemTransport, NotLeaderError, RaftNode
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------- raw raft
+
+
+def make_raft_cluster(n):
+    transport = InmemTransport()
+    applied = {i: [] for i in range(n)}
+    nodes = []
+    ids = [f"n{i}" for i in range(n)]
+    for i, node_id in enumerate(ids):
+        def make_apply(i):
+            return lambda index, mtype, payload: applied[i].append(
+                (index, mtype, payload)
+            )
+
+        node = RaftNode(node_id, ids, transport, make_apply(i), lambda _: None)
+        transport.register(node)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return transport, nodes, applied
+
+
+def find_leader(nodes):
+    leaders = [n for n in nodes if n.is_leader()]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_raft_elects_single_leader():
+    transport, nodes, applied = make_raft_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        # all followers agree on the leader
+        assert wait_until(
+            lambda: all(n.leader_id == leader.node_id for n in nodes)
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_raft_replicates_and_applies_everywhere():
+    transport, nodes, applied = make_raft_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        idx = leader.apply("test", {"value": 42})
+        assert idx == 1
+        assert wait_until(
+            lambda: all(len(applied[i]) == 1 for i in range(3))
+        )
+        for i in range(3):
+            assert applied[i][0] == (1, "test", {"value": 42})
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_raft_follower_forwards_to_leader():
+    transport, nodes, applied = make_raft_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        follower = next(n for n in nodes if not n.is_leader())
+        idx = follower.apply("fwd", {"x": 1})
+        assert idx == 1
+        assert wait_until(lambda: leader.last_index() == 1)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_raft_leader_failover():
+    transport, nodes, applied = make_raft_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        old_leader = find_leader(nodes)
+        old_leader.apply("before", {})
+
+        transport.disconnect(old_leader.node_id)
+        remaining = [n for n in nodes if n is not old_leader]
+        assert wait_until(
+            lambda: any(n.is_leader() for n in remaining), timeout=5.0
+        )
+        new_leader = next(n for n in remaining if n.is_leader())
+        assert new_leader is not old_leader
+        idx = new_leader.apply("after", {})
+        assert idx == 2
+
+        # old leader rejoins as follower and catches up
+        transport.reconnect(old_leader.node_id)
+        assert wait_until(
+            lambda: not old_leader.is_leader() and old_leader.last_index() == 2,
+            timeout=5.0,
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_raft_no_leader_without_quorum():
+    transport, nodes, applied = make_raft_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        # partition everyone: no quorum, no leader progress
+        for n in nodes:
+            transport.disconnect(n.node_id)
+        time.sleep(0.5)
+        leader = find_leader(nodes)
+        if leader is not None:
+            with pytest.raises((NotLeaderError, TimeoutError, ConnectionError)):
+                leader.apply("doomed", {})
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+# ------------------------------------------------- multi-server cluster
+
+
+def make_server_cluster(n=3, **cfg_kwargs):
+    transport = InmemTransport()
+    cluster = {}
+    ids = [f"s{i}" for i in range(n)]
+    servers = []
+    for node_id in ids:
+        cfg = ServerConfig(num_schedulers=1, eval_nack_timeout=5.0, **cfg_kwargs)
+        cfg.node_name = node_id
+        server = Server(cfg)
+        server.start_with_raft(node_id, ids, transport, cluster)
+        servers.append(server)
+    return transport, servers
+
+
+def cluster_leader(servers):
+    leaders = [s for s in servers if s.is_leader()]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_cluster_elects_leader_and_schedules():
+    transport, servers = make_server_cluster(3)
+    try:
+        assert wait_until(lambda: cluster_leader(servers) is not None)
+        leader = cluster_leader(servers)
+        follower = next(s for s in servers if not s.is_leader())
+
+        client = MockClient(leader)
+        client.start()
+        try:
+            # register via a FOLLOWER: the write forwards to the leader
+            job = mock.job()
+            job.task_groups[0].count = 3
+            eval_id, _ = follower.job_register(job)
+
+            # replicated state: every server sees the job and the allocs
+            assert wait_until(
+                lambda: all(
+                    len(s.fsm.state.allocs_by_job(job.id)) == 3 for s in servers
+                )
+            )
+            assert wait_until(
+                lambda: all(
+                    s.fsm.state.eval_by_id(eval_id) is not None
+                    and s.fsm.state.eval_by_id(eval_id).status
+                    == consts.EVAL_STATUS_COMPLETE
+                    for s in servers
+                )
+            )
+        finally:
+            client.stop()
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_cluster_leader_failover_restores_services():
+    transport, servers = make_server_cluster(3)
+    try:
+        assert wait_until(lambda: cluster_leader(servers) is not None)
+        leader = cluster_leader(servers)
+
+        client = MockClient(leader)
+        client.start()
+        job = mock.job()
+        job.task_groups[0].count = 2
+        leader.job_register(job)
+        assert wait_until(
+            lambda: len(leader.fsm.state.allocs_by_job(job.id)) == 2
+        )
+        client.stop()
+
+        # kill the leader
+        transport.disconnect(leader.node_id)
+        remaining = [s for s in servers if s is not leader]
+        assert wait_until(
+            lambda: any(s.is_leader() for s in remaining), timeout=6.0
+        )
+        new_leader = next(s for s in remaining if s.is_leader())
+        assert wait_until(lambda: new_leader.broker.enabled(), timeout=5.0)
+
+        # the new leader can schedule: register another job through it
+        client2 = MockClient(new_leader)
+        client2.start()
+        try:
+            job2 = mock.job()
+            job2.task_groups[0].count = 1
+            eval_id, _ = new_leader.job_register(job2)
+            assert wait_until(
+                lambda: (e := new_leader.fsm.state.eval_by_id(eval_id)) is not None
+                and e.status == consts.EVAL_STATUS_COMPLETE,
+                timeout=8.0,
+            )
+            assert len(new_leader.fsm.state.allocs_by_job(job2.id)) == 1
+        finally:
+            client2.stop()
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_cluster_pending_evals_restored_on_failover():
+    """Evals committed but not yet processed must survive failover
+    (leader.go:192 restoreEvals)."""
+    transport, servers = make_server_cluster(3)
+    try:
+        assert wait_until(lambda: cluster_leader(servers) is not None)
+        leader = cluster_leader(servers)
+        # No nodes: the eval completes with a blocked eval outstanding.
+        job = mock.job()
+        job.task_groups[0].count = 3  # must fit one mock node post-failover
+        eval_id, _ = leader.job_register(job)
+        assert wait_until(
+            lambda: any(
+                e.status == consts.EVAL_STATUS_BLOCKED
+                for e in leader.fsm.state.evals_by_job(job.id)
+            )
+        )
+
+        transport.disconnect(leader.node_id)
+        remaining = [s for s in servers if s is not leader]
+        assert wait_until(
+            lambda: any(s.is_leader() for s in remaining), timeout=6.0
+        )
+        new_leader = next(s for s in remaining if s.is_leader())
+
+        # the blocked eval is tracked by the new leader; a node joining
+        # unblocks it and the job schedules
+        client = MockClient(new_leader)
+        client.start()
+        try:
+            assert wait_until(
+                lambda: len(new_leader.fsm.state.allocs_by_job(job.id)) == 3,
+                timeout=10.0,
+            )
+        finally:
+            client.stop()
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+# -------------------------------------------------------- TCP transport
+
+
+def test_raft_over_tcp_transport():
+    """Three raft nodes talking over real TCP sockets."""
+    from nomad_tpu.server.transport import TCPTransport, fsm_payload_decoder
+
+    transports = [TCPTransport(fsm_payload_decoder) for _ in range(3)]
+    addrs = [t.serve("127.0.0.1", 0) for t in transports]
+    applied = {i: [] for i in range(3)}
+    nodes = []
+    for i, t in enumerate(transports):
+        def make_apply(i):
+            return lambda index, mtype, payload: applied[i].append((index, mtype))
+
+        node = RaftNode(addrs[i], addrs, t, make_apply(i), lambda _: None)
+        t.register(node)
+        nodes.append(node)
+    for n in nodes:
+        n.start()
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None, timeout=8.0)
+        leader = find_leader(nodes)
+        follower = next(n for n in nodes if not n.is_leader())
+
+        # typed payload survives the wire
+        node_obj = mock.node()
+        idx = leader.apply("node_register", {"node": node_obj})
+        assert idx == 1
+        assert wait_until(lambda: all(len(applied[i]) == 1 for i in range(3)))
+
+        # follower forwards over TCP
+        idx2 = follower.apply("test", {"x": 1})
+        assert idx2 == 2
+        assert wait_until(lambda: all(len(applied[i]) == 2 for i in range(3)))
+    finally:
+        for n in nodes:
+            n.stop()
+        for t in transports:
+            t.close()
+
+
+def test_tcp_transport_typed_roundtrip():
+    """FSM payloads decode back to structs after the JSON wire."""
+    from nomad_tpu.server.transport import (
+        _encode_payload,
+        fsm_payload_decoder,
+    )
+    from nomad_tpu.structs import Job, Node
+
+    payload = {"node": mock.node()}
+    wire = _encode_payload(payload)
+    import json
+
+    wire = json.loads(json.dumps(wire))  # force JSON round trip
+    decoded = fsm_payload_decoder("node_register", wire)
+    assert isinstance(decoded["node"], Node)
+    assert decoded["node"] == payload["node"]
+
+    payload = {"job": mock.job()}
+    decoded = fsm_payload_decoder(
+        "job_register", json.loads(json.dumps(_encode_payload(payload)))
+    )
+    assert isinstance(decoded["job"], Job)
+    assert decoded["job"] == payload["job"]
