@@ -89,6 +89,15 @@ class Jobs:
         out, _ = self.c.put("/v1/jobs", {"job": to_dict(job)})
         return out["eval_id"]
 
+    def enforce_register(self, job: Job, modify_index: int) -> str:
+        """Register gated on the job-modify index (api/jobs.go:49-58)."""
+        out, _ = self.c.put("/v1/jobs", {
+            "job": to_dict(job),
+            "enforce_index": True,
+            "job_modify_index": modify_index,
+        })
+        return out["eval_id"]
+
     def list(self, index: Optional[int] = None, wait: Optional[float] = None):
         return self.c.get("/v1/jobs", _query_params(index, wait))
 
@@ -113,9 +122,10 @@ class Jobs:
         out, _ = self.c.put(f"/v1/job/{job_id}/evaluate")
         return out["eval_id"]
 
-    def plan(self, job: Job, diff: bool = False) -> dict:
+    def plan(self, job: Job, diff: bool = False, contextual: bool = False) -> dict:
         out, _ = self.c.put(
-            f"/v1/job/{job.id}/plan", {"job": to_dict(job), "diff": diff}
+            f"/v1/job/{job.id}/plan",
+            {"job": to_dict(job), "diff": diff, "contextual": contextual},
         )
         return out
 

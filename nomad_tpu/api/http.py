@@ -160,7 +160,11 @@ class HTTPServer:
     def _jobs(self, method, query, body):
         if method in ("PUT", "POST"):
             job = from_dict(Job, body.get("job", body))
-            eval_id, index = self.server.job_register(job)
+            eval_id, index = self.server.job_register(
+                job,
+                enforce_index=bool(body.get("enforce_index")),
+                job_modify_index=int(body.get("job_modify_index") or 0),
+            )
             return {"eval_id": eval_id, "index": index}
         state = self.server.fsm.state
         prefix = query.get("prefix", [""])[0]
@@ -215,11 +219,16 @@ class HTTPServer:
 
     def _job_plan(self, method, query, body, job_id):
         job = from_dict(Job, body.get("job", body))
-        result = self.server.job_plan(job, diff=bool(body.get("diff")))
+        result = self.server.job_plan(
+            job, diff=bool(body.get("diff")),
+            contextual=bool(body.get("contextual")),
+        )
         return {
             "annotations": to_dict(result["annotations"]),
             "failed_tg_allocs": to_dict(result["failed_tg_allocs"]),
             "index": result["index"],
+            "job_modify_index": result["job_modify_index"],
+            "diff": to_dict(result.get("diff")),
         }
 
     def _job_periodic_force(self, method, query, body, job_id):

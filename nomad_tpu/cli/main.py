@@ -149,7 +149,10 @@ def cmd_run(args) -> int:
 
     job = parse_file(args.file)
     client = _client(args)
-    eval_id = client.jobs.register(job)
+    if args.check_index is not None:
+        eval_id = client.jobs.enforce_register(job, args.check_index)
+    else:
+        eval_id = client.jobs.register(job)
     if not eval_id:
         print(f'Job "{job.id}" registered (periodic, no evaluation)')
         return 0
@@ -160,22 +163,58 @@ def cmd_run(args) -> int:
     return _monitor_eval(client, eval_id)
 
 
+_DIFF_MARK = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": " "}
+
+
+def _print_field_diffs(fields, indent: str) -> None:
+    for f in fields:
+        mark = _DIFF_MARK.get(f.get("type"), " ")
+        if f.get("type") == "Edited":
+            print(f'{indent}{mark} {f["name"]}: {f["old"]!r} => {f["new"]!r}')
+        elif f.get("type") == "Added":
+            print(f'{indent}{mark} {f["name"]}: {f["new"]!r}')
+        elif f.get("type") == "Deleted":
+            print(f'{indent}{mark} {f["name"]}: {f["old"]!r}')
+        elif f.get("type") == "None":
+            print(f'{indent}  {f["name"]}: {f["old"]!r}')
+
+
+def _print_object_diffs(objects, indent: str) -> None:
+    for o in objects or []:
+        mark = _DIFF_MARK.get(o.get("type"), " ")
+        print(f'{indent}{mark} {o["name"]} {{')
+        _print_field_diffs(o.get("fields") or [], indent + "    ")
+        _print_object_diffs(o.get("objects") or [], indent + "    ")
+        print(f"{indent}}}")
+
+
 def cmd_plan(args) -> int:
     from ..jobspec import parse_file
 
     job = parse_file(args.file)
     client = _client(args)
-    result = client.jobs.plan(job, diff=True)
-    annotations = result.get("annotations") or {}
-    desired = (annotations.get("desired_tg_updates") or {}) if annotations else {}
-    print("+ Job:", job.id)
-    for tg, counts in desired.items():
-        parts = [
-            f"{name}: {count}"
-            for name, count in counts.items()
-            if count
-        ]
-        print(f"  Task Group {tg!r}: " + (", ".join(parts) or "no changes"))
+    result = client.jobs.plan(job, diff=True, contextual=args.verbose)
+    diff = result.get("diff") or {}
+    mark = _DIFF_MARK.get(diff.get("type", "None"), " ")
+    print(f"{mark} Job: {job.id!r}")
+    _print_field_diffs(diff.get("fields") or [], "  ")
+    _print_object_diffs(diff.get("objects") or [], "  ")
+    for tgd in diff.get("task_groups") or []:
+        mark = _DIFF_MARK.get(tgd.get("type", "None"), " ")
+        counts = ", ".join(
+            f"{n} {label}" for label, n in (tgd.get("updates") or {}).items() if n
+        )
+        print(f'{mark} Task Group: {tgd["name"]!r}' + (f" ({counts})" if counts else ""))
+        if args.verbose or tgd.get("type") != "None":
+            _print_field_diffs(tgd.get("fields") or [], "    ")
+            _print_object_diffs(tgd.get("objects") or [], "    ")
+            for td in tgd.get("tasks") or []:
+                tmark = _DIFF_MARK.get(td.get("type", "None"), " ")
+                notes = ", ".join(td.get("annotations") or [])
+                print(f'    {tmark} Task: {td["name"]!r}' + (f" ({notes})" if notes else ""))
+                _print_field_diffs(td.get("fields") or [], "        ")
+                _print_object_diffs(td.get("objects") or [], "        ")
+
     failed = result.get("failed_tg_allocs") or {}
     if failed:
         print("\nPlacement failures:")
@@ -185,6 +224,9 @@ def cmd_plan(args) -> int:
                 print(f"    * Constraint {constraint!r} filtered {count} nodes")
     else:
         print("\nAll tasks successfully allocated.")
+    print(f'\nJob Modify Index: {result.get("job_modify_index", 0)}')
+    print('To submit the job with version verification run:\n')
+    print(f'nomad-tpu run -check-index {result.get("job_modify_index", 0)} {args.file}')
     return 0
 
 
@@ -442,10 +484,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("run", help="run a job")
     p.add_argument("file")
     p.add_argument("-detach", dest="detach", action="store_true")
+    p.add_argument("-check-index", dest="check_index", type=int, default=None)
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("plan", help="dry-run a job update")
     p.add_argument("file")
+    p.add_argument("-verbose", dest="verbose", action="store_true")
     p.set_defaults(fn=cmd_plan)
 
     p = sub.add_parser("status", help="display job status")

@@ -51,6 +51,7 @@ class Server:
             self.config.eval_nack_timeout, self.config.eval_delivery_limit
         )
         self.blocked_evals = BlockedEvals(self.broker.enqueue_all)
+        self._register_lock = threading.Lock()
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(
             self.plan_queue, self.fsm, self.log,
@@ -204,15 +205,35 @@ class Server:
     # ------------------------------------------------------------ jobs
 
     def job_register(
-        self, job: Job, triggered_by: str = consts.EVAL_TRIGGER_JOB_REGISTER
+        self, job: Job, triggered_by: str = consts.EVAL_TRIGGER_JOB_REGISTER,
+        enforce_index: bool = False, job_modify_index: int = 0,
     ) -> Tuple[str, int]:
-        """Job.Register (job_endpoint.go:41): validate, commit the job,
-        then commit its evaluation (periodic parents get no eval)."""
+        """Job.Register (job_endpoint.go:41): validate, optionally gate
+        on the job-modify index (:60-79, the `plan`→`run -check-index`
+        safe-deploy flow), commit the job, then commit its evaluation
+        (periodic parents get no eval)."""
         job.canonicalize()
         errors = job.validate()
         if errors:
             raise ValueError("; ".join(errors))
-        index = self.log.apply(fsm_msgs.JOB_REGISTER, {"job": job})
+        # The index check must be atomic with the commit or two concurrent
+        # `run -check-index N` submissions could both pass the gate.
+        with self._register_lock:
+            if enforce_index:
+                cur = self.fsm.state.job_by_id(job.id)
+                if job_modify_index == 0 and cur is not None:
+                    raise ValueError("Enforcing job modify index 0: job already exists")
+                if job_modify_index != 0:
+                    if cur is None:
+                        raise ValueError(
+                            f"Enforcing job modify index {job_modify_index}: job does not exist"
+                        )
+                    if cur.job_modify_index != job_modify_index:
+                        raise ValueError(
+                            f"Enforcing job modify index {job_modify_index}: job exists "
+                            f"with conflicting job modify index: {cur.job_modify_index}"
+                        )
+            index = self.log.apply(fsm_msgs.JOB_REGISTER, {"job": job})
 
         if job.is_periodic():
             return "", index
@@ -250,7 +271,7 @@ class Server:
         self.eval_update([ev])
         return ev.id
 
-    def job_plan(self, job: Job, diff: bool = False) -> dict:
+    def job_plan(self, job: Job, diff: bool = False, contextual: bool = False) -> dict:
         """Job.Plan dry-run (job_endpoint.go:545): run a real scheduler
         against a snapshot through the Harness; nothing commits."""
         from ..scheduler.testing import Harness
@@ -288,14 +309,26 @@ class Server:
             failed = plan.failed_tg_allocs
         if harness.evals:
             failed = harness.evals[-1].failed_tg_allocs or failed
-        return {
+
+        old_job = snap_store.job_by_id(job.id)
+        result = {
             "annotations": annotations,
             "failed_tg_allocs": failed,
             "next_periodic_launch": (
                 job.periodic.next_launch(time.time()) if job.is_periodic() else None
             ),
             "index": snap_store.latest_index(),
+            # Gate value for `run -check-index` (job_endpoint.go:626-630).
+            "job_modify_index": old_job.job_modify_index if old_job is not None else 0,
         }
+        if diff:
+            from ..structs.diff import annotate as annotate_diff
+            from ..structs.diff import job_diff
+
+            jd = job_diff(old_job, job, contextual=contextual)
+            annotate_diff(jd, annotations)
+            result["diff"] = jd
+        return result
 
     # ----------------------------------------------------------- nodes
 

@@ -96,8 +96,10 @@ def test_plan_shows_placements_and_failures(agent, tmp_path, capsys):
     )
     assert run_cli(addr, "plan", str(spec)) == 0
     out = capsys.readouterr().out
-    assert "place: 3" in out
+    assert "3 create" in out
+    assert "+ Job: 'plan-test'" in out
     assert "All tasks successfully allocated" in out
+    assert "run -check-index" in out
 
     bad = tmp_path / "bad.nomad"
     bad.write_text(
