@@ -32,6 +32,7 @@ class Client:
         self.evaluations = Evaluations(self)
         self.system = System(self)
         self.agent = Agent(self)
+        self.alloc_fs = AllocFS(self)
 
     # ------------------------------------------------------------------
 
@@ -64,6 +65,24 @@ class Client:
 
     def get(self, path: str, params: Optional[Dict] = None) -> Tuple[Any, int]:
         return self._request("GET", path, params=params)
+
+    def get_raw(self, path: str, params: Optional[Dict] = None) -> bytes:
+        """GET returning raw bytes (fs cat/readat endpoints)."""
+        url = self.address + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                message = str(e)
+            raise APIError(e.code, message) from None
+        except urllib.error.URLError as e:
+            raise APIError(0, f"failed to reach agent at {self.address}: {e.reason}") from None
 
     def put(self, path: str, body: Any = None, params: Optional[Dict] = None):
         return self._request("PUT", path, body=body, params=params)
@@ -228,4 +247,56 @@ class Agent:
 
     def leader(self) -> str:
         out, _ = self.c.get("/v1/status/leader")
+        return out
+
+
+class AllocFS:
+    """Allocation filesystem access (reference api/fs.go): list/stat/
+    read files in an alloc dir and follow task logs via offset polling."""
+
+    def __init__(self, client: Client):
+        self.c = client
+
+    def list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        out, _ = self.c.get(f"/v1/client/fs/ls/{alloc_id}", {"path": path})
+        return out
+
+    def stat(self, alloc_id: str, path: str) -> dict:
+        out, _ = self.c.get(f"/v1/client/fs/stat/{alloc_id}", {"path": path})
+        return out
+
+    def cat(self, alloc_id: str, path: str) -> bytes:
+        return self.c.get_raw(f"/v1/client/fs/cat/{alloc_id}", {"path": path})
+
+    def read_at(self, alloc_id: str, path: str, offset: int = 0,
+                limit: Optional[int] = None) -> bytes:
+        params = {"path": path, "offset": str(offset)}
+        if limit is not None:
+            params["limit"] = str(limit)
+        return self.c.get_raw(f"/v1/client/fs/readat/{alloc_id}", params)
+
+    def logs(self, alloc_id: str, task: str, ltype: str = "stdout",
+             offset: int = 0, origin: str = "start") -> dict:
+        import base64
+
+        out, _ = self.c.get(
+            f"/v1/client/fs/logs/{alloc_id}",
+            {"task": task, "type": ltype, "offset": str(offset), "origin": origin},
+        )
+        out["data"] = base64.b64decode(out.get("data") or "")
+        return out
+
+
+class ClientStats:
+    """Client host + per-alloc resource usage (api for /v1/client/stats)."""
+
+    def __init__(self, client: Client):
+        self.c = client
+
+    def host(self) -> dict:
+        out, _ = self.c.get("/v1/client/stats")
+        return out
+
+    def allocation(self, alloc_id: str) -> dict:
+        out, _ = self.c.get(f"/v1/client/allocation/{alloc_id}/stats")
         return out

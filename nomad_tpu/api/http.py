@@ -31,11 +31,22 @@ class HTTPError(Exception):
         self.message = message
 
 
-class HTTPServer:
-    """Embeds the server; serves the public API on localhost."""
+class RawResponse:
+    """Non-JSON reply (file contents for the fs endpoints)."""
 
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, data: bytes, content_type: str = "application/octet-stream"):
+        self.data = data
+        self.content_type = content_type
+
+
+class HTTPServer:
+    """Embeds the server; serves the public API on localhost. When a
+    co-located client agent is attached (dev agent), the /v1/client/*
+    fs + stats endpoints are served too (command/agent/fs_endpoint.go)."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0, client=None):
         self.server = server
+        self.client = client
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,9 +70,12 @@ class HTTPServer:
                     self._reply(200, body, index)
 
             def _reply(self, status, body, index=None):
-                data = json.dumps(body).encode()
+                if isinstance(body, RawResponse):
+                    data, ctype = body.data, body.content_type
+                else:
+                    data, ctype = json.dumps(body).encode(), "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 if index is not None:
                     self.send_header("X-Nomad-Index", str(index))
@@ -123,6 +137,13 @@ class HTTPServer:
             (r"^/v1/status/peers$", self._status_peers),
             (r"^/v1/agent/self$", self._agent_self),
             (r"^/v1/system/gc$", self._system_gc),
+            (r"^/v1/client/fs/ls/(?P<alloc_id>[^/]+)$", self._fs_ls),
+            (r"^/v1/client/fs/stat/(?P<alloc_id>[^/]+)$", self._fs_stat),
+            (r"^/v1/client/fs/cat/(?P<alloc_id>[^/]+)$", self._fs_cat),
+            (r"^/v1/client/fs/readat/(?P<alloc_id>[^/]+)$", self._fs_readat),
+            (r"^/v1/client/fs/logs/(?P<alloc_id>[^/]+)$", self._fs_logs),
+            (r"^/v1/client/stats$", self._client_stats),
+            (r"^/v1/client/allocation/(?P<alloc_id>[^/]+)/stats$", self._client_alloc_stats),
         ]
         for pattern, handler in route_handlers:
             m = re.match(pattern, path)
@@ -364,6 +385,63 @@ class HTTPServer:
     def _system_gc(self, method, query, body):
         self.server.force_gc()
         return {}
+
+    # --------------------------------------- client fs + stats routes
+
+    def _require_client(self):
+        if self.client is None:
+            raise HTTPError(501, "no client agent attached to this HTTP server")
+        return self.client
+
+    @staticmethod
+    def _q(query, name, default=""):
+        return query.get(name, [default])[0]
+
+    def _fs_ls(self, method, query, body, alloc_id):
+        fs = self._require_client().fs(alloc_id)
+        return fs.list_dir(self._q(query, "path", "/"))
+
+    def _fs_stat(self, method, query, body, alloc_id):
+        fs = self._require_client().fs(alloc_id)
+        return fs.stat_file(self._q(query, "path", "/"))
+
+    def _fs_cat(self, method, query, body, alloc_id):
+        fs = self._require_client().fs(alloc_id)
+        try:
+            return RawResponse(fs.read_at(self._q(query, "path", "/")))
+        except (FileNotFoundError, IsADirectoryError) as e:
+            raise HTTPError(404, str(e))
+
+    def _fs_readat(self, method, query, body, alloc_id):
+        fs = self._require_client().fs(alloc_id)
+        offset = int(self._q(query, "offset", "0"))
+        limit_s = self._q(query, "limit", "")
+        limit = int(limit_s) if limit_s else None
+        try:
+            return RawResponse(
+                fs.read_at(self._q(query, "path", "/"), offset, limit)
+            )
+        except (FileNotFoundError, IsADirectoryError) as e:
+            raise HTTPError(404, str(e))
+
+    def _fs_logs(self, method, query, body, alloc_id):
+        import base64
+
+        fs = self._require_client().fs(alloc_id)
+        out = fs.logs_read(
+            task=self._q(query, "task"),
+            ltype=self._q(query, "type", "stdout"),
+            offset=int(self._q(query, "offset", "0")),
+            origin=self._q(query, "origin", "start"),
+        )
+        out["data"] = base64.b64encode(out["data"]).decode()
+        return out
+
+    def _client_stats(self, method, query, body):
+        return self._require_client().host_stats()
+
+    def _client_alloc_stats(self, method, query, body, alloc_id):
+        return self._require_client().alloc_stats(alloc_id)
 
 
 def _job_stub(job: Job) -> dict:

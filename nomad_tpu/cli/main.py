@@ -398,6 +398,58 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_fs(args) -> int:
+    """Browse an allocation's filesystem (command/fs.go)."""
+    client = _client(args)
+    path = args.path or "/"
+    if args.stat:
+        st = client.alloc_fs.stat(args.alloc, path)
+        kind = "dir" if st["is_dir"] else "file"
+        print(f'{st["name"]}\t{kind}\t{st["size"]} bytes')
+        return 0
+    st = client.alloc_fs.stat(args.alloc, path)
+    if st["is_dir"]:
+        for ent in client.alloc_fs.list(args.alloc, path):
+            kind = "d" if ent["is_dir"] else "-"
+            print(f'{kind} {ent["size"]:>10}  {ent["name"]}')
+    else:
+        sys.stdout.buffer.write(client.alloc_fs.cat(args.alloc, path))
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Stream a task's stdout/stderr (command/logs.go): offset-poll the
+    logs endpoint; -f keeps following."""
+    client = _client(args)
+    ltype = "stderr" if args.stderr else "stdout"
+    task = args.task
+    if not task:
+        alloc, _ = client.allocations.info(args.alloc)
+        names = list(alloc.task_states or {})
+        if len(names) != 1:
+            print(
+                f"allocation has {len(names)} tasks, specify one of: {names}",
+                file=sys.stderr,
+            )
+            return 1
+        task = names[0]
+    if args.tail and args.n > 0:
+        out = client.alloc_fs.logs(args.alloc, task, ltype, offset=args.n, origin="end")
+    else:
+        out = client.alloc_fs.logs(args.alloc, task, ltype)
+    sys.stdout.buffer.write(out["data"])
+    sys.stdout.flush()
+    offset = out["offset"]
+    while args.follow:
+        time.sleep(1.0)
+        out = client.alloc_fs.logs(args.alloc, task, ltype, offset=offset)
+        if out["data"]:
+            sys.stdout.buffer.write(out["data"])
+            sys.stdout.flush()
+            offset = out["offset"]
+    return 0
+
+
 def cmd_agent_info(args) -> int:
     client = _client(args)
     info = client.agent.self()
@@ -442,6 +494,8 @@ def cmd_agent(args) -> int:
         )
     )
     client_agent.start()
+    # fs/stats endpoints are served off the co-located client.
+    http.client = client_agent
     print(f"    Client node: {client_agent.node.id}")
     try:
         while True:
@@ -523,6 +577,21 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("eval-status", help="display evaluation status")
     p.add_argument("eval")
     p.set_defaults(fn=cmd_eval_status)
+
+    p = sub.add_parser("fs", help="browse an allocation's filesystem")
+    p.add_argument("alloc")
+    p.add_argument("path", nargs="?", default="/")
+    p.add_argument("-stat", dest="stat", action="store_true")
+    p.set_defaults(fn=cmd_fs)
+
+    p = sub.add_parser("logs", help="stream a task's logs")
+    p.add_argument("alloc")
+    p.add_argument("task", nargs="?", default="")
+    p.add_argument("-stderr", dest="stderr", action="store_true")
+    p.add_argument("-f", dest="follow", action="store_true")
+    p.add_argument("-tail", dest="tail", action="store_true")
+    p.add_argument("-n", dest="n", type=int, default=0)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("agent-info", help="display agent stats")
     p.set_defaults(fn=cmd_agent_info)

@@ -261,3 +261,47 @@ class ClientAgent:
                 "num_allocs": len(self.alloc_runners),
                 "heartbeat_ttl": self.heartbeat_ttl,
             }
+
+    # ---------------------------------------- fs + stats (HTTP-facing)
+
+    def fs(self, alloc_id: str):
+        """AllocDir for a local allocation, backing the /v1/client/fs
+        endpoints (allocdir file APIs, alloc_dir.go:461-551)."""
+        with self._runners_lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise ValueError(f"unknown allocation {alloc_id!r}")
+        return runner.alloc_dir
+
+    def host_stats(self) -> dict:
+        """Host cpu/mem/disk usage (/v1/client/stats, stats/host.go)."""
+        from .stats import HostStatsCollector
+
+        if not hasattr(self, "_host_stats"):
+            self._host_stats = HostStatsCollector(
+                data_dirs=[self.config.alloc_dir]
+            )
+            self._host_stats.collect()  # prime the cpu delta
+        return self._host_stats.collect()
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        """Per-task cpu/rss usage for one allocation
+        (/v1/client/allocation/<id>/stats)."""
+        from .stats import ProcessStatsSampler
+
+        if not hasattr(self, "_proc_stats"):
+            self._proc_stats = ProcessStatsSampler()
+        with self._runners_lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise ValueError(f"unknown allocation {alloc_id!r}")
+        tasks = {}
+        for name, tr in runner.task_runners.items():
+            handle = tr.handle
+            usage = None
+            if handle is not None:
+                pid = handle.pid()
+                if pid is not None:
+                    usage = self._proc_stats.sample(pid)
+            tasks[name] = usage
+        return {"alloc_id": alloc_id, "tasks": tasks, "timestamp": time.time()}

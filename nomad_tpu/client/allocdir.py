@@ -84,6 +84,48 @@ class AllocDir:
             f.seek(offset)
             return f.read(limit if limit is not None else -1)
 
+    def logs_read(
+        self,
+        task: str,
+        ltype: str = "stdout",
+        offset: int = 0,
+        origin: str = "start",
+        limit: Optional[int] = None,
+    ) -> dict:
+        """Read from the newest rotated log file `<task>.<type>.<n>` in
+        the shared log dir (reference streams these via the framed
+        fs_endpoint.go log API; here reads are offset-based and the
+        caller re-polls with the returned offset to follow)."""
+        if ltype not in ("stdout", "stderr"):
+            raise ValueError(f"invalid log type {ltype!r}")
+        log_dir = self.log_dir()
+        prefix = f"{task}.{ltype}."
+        try:
+            indexes = sorted(
+                int(name[len(prefix):])
+                for name in os.listdir(log_dir)
+                if name.startswith(prefix) and name[len(prefix):].isdigit()
+            )
+        except OSError:
+            indexes = []
+        if not indexes:
+            return {"file": "", "data": b"", "offset": 0, "size": 0}
+        name = f"{prefix}{indexes[-1]}"
+        path = os.path.join(log_dir, name)
+        size = os.path.getsize(path)
+        if origin == "end":
+            offset = max(0, size - offset)
+        offset = min(offset, size)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(limit if limit is not None else -1)
+        return {
+            "file": name,
+            "data": data,
+            "offset": offset + len(data),
+            "size": size,
+        }
+
     def disk_used_mb(self) -> float:
         total = 0
         for dirpath, _, files in os.walk(self.root):

@@ -39,6 +39,10 @@ class DriverHandle:
         (task_runner.go:189)."""
         raise NotImplementedError
 
+    def pid(self) -> Optional[int]:
+        """OS pid for resource-usage sampling; None for virtual tasks."""
+        return None
+
     def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
         """Block for task exit; None on timeout."""
         raise NotImplementedError

@@ -36,6 +36,9 @@ class ProcessHandle(DriverHandle):
     def id(self) -> str:
         return f"{self.task_name}:{self.proc.pid}"
 
+    def pid(self) -> Optional[int]:
+        return self.proc.pid
+
     def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
         if not self._done.wait(timeout):
             return None

@@ -1,0 +1,140 @@
+"""Host and per-process resource usage.
+
+Reference: client/stats/host.go:187 (HostStats: cpu/mem/disk/uptime,
+served at /v1/client/stats) and the executor's pid-scan usage sampling
+(client/driver/executor/executor.go). Linux /proc is read directly;
+non-Linux hosts degrade to loadavg-only.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _read_meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0].rstrip(":")] = int(parts[1]) * 1024  # kB -> bytes
+    except OSError:
+        pass
+    return out
+
+
+def _read_cpu_times() -> Optional[List[int]]:
+    try:
+        with open("/proc/stat") as f:
+            first = f.readline().split()
+        if first and first[0] == "cpu":
+            return [int(x) for x in first[1:]]
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+class HostStatsCollector:
+    """Samples host cpu/memory/disk; cpu% is computed between calls."""
+
+    def __init__(self, data_dirs: Optional[List[str]] = None):
+        self.data_dirs = data_dirs or []
+        self._last_cpu = _read_cpu_times()
+        self._last_ts = time.time()
+
+    def collect(self) -> dict:
+        now = time.time()
+        mem = _read_meminfo()
+        cpu_pct = 0.0
+        cur = _read_cpu_times()
+        if cur is not None and self._last_cpu is not None:
+            delta = [c - l for c, l in zip(cur, self._last_cpu)]
+            total = sum(delta)
+            idle = delta[3] + (delta[4] if len(delta) > 4 else 0)  # idle+iowait
+            if total > 0:
+                cpu_pct = 100.0 * (total - idle) / total
+        self._last_cpu = cur
+        self._last_ts = now
+
+        disks = []
+        for d in self.data_dirs:
+            try:
+                st = os.statvfs(d)
+                size = st.f_blocks * st.f_frsize
+                avail = st.f_bavail * st.f_frsize
+                disks.append({
+                    "device": d,
+                    "size": size,
+                    "used": size - st.f_bfree * st.f_frsize,
+                    "available": avail,
+                    "used_percent": 100.0 * (size - st.f_bfree * st.f_frsize) / size if size else 0.0,
+                })
+            except OSError:
+                pass
+
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+
+        uptime = 0.0
+        try:
+            with open("/proc/uptime") as f:
+                uptime = float(f.read().split()[0])
+        except (OSError, ValueError):
+            pass
+
+        return {
+            "timestamp": now,
+            "cpu_percent": cpu_pct,
+            "load_avg": [load1, load5, load15],
+            "memory": {
+                "total": mem.get("MemTotal", 0),
+                "available": mem.get("MemAvailable", 0),
+                "used": max(0, mem.get("MemTotal", 0) - mem.get("MemAvailable", 0)),
+                "free": mem.get("MemFree", 0),
+            },
+            "disk_stats": disks,
+            "uptime": uptime,
+        }
+
+
+class ProcessStatsSampler:
+    """Per-pid cpu%/rss via /proc/<pid>/stat + statm; cpu% is computed
+    between successive sample() calls for the same pid."""
+
+    def __init__(self):
+        self._last: Dict[int, tuple] = {}  # pid -> (proc_ticks, wall_ts)
+
+    def sample(self, pid: int) -> Optional[dict]:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                fields = f.read().rsplit(")", 1)[1].split()
+            utime, stime = int(fields[11]), int(fields[12])
+            with open(f"/proc/{pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+        except (OSError, IndexError, ValueError):
+            self._last.pop(pid, None)
+            return None
+
+        ticks = utime + stime
+        now = time.time()
+        cpu_pct = 0.0
+        last = self._last.get(pid)
+        if last is not None:
+            dticks, dt = ticks - last[0], now - last[1]
+            if dt > 0:
+                cpu_pct = 100.0 * (dticks / _CLK_TCK) / dt
+        self._last[pid] = (ticks, now)
+        return {
+            "pid": pid,
+            "cpu_percent": cpu_pct,
+            "rss_bytes": rss_pages * _PAGE_SIZE,
+            "cpu_ticks": ticks,
+        }

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from ..state import PeriodicLaunch, StateStore
@@ -40,6 +41,9 @@ class FSM:
         self.broker = None
         self.blocked_evals = None
         self.periodic = None
+        self.last_applied_index = 0
+        # Recent apply outcomes (rejections), bounded; keyed by index.
+        self._outcomes: "OrderedDict[int, object]" = OrderedDict()
         self._handlers: Dict[str, Callable] = {
             NODE_REGISTER: self._apply_node_register,
             NODE_DEREGISTER: self._apply_node_deregister,
@@ -60,7 +64,15 @@ class FSM:
         handler = self._handlers.get(msg_type)
         if handler is None:
             raise ValueError(f"unknown log message type {msg_type!r}")
-        return handler(index, payload)
+        result = handler(index, payload)
+        self.last_applied_index = index
+        return result
+
+    def outcome(self, index: int) -> object:
+        """Deterministic apply outcome for a recent log index (e.g. an
+        enforce-index rejection). Every replica computes the same value
+        from identical state, so reading it locally is safe."""
+        return self._outcomes.get(index)
 
     # ------------------------------------------------------------ nodes
 
@@ -94,6 +106,29 @@ class FSM:
 
     def _apply_job_register(self, index: int, payload: dict):
         job: Job = payload["job"]
+        # Enforce-index gate (job_endpoint.go:60-79) is evaluated here,
+        # inside the serialized apply path, so the check-and-commit is
+        # atomic and identical on every replica — two concurrent
+        # `run -check-index N` submissions commit at different log
+        # positions and the second deterministically loses.
+        if payload.get("enforce_index"):
+            jmi = int(payload.get("job_modify_index") or 0)
+            cur = self.state.job_by_id(job.id)
+            err = None
+            if jmi == 0 and cur is not None:
+                err = "Enforcing job modify index 0: job already exists"
+            elif jmi != 0 and cur is None:
+                err = f"Enforcing job modify index {jmi}: job does not exist"
+            elif jmi != 0 and cur.job_modify_index != jmi:
+                err = (
+                    f"Enforcing job modify index {jmi}: job exists "
+                    f"with conflicting job modify index: {cur.job_modify_index}"
+                )
+            if err is not None:
+                self._outcomes[index] = err
+                while len(self._outcomes) > 1024:
+                    self._outcomes.popitem(last=False)
+                return err
         self.state.upsert_job(index, job)
         if self.periodic is not None and job.is_periodic():
             self.periodic.add(self.state.job_by_id(job.id))

@@ -51,7 +51,6 @@ class Server:
             self.config.eval_nack_timeout, self.config.eval_delivery_limit
         )
         self.blocked_evals = BlockedEvals(self.broker.enqueue_all)
-        self._register_lock = threading.Lock()
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(
             self.plan_queue, self.fsm, self.log,
@@ -216,24 +215,20 @@ class Server:
         errors = job.validate()
         if errors:
             raise ValueError("; ".join(errors))
-        # The index check must be atomic with the commit or two concurrent
-        # `run -check-index N` submissions could both pass the gate.
-        with self._register_lock:
-            if enforce_index:
-                cur = self.fsm.state.job_by_id(job.id)
-                if job_modify_index == 0 and cur is not None:
-                    raise ValueError("Enforcing job modify index 0: job already exists")
-                if job_modify_index != 0:
-                    if cur is None:
-                        raise ValueError(
-                            f"Enforcing job modify index {job_modify_index}: job does not exist"
-                        )
-                    if cur.job_modify_index != job_modify_index:
-                        raise ValueError(
-                            f"Enforcing job modify index {job_modify_index}: job exists "
-                            f"with conflicting job modify index: {cur.job_modify_index}"
-                        )
-            index = self.log.apply(fsm_msgs.JOB_REGISTER, {"job": job})
+        # The enforce-index gate is decided inside the FSM apply (same
+        # log position -> same verdict on every replica), which makes
+        # check+commit atomic even when this server is a raft follower
+        # forwarding the write to the leader.
+        payload = {"job": job}
+        if enforce_index:
+            payload["enforce_index"] = True
+            payload["job_modify_index"] = job_modify_index
+        index = self.log.apply(fsm_msgs.JOB_REGISTER, payload)
+        if enforce_index:
+            self._wait_applied(index)
+            err = self.fsm.outcome(index)
+            if err is not None:
+                raise ValueError(str(err))
 
         if job.is_periodic():
             return "", index
@@ -242,6 +237,15 @@ class Server:
         ev = new_eval(stored, triggered_by)
         self.eval_update([ev])
         return ev.id, index
+
+    def _wait_applied(self, index: int, timeout: float = 5.0) -> None:
+        """Wait until the local FSM has applied `index` (a follower's
+        FSM lags the leader commit it just forwarded)."""
+        deadline = time.monotonic() + timeout
+        while self.fsm.last_applied_index < index:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"timed out waiting for index {index}")
+            time.sleep(0.005)
 
     def job_deregister(self, job_id: str, create_eval: bool = True) -> Optional[str]:
         job = self.fsm.state.job_by_id(job_id)

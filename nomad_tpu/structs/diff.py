@@ -120,13 +120,16 @@ def _map_diff(name: str, old: Dict, new: Dict, contextual: bool) -> Optional[Obj
         fd = _field_diff(f"{name}[{k}]", old.get(k), new.get(k), contextual)
         if fd is not None:
             fields.append(fd)
-    return _wrap_object(name, fields, [], old, new)
+    return _wrap_object(name, fields, [], old, new, contextual)
 
 
 def _wrap_object(name: str, fields: List[FieldDiff], objects: List[ObjectDiff],
-                 old: Any, new: Any) -> Optional[ObjectDiff]:
-    changed = [f for f in fields if f.type != DIFF_NONE] or objects
+                 old: Any, new: Any, contextual: bool = False) -> Optional[ObjectDiff]:
+    changed = ([f for f in fields if f.type != DIFF_NONE]
+               or [o for o in objects if o.type != DIFF_NONE])
     if not changed:
+        if contextual and (fields or objects):
+            return ObjectDiff(DIFF_NONE, name, fields, objects)
         return None
     if old in (None, {}, []):
         typ = DIFF_ADDED
@@ -150,7 +153,7 @@ def _scalar_list_diff(name: str, old: List, new: List, contextual: bool) -> Opti
             fields.append(FieldDiff(DIFF_ADDED, name, "", v))
         else:
             fields.append(FieldDiff(DIFF_DELETED, name, v, ""))
-    return _wrap_object(name, fields, [], old, new)
+    return _wrap_object(name, fields, [], old, new, contextual)
 
 
 def _object_set_diff(name: str, old: List, new: List) -> List[ObjectDiff]:
@@ -220,7 +223,7 @@ def _dataclass_diff(name: str, old: Any, new: Any, contextual: bool,
             if ov == nv and not contextual:
                 continue
             sub_f, sub_o = _dataclass_diff(f.name, ov, nv, contextual)
-            od = _wrap_object(f.name, sub_f, sub_o, ov, nv)
+            od = _wrap_object(f.name, sub_f, sub_o, ov, nv, contextual)
             if od is not None:
                 objects.append(od)
     return fields, objects
@@ -237,7 +240,7 @@ def _named_list_diff(name: str, old: List, new: List, contextual: bool) -> List[
         if ov == nv and not contextual:
             continue
         sub_f, sub_o = _dataclass_diff(singular, ov, nv, contextual)
-        od = _wrap_object(f"{singular}[{k}]", sub_f, sub_o, ov, nv)
+        od = _wrap_object(f"{singular}[{k}]", sub_f, sub_o, ov, nv, contextual)
         if od is not None:
             out.append(od)
     return out

@@ -1,0 +1,171 @@
+"""Alloc filesystem endpoints, log reading, and client stats (reference
+command/agent/fs_endpoint.go, client/allocdir file APIs, stats/host.go)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.api.client import APIError, Client
+from nomad_tpu.client import ClientAgent, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    cfg = ClientConfig(
+        servers=[http.addr],
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        options={"driver.raw_exec.enable": "1"},
+        dev_mode=True,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    agent.start()
+    http.client = agent
+    yield server, agent, Client(http.addr, timeout=10.0)
+    agent.shutdown(destroy_allocs=True)
+    http.stop()
+    server.shutdown()
+
+
+def _run_echo_job(server, text="hello fs", run_for=30):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", f"echo '{text}'; sleep {run_for}"],
+    }
+    task.resources.networks = []
+    server.job_register(job)
+    assert wait_until(
+        lambda: any(
+            a.client_status == consts.ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+    )
+    return server.fsm.state.allocs_by_job(job.id)[0]
+
+
+def test_fs_list_stat_cat(cluster):
+    server, agent, api = cluster
+    alloc = _run_echo_job(server)
+
+    # alloc root has the shared dir plus one dir per task
+    names = {e["name"] for e in api.alloc_fs.list(alloc.id, "/")}
+    assert "alloc" in names and "web" in names
+
+    st = api.alloc_fs.stat(alloc.id, "alloc/logs")
+    assert st["is_dir"]
+
+    # stdout log is under alloc/logs/<task>.stdout.0
+    assert wait_until(
+        lambda: any(
+            e["name"] == "web.stdout.0" and e["size"] > 0
+            for e in api.alloc_fs.list(alloc.id, "alloc/logs")
+        )
+    )
+    data = api.alloc_fs.cat(alloc.id, "alloc/logs/web.stdout.0")
+    assert b"hello fs" in data
+
+    # read_at with offset/limit
+    part = api.alloc_fs.read_at(alloc.id, "alloc/logs/web.stdout.0", offset=6, limit=2)
+    assert part == b"fs"
+
+
+def test_fs_path_escape_rejected(cluster):
+    server, agent, api = cluster
+    alloc = _run_echo_job(server)
+    with pytest.raises(APIError) as e:
+        api.alloc_fs.list(alloc.id, "../../")
+    assert e.value.status == 403
+
+
+def test_fs_unknown_alloc_404s_or_errors(cluster):
+    server, agent, api = cluster
+    with pytest.raises(APIError):
+        api.alloc_fs.list("no-such-alloc", "/")
+
+
+def test_logs_endpoint_and_follow_offsets(cluster):
+    server, agent, api = cluster
+    alloc = _run_echo_job(server, text="line one")
+
+    assert wait_until(
+        lambda: api.alloc_fs.logs(alloc.id, "web")["data"] != b""
+    )
+    out = api.alloc_fs.logs(alloc.id, "web")
+    assert b"line one" in out["data"]
+    offset = out["offset"]
+
+    # no new output -> empty poll at the returned offset
+    again = api.alloc_fs.logs(alloc.id, "web", offset=offset)
+    assert again["data"] == b""
+
+    # tail-from-end origin
+    tail = api.alloc_fs.logs(alloc.id, "web", offset=4, origin="end")
+    assert tail["data"] == b"one\n"
+
+
+def test_client_host_stats(cluster):
+    server, agent, api = cluster
+    from nomad_tpu.api.client import ClientStats
+
+    stats = ClientStats(api)
+    host = stats.host()
+    assert host["memory"]["total"] > 0
+    assert host["uptime"] > 0
+    assert isinstance(host["load_avg"], list) and len(host["load_avg"]) == 3
+
+
+def test_alloc_stats_samples_real_pid(cluster):
+    server, agent, api = cluster
+    alloc = _run_echo_job(server)
+    from nomad_tpu.api.client import ClientStats
+
+    stats = ClientStats(api)
+    out = stats.allocation(alloc.id)
+    usage = out["tasks"]["web"]
+    assert usage is not None and usage["pid"] > 0
+    assert usage["rss_bytes"] > 0
+
+
+def test_mock_driver_task_has_no_pid_stats(cluster):
+    server, agent, api = cluster
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": 1e9}
+    task.resources.networks = []
+    server.job_register(job)
+    assert wait_until(
+        lambda: any(
+            a.client_status == consts.ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+    )
+    alloc = server.fsm.state.allocs_by_job(job.id)[0]
+    out = agent.alloc_stats(alloc.id)
+    assert out["tasks"]["web"] is None
