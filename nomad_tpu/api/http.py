@@ -18,6 +18,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from ..state import watch
 from ..structs import Allocation, Evaluation, Job, Node
+from ..utils import metrics
 from ..utils.codec import from_dict, to_dict
 
 MAX_BLOCKING_WAIT = 300.0  # rpc.go:34
@@ -56,6 +57,7 @@ class HTTPServer:
                 pass
 
             def _dispatch(self):
+                _start = time.monotonic()
                 try:
                     body = api.handle(self)
                 except HTTPError as e:
@@ -68,6 +70,7 @@ class HTTPServer:
                 else:
                     index = api.server.fsm.state.latest_index()
                     self._reply(200, body, index)
+                metrics.measure_since(("http", "request"), _start)
 
             def _reply(self, status, body, index=None):
                 if isinstance(body, RawResponse):
@@ -380,7 +383,11 @@ class HTTPServer:
         return [self.addr]
 
     def _agent_self(self, method, query, body):
-        return {"stats": self.server.stats(), "config": to_dict(self.server.config)}
+        return {
+            "stats": self.server.stats(),
+            "config": to_dict(self.server.config),
+            "metrics": metrics.get_metrics().snapshot(),
+        }
 
     def _system_gc(self, method, query, body):
         self.server.force_gc()

@@ -473,6 +473,11 @@ def cmd_agent(args) -> int:
         print("only -dev mode is supported for now", file=sys.stderr)
         return 1
 
+    if args.statsd:
+        from ..utils import metrics
+
+        metrics.configure(statsd_addr=args.statsd)
+
     scheduler_factories = {}
     if args.tpu:
         scheduler_factories = {"service": "service-tpu", "batch": "batch-tpu"}
@@ -520,6 +525,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("agent", help="run an agent")
     p.add_argument("-dev", dest="dev", action="store_true")
+    p.add_argument("-statsd", dest="statsd", default="", help="statsd UDP addr host:port")
     p.add_argument("-bind", dest="bind", default="127.0.0.1")
     p.add_argument("-port", dest="port", type=int, default=4646)
     p.add_argument("-num-schedulers", dest="num_schedulers", type=int, default=2)

@@ -32,6 +32,10 @@ class ServerConfig:
     eval_nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
 
+    # Telemetry gauge emission period (command.go:570 setupTelemetry)
+    telemetry_interval: float = 10.0
+    statsd_addr: str = ""
+
     # Heartbeats (config.go:235-238)
     min_heartbeat_ttl: float = 10.0
     max_heartbeats_per_second: float = 50.0

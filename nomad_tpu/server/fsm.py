@@ -9,10 +9,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from ..state import PeriodicLaunch, StateStore
+from ..utils import metrics
 from ..structs import Allocation, Evaluation, Job, Node, consts
 from .timetable import TimeTable
 
@@ -64,7 +66,9 @@ class FSM:
         handler = self._handlers.get(msg_type)
         if handler is None:
             raise ValueError(f"unknown log message type {msg_type!r}")
+        start = time.monotonic()
         result = handler(index, payload)
+        metrics.measure_since(("fsm", msg_type), start)
         self.last_applied_index = index
         return result
 

@@ -14,10 +14,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import Allocation, Plan, PlanResult, allocs_fit, consts, remove_allocs
+from ..utils import metrics
 from .fsm import ALLOC_UPDATE
 from .plan_queue import PendingPlan, PlanQueue
 
@@ -90,10 +92,14 @@ class PlanApplier:
 
     def _apply_one(self, plan: Plan) -> PlanResult:
         snapshot = self.fsm.state.snapshot()
+        start = time.monotonic()
         result = self._evaluate_plan(snapshot, plan)
+        metrics.measure_since(("plan", "evaluate"), start)
         if result.is_no_op():
             return result
+        start = time.monotonic()
         alloc_index = self._commit(plan, result)
+        metrics.measure_since(("plan", "submit"), start)
         result.alloc_index = alloc_index
         return result
 

@@ -89,6 +89,43 @@ class Server:
             self.workers.append(worker)
             worker.start()
         self.establish_leadership()
+        self._start_telemetry()
+
+    def _start_telemetry(self) -> None:
+        """Periodic broker/plan-queue/heartbeat gauges (the reference
+        leader loops emit these via go-metrics, eval_broker.go:650,
+        server.go:262-271)."""
+        from ..utils import metrics
+
+        if self.config.statsd_addr:
+            metrics.get_metrics().add_statsd(self.config.statsd_addr)
+
+        def emit():
+            while not self._telemetry_stop.wait(self.config.telemetry_interval):
+                try:
+                    if not self._leader:
+                        # Broker/plan-queue/heartbeats are leader-only
+                        # (eval_broker.go:650 runs in the leader loop);
+                        # followers emitting zeros would clobber the
+                        # leader's gauges in shared sinks.
+                        continue
+                    broker = self.broker.stats()
+                    metrics.set_gauge(("broker", "total_ready"), broker["total_ready"])
+                    metrics.set_gauge(("broker", "total_unacked"), broker["total_unacked"])
+                    metrics.set_gauge(("broker", "total_blocked"), broker["total_blocked"])
+                    metrics.set_gauge(
+                        ("blocked_evals", "total_blocked"),
+                        self.blocked_evals.stats()["total_blocked"],
+                    )
+                    metrics.set_gauge(("plan", "queue_depth"), self.plan_queue.depth())
+                    metrics.set_gauge(("heartbeat", "active"), self.heartbeats.count())
+                except Exception:  # noqa: BLE001 — telemetry must not die
+                    self.logger.exception("telemetry emit failed")
+
+        self._telemetry_stop = threading.Event()
+        t = threading.Thread(target=emit, name="telemetry", daemon=True)
+        t.start()
+        self._telemetry_thread = t
 
     def start_with_raft(self, node_id: str, peers: List[str], transport,
                         cluster: Dict[str, "Server"]) -> None:
@@ -109,6 +146,7 @@ class Server:
             self.workers.append(worker)
             worker.start()
         self.raft.start()
+        self._start_telemetry()
 
     def _leadership_changed(self, is_leader: bool) -> None:
         # Serialized: elections can flap faster than the services
@@ -140,6 +178,8 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if getattr(self, "_telemetry_stop", None) is not None:
+            self._telemetry_stop.set()
         self.revoke_leadership()
         if self.raft is not None:
             self.raft.stop()

@@ -16,6 +16,7 @@ import time
 from typing import Optional, Tuple
 
 from ..scheduler import new_scheduler
+from ..utils import metrics
 from ..structs import Evaluation, Plan, PlanResult, consts
 
 DEQUEUE_TIMEOUT = 0.5
@@ -70,21 +71,28 @@ class Worker:
     def run(self) -> None:
         while not self._stop.is_set():
             self._check_paused()
+            start = time.monotonic()
             ev, token = self.server.eval_dequeue(
                 self.server.config.enabled_schedulers, DEQUEUE_TIMEOUT
             )
             if ev is None:
                 continue
+            metrics.measure_since(("worker", "dequeue_eval"), start)
+            start = time.monotonic()
             if not self._wait_for_index(ev.modify_index, timeout=5.0):
                 self.server.eval_nack(ev.id, token)
                 continue
+            metrics.measure_since(("worker", "wait_for_index"), start)
             self._eval, self._token = ev, token
+            start = time.monotonic()
             try:
                 self._invoke_scheduler(ev)
             except Exception:
                 self.logger.exception("eval %s failed", ev.id)
                 self._safe_nack(ev.id, token)
                 continue
+            finally:
+                metrics.measure_since(("worker", "invoke_scheduler", ev.type), start)
             try:
                 self.server.eval_ack(ev.id, token)
             except ValueError:
@@ -117,6 +125,7 @@ class Worker:
     # ------------------------------------------------ Planner interface
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        start = time.monotonic()
         plan.eval_token = self._token
         # The Nack clock stops while the plan waits in the queue
         # (plan_endpoint.go:16).
@@ -131,6 +140,7 @@ class Worker:
                 self.server.eval_resume_nack(self._eval.id, self._token)
             except ValueError:
                 pass
+        metrics.measure_since(("worker", "submit_plan"), start)
         if result.refresh_index:
             # Stale snapshot: catch up and hand back fresh state.
             self._wait_for_index(result.refresh_index, timeout=5.0)

@@ -1,0 +1,197 @@
+"""Telemetry: counters, gauges, and timing samples with pluggable sinks.
+
+Reference: the go-metrics fanout wired in command/agent/command.go:570
+(setupTelemetry) — an in-memory interval sink (signal-dumpable) plus
+optional statsd/statsite UDP sinks — and the `MeasureSince` calls
+sprinkled through worker.go:152,248,290, plan_apply.go:168,195, fsm.go
+per-handler, and rpc.go:168-172.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class _Interval:
+    __slots__ = ("start", "counters", "gauges", "samples")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.counters: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0])  # count, sum
+        self.gauges: Dict[str, float] = {}
+        self.samples: Dict[str, List[float]] = defaultdict(
+            lambda: [0, 0.0, float("inf"), float("-inf")]  # count, sum, min, max
+        )
+
+
+class InmemSink:
+    """Ring of aggregation intervals (go-metrics inmem.go analog)."""
+
+    def __init__(self, interval: float = 10.0, retain: int = 60):
+        self.interval = interval
+        self.retain = retain
+        self._lock = threading.Lock()
+        self._intervals: List[_Interval] = [_Interval(time.time())]
+
+    def _current(self) -> _Interval:
+        now = time.time()
+        cur = self._intervals[-1]
+        if now - cur.start >= self.interval:
+            cur = _Interval(now)
+            self._intervals.append(cur)
+            if len(self._intervals) > self.retain:
+                del self._intervals[: len(self._intervals) - self.retain]
+        return cur
+
+    def incr_counter(self, name: str, n: float) -> None:
+        with self._lock:
+            c = self._current().counters[name]
+            c[0] += 1
+            c[1] += n
+
+    def set_gauge(self, name: str, v: float) -> None:
+        with self._lock:
+            self._current().gauges[name] = v
+
+    def add_sample(self, name: str, v: float) -> None:
+        with self._lock:
+            s = self._current().samples[name]
+            s[0] += 1
+            s[1] += v
+            s[2] = min(s[2], v)
+            s[3] = max(s[3], v)
+
+    def snapshot(self, intervals: int = 2) -> List[dict]:
+        """The most recent aggregation intervals, newest last."""
+        with self._lock:
+            out = []
+            for iv in self._intervals[-intervals:]:
+                out.append({
+                    "start": iv.start,
+                    "counters": {
+                        k: {"count": v[0], "sum": v[1]} for k, v in iv.counters.items()
+                    },
+                    "gauges": dict(iv.gauges),
+                    "samples": {
+                        k: {
+                            "count": v[0],
+                            "sum": v[1],
+                            "min": v[2] if v[0] else 0.0,
+                            "max": v[3] if v[0] else 0.0,
+                            "mean": (v[1] / v[0]) if v[0] else 0.0,
+                        }
+                        for k, v in iv.samples.items()
+                    },
+                })
+            return out
+
+
+class StatsdSink:
+    """Plain UDP statsd datagrams (`name:value|type`)."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 8125))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass  # telemetry must never take the agent down
+
+    def incr_counter(self, name: str, n: float) -> None:
+        self._send(f"{name}:{n}|c")
+
+    def set_gauge(self, name: str, v: float) -> None:
+        self._send(f"{name}:{v}|g")
+
+    def add_sample(self, name: str, v: float) -> None:
+        self._send(f"{name}:{v}|ms")
+
+
+class Metrics:
+    """Fanout front-end; the module-global instance is what call sites
+    use (go-metrics global metrics object)."""
+
+    def __init__(self, prefix: str = "nomad_tpu"):
+        self.prefix = prefix
+        self.inmem = InmemSink()
+        self._sinks: List[object] = [self.inmem]
+        self._statsd_addrs: set = set()
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def add_statsd(self, addr: str) -> None:
+        """Attach a statsd sink once per address (servers and the CLI
+        may both request the same target)."""
+        if addr in self._statsd_addrs:
+            return
+        self._statsd_addrs.add(addr)
+        self.add_sink(StatsdSink(addr))
+
+    def _name(self, parts) -> str:
+        if isinstance(parts, str):
+            return f"{self.prefix}.{parts}"
+        return ".".join([self.prefix, *parts])
+
+    def incr_counter(self, parts, n: float = 1) -> None:
+        name = self._name(parts)
+        for s in self._sinks:
+            s.incr_counter(name, n)
+
+    def set_gauge(self, parts, v: float) -> None:
+        name = self._name(parts)
+        for s in self._sinks:
+            s.set_gauge(name, v)
+
+    def add_sample(self, parts, v: float) -> None:
+        name = self._name(parts)
+        for s in self._sinks:
+            s.add_sample(name, v)
+
+    def measure_since(self, parts, start: float) -> None:
+        """Record elapsed milliseconds since `start` (time.monotonic)."""
+        self.add_sample(parts, (time.monotonic() - start) * 1000.0)
+
+    def snapshot(self) -> List[dict]:
+        return self.inmem.snapshot()
+
+
+_global = Metrics()
+
+
+def get_metrics() -> Metrics:
+    return _global
+
+
+def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None) -> Metrics:
+    """Re-init the global registry from agent telemetry config
+    (command.go:570 setupTelemetry)."""
+    global _global
+    m = Metrics(prefix or "nomad_tpu")
+    if statsd_addr:
+        m.add_statsd(statsd_addr)
+    _global = m
+    return m
+
+
+def incr_counter(parts, n: float = 1) -> None:
+    _global.incr_counter(parts, n)
+
+
+def set_gauge(parts, v: float) -> None:
+    _global.set_gauge(parts, v)
+
+
+def add_sample(parts, v: float) -> None:
+    _global.add_sample(parts, v)
+
+
+def measure_since(parts, start: float) -> None:
+    _global.measure_since(parts, start)

@@ -1,0 +1,111 @@
+"""Telemetry: inmem interval sink, statsd UDP sink, and the gauges/
+samples emitted by the control plane (reference go-metrics fanout,
+command/agent/command.go:570)."""
+
+import socket
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.utils.metrics import InmemSink, Metrics, StatsdSink
+
+
+def test_inmem_counter_gauge_sample_aggregation():
+    sink = InmemSink(interval=60.0)
+    sink.incr_counter("a.b", 1)
+    sink.incr_counter("a.b", 3)
+    sink.set_gauge("g", 7.0)
+    sink.set_gauge("g", 9.0)  # last write wins within the interval
+    for v in (5.0, 1.0, 3.0):
+        sink.add_sample("s", v)
+
+    snap = sink.snapshot()[-1]
+    assert snap["counters"]["a.b"] == {"count": 2, "sum": 4}
+    assert snap["gauges"]["g"] == 9.0
+    s = snap["samples"]["s"]
+    assert s["count"] == 3 and s["min"] == 1.0 and s["max"] == 5.0
+    assert abs(s["mean"] - 3.0) < 1e-9
+
+
+def test_inmem_interval_rotation():
+    sink = InmemSink(interval=0.01, retain=3)
+    for i in range(6):
+        sink.incr_counter("c", 1)
+        time.sleep(0.015)
+    assert len(sink._intervals) <= 3
+
+
+def test_metrics_prefix_and_measure_since():
+    m = Metrics(prefix="test")
+    start = time.monotonic()
+    time.sleep(0.01)
+    m.measure_since(("stage", "x"), start)
+    snap = m.snapshot()[-1]
+    (name,) = snap["samples"].keys()
+    assert name == "test.stage.x"
+    assert snap["samples"][name]["max"] >= 10.0  # milliseconds
+
+
+def test_statsd_sink_sends_datagrams():
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+
+    m = Metrics(prefix="nomad_tpu")
+    m.add_sink(StatsdSink(f"127.0.0.1:{port}"))
+    m.incr_counter(("rpc", "query"), 1)
+    m.set_gauge(("broker", "depth"), 5)
+    m.add_sample(("plan", "evaluate"), 12.5)
+
+    got = set()
+    for _ in range(3):
+        got.add(recv.recv(1024).decode())
+    assert "nomad_tpu.rpc.query:1|c" in got
+    assert "nomad_tpu.broker.depth:5|g" in got
+    assert "nomad_tpu.plan.evaluate:12.5|ms" in got
+    recv.close()
+
+
+def test_server_emits_worker_and_fsm_samples():
+    """End to end: registering and scheduling a job must produce fsm/
+    worker/plan timing samples in the global registry."""
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.utils import metrics as gm
+
+    gm.configure()  # fresh global registry
+    s = Server(ServerConfig(num_schedulers=1, telemetry_interval=0.05))
+    s.start()
+    try:
+        for i in range(3):
+            s.fsm.state.upsert_node(i + 1, mock.node())
+        job = mock.job()
+        s.job_register(job)
+
+        deadline = time.monotonic() + 5.0
+        needed = {
+            "nomad_tpu.fsm.job_register",
+            "nomad_tpu.worker.invoke_scheduler.service",
+            "nomad_tpu.plan.evaluate",
+        }
+        while time.monotonic() < deadline:
+            seen = set()
+            for iv in gm.get_metrics().snapshot():
+                seen |= set(iv["samples"])
+            if needed <= seen:
+                break
+            time.sleep(0.05)
+        assert needed <= seen, f"missing: {needed - seen}"
+
+        # gauge loop fires on telemetry_interval
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            gauges = {}
+            for iv in gm.get_metrics().snapshot():
+                gauges.update(iv["gauges"])
+            if "nomad_tpu.broker.total_ready" in gauges:
+                break
+            time.sleep(0.05)
+        assert "nomad_tpu.broker.total_ready" in gauges
+    finally:
+        s.shutdown()
+        gm.configure()  # reset global for other tests
