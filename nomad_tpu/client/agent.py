@@ -45,8 +45,9 @@ class ClientAgent:
 
         self.node = node or Node()
         self._setup_node()
-        # Restore a persisted node identity before first contact
-        # (client.go:496 restoreState).
+        self._restored_handles: Dict[str, Dict[str, str]] = {}
+        # Restore a persisted node identity + task handles before first
+        # contact (client.go:496 restoreState).
         self._restore_state()
 
         self.alloc_runners: Dict[str, AllocRunner] = {}
@@ -175,13 +176,45 @@ class ClientAgent:
                         runner.update(alloc)
                     continue
                 if alloc.terminal_status():
+                    self._kill_restored_handles(alloc.id)
                     continue
                 runner = AllocRunner(
                     alloc, self.config.alloc_dir, self._mark_dirty,
                     self.config.max_kill_timeout,
+                    restored_handles=self._restored_handles.pop(alloc.id, None),
+                    persist_cb=self._save_state,
                 )
                 self.alloc_runners[alloc.id] = runner
                 runner.run()
+            # Allocs that disappeared (or went terminal) while the
+            # client was down never re-arrive, but their executors are
+            # still running the task: reap them (the reference restores
+            # runners from disk and destroys unneeded ones).
+            for alloc_id in list(self._restored_handles):
+                if alloc_id not in pulled_ids:
+                    self._kill_restored_handles(alloc_id)
+
+    def _kill_restored_handles(self, alloc_id: str) -> None:
+        handles = self._restored_handles.pop(alloc_id, None) or {}
+        if not handles:
+            return
+
+        def reap():
+            from .executor import reattach_executor
+
+            for handle_id in handles.values():
+                try:
+                    handle = reattach_executor(handle_id)
+                    if handle is not None:
+                        handle.kill()
+                except Exception:
+                    self.logger.exception("failed to reap restored handle")
+
+        # Off-thread: reattach probes can block seconds and this is
+        # called while _runners_lock is held.
+        threading.Thread(
+            target=reap, daemon=True, name=f"reap-{alloc_id[:8]}"
+        ).start()
 
     def _mark_dirty(self, alloc: Allocation) -> None:
         with self._dirty_lock:
@@ -251,6 +284,17 @@ class ClientAgent:
         # Keep a stable node identity across restarts (client.go:496).
         self.node.id = state.get("node_id") or self.node.id
         self.node.secret_id = state.get("secret_id") or self.node.secret_id
+        # Saved driver handle ids, keyed alloc id -> task name; consumed
+        # when the server re-sends each alloc so TaskRunners reattach to
+        # still-live executors instead of restarting tasks.
+        for entry in state.get("allocs") or []:
+            handles = {
+                tr.get("task", ""): tr.get("handle_id", "")
+                for tr in entry.get("task_runners", [])
+                if tr.get("handle_id")
+            }
+            if handles:
+                self._restored_handles[entry.get("alloc_id", "")] = handles
 
     # ------------------------------------------------------------------
 

@@ -25,6 +25,8 @@ class AllocRunner:
         sync_cb: Callable[[Allocation], None],
         max_kill_timeout: float = 30.0,
         logger: Optional[logging.Logger] = None,
+        restored_handles: Optional[Dict[str, str]] = None,
+        persist_cb: Optional[Callable[[], None]] = None,
     ):
         self.alloc = alloc
         self.sync_cb = sync_cb
@@ -35,6 +37,10 @@ class AllocRunner:
         self.alloc_dir = AllocDir(os.path.join(alloc_root, alloc.id))
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = {}
+        # task name -> persisted driver handle id (reattach after client
+        # restart, alloc_runner.go SaveState/RestoreState).
+        self.restored_handles = restored_handles or {}
+        self.persist_cb = persist_cb
         self._lock = threading.Lock()
         self._destroyed = False
 
@@ -55,6 +61,8 @@ class AllocRunner:
             runner = TaskRunner(
                 self.alloc, task, self.alloc_dir, self._on_task_state,
                 self.max_kill_timeout,
+                restore_handle_id=self.restored_handles.get(task.name, ""),
+                persist_cb=self.persist_cb,
             )
             self.task_runners[task.name] = runner
             runner.start()
@@ -135,5 +143,6 @@ class AllocRunner:
     def persist(self) -> dict:
         return {
             "alloc_id": self.alloc.id,
-            "task_runners": [r.persist() for r in self.task_runners.values()],
+            # list() first: run() may still be adding runners.
+            "task_runners": [r.persist() for r in list(self.task_runners.values())],
         }

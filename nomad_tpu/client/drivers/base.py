@@ -50,6 +50,10 @@ class DriverHandle:
     def kill(self, kill_timeout: float = 5.0) -> None:
         raise NotImplementedError
 
+    def signal(self, signum: int) -> None:
+        """Deliver a signal to the task (template change_mode=signal)."""
+        raise NotImplementedError
+
     def update(self, task: Task) -> None:
         pass
 

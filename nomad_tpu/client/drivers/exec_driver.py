@@ -1,18 +1,18 @@
-"""exec driver: command execution with best-effort isolation.
+"""exec driver: command execution with resource isolation.
 
-Reference: client/driver/exec.go:326 + exec_linux.go (cgroup + chroot
-via the out-of-process executor). Here: own session + rlimits applied
-in the child via preexec; full cgroup/chroot isolation requires root
-and lands with the native executor.
+Reference: client/driver/exec.go:326 + exec_linux.go — runs under the
+out-of-process executor, which applies cgroup limits when root
+(executor_linux.go:48) plus an address-space rlimit in the child, and
+optional chroot when explicitly configured.
 """
 
 from __future__ import annotations
 
-import resource
+import os
+from typing import Optional
 
 from ...structs import Node, Task
 from .base import Driver, DriverHandle, TaskContext, register_driver
-from .raw_exec import ProcessHandle, launch_command
 
 
 @register_driver
@@ -26,17 +26,20 @@ class ExecDriver(Driver):
         return True
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        from ..executor import launch_executor
+
         mem_bytes = None
         if task.resources is not None and task.resources.memory_mb:
             mem_bytes = task.resources.memory_mb * 1024 * 1024
+        # Chroot only on explicit opt-in while running as root; the
+        # reference builds a populated chroot per task (exec_linux.go),
+        # which needs root and an embedded toolchain.
+        chroot = None
+        if (task.config or {}).get("chroot") and os.geteuid() == 0:
+            chroot = ctx.task_dir
+        return launch_executor(ctx, task, rlimit_as=mem_bytes, chroot=chroot)
 
-        def preexec():
-            if mem_bytes is not None:
-                try:
-                    resource.setrlimit(resource.RLIMIT_AS, (mem_bytes, mem_bytes))
-                except (ValueError, OSError):
-                    pass
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        from ..executor import reattach_executor
 
-        return ProcessHandle(
-            launch_command(ctx, task, preexec=preexec), task.name
-        )
+        return reattach_executor(handle_id)

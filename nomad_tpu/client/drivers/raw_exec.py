@@ -1,7 +1,9 @@
-"""raw_exec driver: run a command as a child process, no isolation.
+"""raw_exec driver: run a command with no isolation.
 
 Reference: client/driver/raw_exec.go:312 — opt-in via client option
-driver.raw_exec.enable; stdout/stderr captured to the alloc log dir.
+driver.raw_exec.enable; the command runs under the out-of-process
+executor so it survives client restarts (executor_plugin.go), with
+stdout/stderr rotated into the alloc log dir.
 """
 
 from __future__ import annotations
@@ -17,6 +19,9 @@ from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
 
 
 class ProcessHandle(DriverHandle):
+    """In-process child handle — used by drivers that manage their own
+    external supervisor (e.g. docker) or in tests."""
+
     def __init__(self, proc: subprocess.Popen, task_name: str):
         self.proc = proc
         self.task_name = task_name
@@ -44,6 +49,12 @@ class ProcessHandle(DriverHandle):
             return None
         return self._result
 
+    def signal(self, signum: int) -> None:
+        try:
+            os.killpg(self.proc.pid, signum)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
     def kill(self, kill_timeout: float = 5.0) -> None:
         if self._done.is_set():
             return
@@ -61,6 +72,7 @@ class ProcessHandle(DriverHandle):
 
 
 def launch_command(ctx: TaskContext, task: Task, preexec=None) -> subprocess.Popen:
+    """Direct (non-executor) launch, kept for driver-internal use."""
     cfg = task.config or {}
     command = cfg.get("command")
     if not command:
@@ -94,4 +106,11 @@ class RawExecDriver(Driver):
         return True
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
-        return ProcessHandle(launch_command(ctx, task), task.name)
+        from ..executor import launch_executor
+
+        return launch_executor(ctx, task)
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        from ..executor import reattach_executor
+
+        return reattach_executor(handle_id)

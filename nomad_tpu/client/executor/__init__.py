@@ -1,0 +1,22 @@
+"""Out-of-process task executor.
+
+Reference: client/driver/executor/ + executor_plugin.go — tasks run
+under a separate `nomad executor` process spawned via go-plugin so the
+client can restart without killing tasks; the driver handle persists a
+reattach config (plugins.go:31 PluginReattachConfig).
+
+Here the executor is a self-contained stdlib-only script
+(executor_main.py) launched directly by path, serving newline-JSON RPC
+over a unix domain socket. The handle id is a JSON reattach blob
+(socket path + state file + pids); after a client restart the driver
+re-opens the socket, or — if the executor already exited — recovers the
+exit result from the executor's state file.
+"""
+
+from .client import (
+    ExecutorHandle,
+    launch_executor,
+    reattach_executor,
+)
+
+__all__ = ["ExecutorHandle", "launch_executor", "reattach_executor"]

@@ -1,0 +1,352 @@
+"""Client-side executor control: spawn, RPC handle, reattach.
+
+Reference: client/driver/executor_plugin.go (ExecutorRPC wrapper) and
+client/driver/plugins.go:31 (PluginReattachConfig persisted in the
+driver handle id so a restarted client can reattach).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ...structs import Task
+from ..drivers.base import DriverHandle, TaskContext, WaitResult
+
+EXECUTOR_MAIN = os.path.join(os.path.dirname(__file__), "executor_main.py")
+HANDLE_PREFIX = "executor:"
+
+
+class ExecutorClient:
+    """Newline-JSON RPC over the executor's unix socket. One socket
+    connection per concurrent call site; calls on a connection are
+    serialized."""
+
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+
+    def _connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(self.sock_path)
+        self._sock = s
+        self._file = s.makefile("rwb")
+
+    def call(self, method: str, *, _timeout: Optional[float] = None, **kw) -> dict:
+        """One RPC round-trip; _timeout bounds the socket wait."""
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            self._sock.settimeout(_timeout)
+            req = dict(kw)
+            req["method"] = method
+            try:
+                self._file.write(json.dumps(req).encode() + b"\n")
+                self._file.flush()
+                line = self._file.readline()
+            except (OSError, ValueError):
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError("executor closed connection")
+            return json.loads(line)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._file = None
+
+
+class ExecutorHandle(DriverHandle):
+    """DriverHandle backed by the out-of-process executor."""
+
+    def __init__(self, task_name: str, sock_path: str, state_path: str,
+                 executor_pid: int, child_pid: int):
+        self.task_name = task_name
+        self.sock_path = sock_path
+        self.state_path = state_path
+        self.executor_pid = executor_pid
+        self.child_pid = child_pid
+        self._client = ExecutorClient(sock_path)
+        self._result: Optional[WaitResult] = None
+
+    # -- identity ------------------------------------------------------
+
+    def id(self) -> str:
+        return HANDLE_PREFIX + json.dumps(
+            {
+                "task": self.task_name,
+                "sock": self.sock_path,
+                "state": self.state_path,
+                "executor_pid": self.executor_pid,
+                "child_pid": self.child_pid,
+            },
+            sort_keys=True,
+        )
+
+    def pid(self) -> Optional[int]:
+        return self.child_pid or None
+
+    # -- state-file fallback -------------------------------------------
+
+    def _result_from_state_file(self) -> Optional[WaitResult]:
+        try:
+            with open(self.state_path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return None
+        res = state.get("result")
+        if res is None:
+            return None
+        return WaitResult(
+            exit_code=res.get("exit_code", -1),
+            signal=res.get("signal", 0),
+            error=res.get("error", ""),
+        )
+
+    def _executor_alive(self) -> bool:
+        if not self.executor_pid:
+            return False
+        try:
+            os.kill(self.executor_pid, 0)
+            return True
+        except OSError:
+            return False
+
+    # -- DriverHandle --------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if self._result is not None:
+            return self._result
+        try:
+            resp = self._client.call(
+                "wait", timeout=timeout,
+                _timeout=(timeout + 5.0) if timeout is not None else None,
+            )
+            if resp.get("done"):
+                r = resp["result"]
+                self._result = WaitResult(
+                    exit_code=r.get("exit_code", -1),
+                    signal=r.get("signal", 0),
+                    error=r.get("error", ""),
+                )
+                return self._result
+            return None
+        except (OSError, ValueError, ConnectionError):
+            # Executor gone: recover from its state file, else report lost.
+            res = self._result_from_state_file()
+            if res is not None:
+                self._result = res
+                return res
+            if not self._executor_alive():
+                # The supervisor died without recording an exit. Its
+                # child (own session) may still be running: reap it
+                # before reporting the task dead, or a restart would run
+                # a second copy alongside the orphan.
+                if self.child_pid and self._pid_is_session_leader(self.child_pid):
+                    try:
+                        os.killpg(self.child_pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                self._result = WaitResult(exit_code=-1, error="executor exited unexpectedly")
+                return self._result
+            return None
+
+    @staticmethod
+    def _pid_is_session_leader(pid: int) -> bool:
+        """Guard against recycled pids: our executor and child are both
+        session leaders (setsid), so a pid whose pgrp differs was reused
+        by some unrelated process and must not be signalled."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            return int(parts[2]) == pid  # field 5: pgrp
+        except (OSError, IndexError, ValueError):
+            return False
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        try:
+            self._client.call("kill", timeout=kill_timeout,
+                              _timeout=kill_timeout + 10.0)
+            self._client.call("shutdown", _timeout=5.0)
+        except (OSError, ValueError, ConnectionError):
+            # RPC unavailable. If the task's exit is already on record
+            # there is nothing to kill — signalling the stored pids
+            # would hit whatever process recycled them.
+            if self._result is not None or self._result_from_state_file() is not None:
+                return
+            # SIGKILL for the executor too: it ignores SIGINT/SIGTERM by
+            # design (it must survive client shutdown signals).
+            for pid in (self.child_pid, self.executor_pid):
+                if pid and self._pid_is_session_leader(pid):
+                    try:
+                        os.killpg(pid, signal.SIGKILL)
+                    except OSError:
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                        except OSError:
+                            pass
+        finally:
+            self._client.close()
+
+    def signal(self, signum: int) -> None:
+        self._client.call("signal", signum=signum, _timeout=10.0)
+
+    def stats(self) -> dict:
+        try:
+            return self._client.call("stats", _timeout=5.0)
+        except (OSError, ValueError, ConnectionError):
+            return {}
+
+
+def _paths(ctx: TaskContext, task_name: str):
+    # Unique per launch attempt: a restarted task must never find the
+    # previous attempt's still-alive executor (or its recorded exit
+    # result) at the same path. Reattach uses the exact paths stored in
+    # the handle id, so uniqueness costs nothing.
+    import uuid
+
+    nonce = uuid.uuid4().hex[:8]
+    base = os.path.join(
+        ctx.task_dir, os.pardir, f".executor-{task_name}-{nonce}"
+    )
+    base = os.path.abspath(base)
+    # AF_UNIX socket paths are capped at ~108 bytes; alloc dirs easily
+    # exceed that, so the socket lives in the system tempdir (the
+    # handle id records it anyway).
+    import tempfile
+
+    sock = os.path.join(tempfile.gettempdir(), f"nomad-exec-{nonce}.sock")
+    return sock, base + ".state", base + ".spec"
+
+
+def launch_executor(ctx: TaskContext, task: Task, *, rlimit_as: Optional[int] = None,
+                    chroot: Optional[str] = None) -> ExecutorHandle:
+    """Spawn the executor process for a task and wait for it to come up."""
+    cfg = task.config or {}
+    command = cfg.get("command")
+    if not command:
+        raise ValueError(f"missing command for task {task.name!r}")
+    env = dict(os.environ)
+    env.update(ctx.env)
+    log_cfg = task.log_config
+    sock_path, state_path, spec_path = _paths(ctx, task.name)
+    spec = {
+        "task_name": task.name,
+        "command": command,
+        "args": [str(a) for a in cfg.get("args", [])],
+        "env": env,
+        "cwd": ctx.task_dir,
+        "log_dir": ctx.log_dir,
+        "max_files": log_cfg.max_files if log_cfg else 10,
+        "max_file_size_mb": log_cfg.max_file_size_mb if log_cfg else 10,
+        "sock_path": sock_path,
+        "state_path": state_path,
+        "rlimit_as": rlimit_as,
+        "chroot": chroot,
+        "memory_mb": task.resources.memory_mb if task.resources else 0,
+        "cpu_shares": task.resources.cpu if task.resources else 0,
+    }
+    # 0600 and deleted by the executor once loaded: the spec carries the
+    # task environment (which may hold credentials).
+    fd = os.open(spec_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec, f)
+
+    proc = subprocess.Popen(
+        [sys.executable, EXECUTOR_MAIN, spec_path],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+        close_fds=True,
+    )
+    # The executor daemonizes itself (setsid); wait for its socket.
+    deadline = time.monotonic() + 15.0
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        if os.path.exists(sock_path):
+            client = ExecutorClient(sock_path)
+            try:
+                resp = client.call("ping", _timeout=5.0)
+                child_pid = resp.get("child_pid", 0)
+                handle = ExecutorHandle(task.name, sock_path, state_path,
+                                        proc.pid, child_pid)
+                # Launch may have failed inside the executor: surface it.
+                res = handle._result_from_state_file()
+                if res is not None and res.error:
+                    raise RuntimeError(f"executor launch failed: {res.error}")
+                return handle
+            except (OSError, ValueError, ConnectionError) as e:
+                last_err = e
+                client.close()
+        if proc.poll() is not None:
+            # Executor died before serving; check state file for reason.
+            try:
+                with open(state_path) as f:
+                    res = json.load(f).get("result") or {}
+                raise RuntimeError(
+                    f"executor failed: {res.get('error') or 'exited'}"
+                )
+            except (OSError, ValueError):
+                raise RuntimeError("executor exited before serving") from last_err
+        time.sleep(0.05)
+    # Reap the slow starter: without this a retry would race a second
+    # copy of the task against the one this executor eventually starts.
+    # The executor and its child each run setsid, so kill both groups.
+    pids = [proc.pid]
+    try:
+        with open(state_path) as f:
+            pids.append(json.load(f).get("child_pid", 0))
+    except (OSError, ValueError):
+        pass
+    for pid in pids:
+        if pid:
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except OSError:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+    raise TimeoutError(f"executor for {task.name!r} did not start") from last_err
+
+
+def reattach_executor(handle_id: str) -> Optional[ExecutorHandle]:
+    """Rebuild a handle from a persisted id after client restart.
+
+    Returns None when the task is unrecoverable (no executor and no
+    state file) — reference task_runner.go:189 marks such tasks lost.
+    """
+    if not handle_id.startswith(HANDLE_PREFIX):
+        return None
+    try:
+        blob = json.loads(handle_id[len(HANDLE_PREFIX):])
+    except ValueError:
+        return None
+    handle = ExecutorHandle(
+        blob.get("task", ""), blob.get("sock", ""), blob.get("state", ""),
+        blob.get("executor_pid", 0), blob.get("child_pid", 0),
+    )
+    try:
+        handle._client.call("ping", _timeout=5.0)
+        return handle
+    except (OSError, ValueError, ConnectionError):
+        pass
+    # Executor gone: a recorded exit result still makes a usable handle.
+    if handle._result_from_state_file() is not None:
+        return handle
+    return None

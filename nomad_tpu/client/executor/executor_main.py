@@ -1,0 +1,403 @@
+"""Standalone task executor process.
+
+Run directly (never imported by the client):
+
+    python executor_main.py <spec.json>
+
+Responsibilities (reference client/driver/executor/executor.go):
+  - launch the task command in its own session (process group)
+  - capture stdout/stderr through size-based rotating log files
+    (reference client/driver/logging/rotator.go)
+  - apply resource limits in the child (reference executor_linux.go
+    applies cgroup limits; here rlimits, cgroups when root)
+  - serve a control RPC (wait/stats/signal/kill/shutdown) over a unix
+    socket so the client agent can detach/reattach
+    (reference executor_plugin.go)
+  - persist a state file with the exit result so a reattaching client
+    can recover the outcome even after this process exits
+
+This file is intentionally stdlib-only and self-contained: it is
+executed by path with a bare interpreter, so it must not import
+nomad_tpu (and transitively jax).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+IDLE_EXIT_SECONDS = 300.0
+
+
+class FileRotator:
+    """Size-rotated log writer: <base>.0, <base>.1, ... keeping at most
+    max_files, rotating at max_bytes (reference logging/rotator.go)."""
+
+    def __init__(self, log_dir: str, base: str, max_files: int, max_bytes: int):
+        self.log_dir = log_dir
+        self.base = base
+        self.max_files = max(1, max_files)
+        self.max_bytes = max(1, max_bytes)
+        self._lock = threading.Lock()
+        self._idx = self._latest_index()
+        self._fh = open(self._path(self._idx), "ab")
+        self._written = self._fh.tell()
+
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.log_dir, f"{self.base}.{idx}")
+
+    def _latest_index(self) -> int:
+        latest = 0
+        prefix = self.base + "."
+        try:
+            names = os.listdir(self.log_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(prefix):
+                suffix = name[len(prefix):]
+                if suffix.isdigit():
+                    latest = max(latest, int(suffix))
+        return latest
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            while data:
+                room = self.max_bytes - self._written
+                if room <= 0:
+                    self._rotate_locked()
+                    room = self.max_bytes
+                chunk, data = data[:room], data[room:]
+                self._fh.write(chunk)
+                self._fh.flush()
+                self._written += len(chunk)
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        self._idx += 1
+        self._fh = open(self._path(self._idx), "ab")
+        self._written = 0
+        # prune oldest beyond max_files
+        oldest_keep = self._idx - self.max_files + 1
+        prefix = self.base + "."
+        for name in os.listdir(self.log_dir):
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                if int(name[len(prefix):]) < oldest_keep:
+                    try:
+                        os.unlink(os.path.join(self.log_dir, name))
+                    except OSError:
+                        pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+class Executor:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.state_path = spec["state_path"]
+        self.sock_path = spec["sock_path"]
+        self.done = threading.Event()
+        self.result: dict = {}
+        self.proc = None
+        self.last_activity = time.monotonic()
+        self._kill_lock = threading.Lock()
+        self._rotators = []
+
+    # -- state file ----------------------------------------------------
+
+    def write_state(self, extra: dict | None = None) -> None:
+        state = {
+            "executor_pid": os.getpid(),
+            "sock_path": self.sock_path,
+            "task": self.spec.get("task_name", ""),
+            "child_pid": self.proc.pid if self.proc else 0,
+            "started_at": self.spec.get("_started_at", 0.0),
+        }
+        if extra:
+            state.update(extra)
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.state_path)
+
+    # -- child lifecycle ----------------------------------------------
+
+    def launch(self) -> None:
+        import subprocess
+
+        spec = self.spec
+        argv = [spec["command"]] + [str(a) for a in spec.get("args", [])]
+        env = dict(spec.get("env") or {})
+        max_files = int(spec.get("max_files", 10))
+        max_bytes = int(spec.get("max_file_size_mb", 10)) * 1024 * 1024
+        task = spec.get("task_name", "task")
+        out_rot = FileRotator(spec["log_dir"], f"{task}.stdout", max_files, max_bytes)
+        err_rot = FileRotator(spec["log_dir"], f"{task}.stderr", max_files, max_bytes)
+        self._rotators = [out_rot, err_rot]
+
+        rlimit_as = spec.get("rlimit_as")
+        chroot = spec.get("chroot") or None
+
+        def preexec():
+            if rlimit_as:
+                import resource
+
+                try:
+                    resource.setrlimit(resource.RLIMIT_AS, (rlimit_as, rlimit_as))
+                except (ValueError, OSError):
+                    pass
+            if chroot:
+                try:
+                    os.chroot(chroot)
+                    os.chdir("/")
+                except OSError:
+                    pass
+
+        self.spec["_started_at"] = time.time()
+        self.proc = subprocess.Popen(
+            argv,
+            cwd=spec.get("cwd") or None,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            start_new_session=True,
+            preexec_fn=preexec,
+        )
+        self._maybe_cgroup(self.proc.pid)
+        threading.Thread(
+            target=self._pump, args=(self.proc.stdout, out_rot), daemon=True
+        ).start()
+        threading.Thread(
+            target=self._pump, args=(self.proc.stderr, err_rot), daemon=True
+        ).start()
+        threading.Thread(target=self._reap, daemon=True).start()
+        self.write_state()
+
+    def _maybe_cgroup(self, pid: int) -> None:
+        """Best-effort cgroup-v2 memory/cpu limits when running as root
+        (reference executor_linux.go:48 uses libcontainer cgroups)."""
+        spec = self.spec
+        if os.geteuid() != 0 or not os.path.isdir("/sys/fs/cgroup"):
+            return
+        mem_mb = spec.get("memory_mb") or 0
+        cpu_shares = spec.get("cpu_shares") or 0
+        if not mem_mb and not cpu_shares:
+            return
+        cg = f"/sys/fs/cgroup/nomad-tpu-{os.getpid()}"
+        try:
+            os.makedirs(cg, exist_ok=True)
+            if mem_mb:
+                with open(os.path.join(cg, "memory.max"), "w") as f:
+                    f.write(str(int(mem_mb) * 1024 * 1024))
+            if cpu_shares:
+                with open(os.path.join(cg, "cpu.weight"), "w") as f:
+                    # map MHz shares into cgroup2 weight range [1,10000]
+                    f.write(str(max(1, min(10000, int(cpu_shares)))))
+            with open(os.path.join(cg, "cgroup.procs"), "w") as f:
+                f.write(str(pid))
+            self.spec["_cgroup"] = cg
+        except OSError:
+            pass
+
+    def _pump(self, pipe, rotator: FileRotator) -> None:
+        try:
+            # read1: return as soon as bytes are available — plain
+            # read(n) would buffer a partially-filled chunk until EOF,
+            # hiding live output from the log-tailing APIs.
+            for chunk in iter(lambda: pipe.read1(65536), b""):
+                rotator.write(chunk)
+        except (OSError, ValueError):
+            pass
+
+    def _reap(self) -> None:
+        code = self.proc.wait()
+        if code < 0:
+            self.result = {"exit_code": 0, "signal": -code, "error": ""}
+        else:
+            self.result = {"exit_code": code, "signal": 0, "error": ""}
+        time.sleep(0.05)  # let pumps drain
+        for r in self._rotators:
+            r.close()
+        cg = self.spec.get("_cgroup")
+        if cg:
+            try:
+                os.rmdir(cg)
+            except OSError:
+                pass
+        self.write_state({"result": self.result, "exited_at": time.time()})
+        self.done.set()
+
+    # -- RPC methods ---------------------------------------------------
+
+    def rpc_ping(self, req: dict) -> dict:
+        return {"ok": True, "child_pid": self.proc.pid}
+
+    def rpc_wait(self, req: dict) -> dict:
+        timeout = req.get("timeout")
+        if self.done.wait(timeout):
+            return {"done": True, "result": self.result}
+        return {"done": False}
+
+    def rpc_stats(self, req: dict) -> dict:
+        """RSS + cpu ticks summed over the child's process group
+        (reference executor.go pid-scan resource usage)."""
+        rss = 0
+        ticks = 0
+        pids = []
+        if self.proc and not self.done.is_set():
+            pgid = self.proc.pid
+            try:
+                for entry in os.listdir("/proc"):
+                    if not entry.isdigit():
+                        continue
+                    try:
+                        with open(f"/proc/{entry}/stat") as f:
+                            parts = f.read().rsplit(")", 1)[1].split()
+                        if int(parts[2]) != pgid:  # field 5: pgrp
+                            continue
+                        pids.append(int(entry))
+                        ticks += int(parts[11]) + int(parts[12])  # utime+stime
+                        rss += int(parts[21]) * os.sysconf("SC_PAGE_SIZE")
+                    except (OSError, IndexError, ValueError):
+                        continue
+            except OSError:
+                pass
+        return {"rss_bytes": rss, "cpu_ticks": ticks, "pids": pids}
+
+    def rpc_signal(self, req: dict) -> dict:
+        signum = int(req.get("signum", signal.SIGTERM))
+        try:
+            os.killpg(self.proc.pid, signum)
+            return {"ok": True}
+        except OSError as e:
+            return {"ok": False, "error": str(e)}
+
+    def rpc_kill(self, req: dict) -> dict:
+        timeout = float(req.get("timeout", 5.0))
+        with self._kill_lock:
+            if not self.done.is_set():
+                try:
+                    os.killpg(self.proc.pid, signal.SIGINT)
+                except OSError:
+                    pass
+                if not self.done.wait(timeout):
+                    try:
+                        os.killpg(self.proc.pid, signal.SIGKILL)
+                    except OSError:
+                        try:
+                            self.proc.kill()
+                        except OSError:
+                            pass
+                    self.done.wait(5.0)
+        return {"done": self.done.is_set(), "result": self.result}
+
+    def rpc_shutdown(self, req: dict) -> dict:
+        if not self.done.is_set():
+            self.rpc_kill({"timeout": req.get("timeout", 5.0)})
+
+        def _exit():
+            time.sleep(0.1)
+            os._exit(0)
+
+        threading.Thread(target=_exit, daemon=True).start()
+        return {"ok": True}
+
+    def dispatch(self, req: dict) -> dict:
+        self.last_activity = time.monotonic()
+        method = req.get("method", "")
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            return {"error": f"unknown method {method!r}"}
+        try:
+            return fn(req)
+        except Exception as e:  # noqa: BLE001 - report RPC errors to caller
+            return {"error": str(e)}
+
+
+def serve(ex: Executor) -> None:
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    return
+                resp = ex.dispatch(req)
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                except (BrokenPipeError, OSError):
+                    return
+
+    class Server(socketserver.ThreadingUnixStreamServer):
+        daemon_threads = True
+
+    if os.path.exists(ex.sock_path):
+        os.unlink(ex.sock_path)
+    srv = Server(ex.sock_path, Handler)
+
+    def idle_watch():
+        while True:
+            time.sleep(10.0)
+            if ex.done.is_set() and (
+                time.monotonic() - ex.last_activity > IDLE_EXIT_SECONDS
+            ):
+                os._exit(0)
+
+    threading.Thread(target=idle_watch, daemon=True).start()
+    srv.serve_forever(poll_interval=0.5)
+
+
+def main() -> int:
+    spec_path = sys.argv[1]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    # The spec holds the task environment (possibly credentials); it has
+    # served its purpose once loaded.
+    try:
+        os.unlink(spec_path)
+    except OSError:
+        pass
+    # Detach from the client's session so a client restart/kill never
+    # propagates to the task (reference: go-plugin subprocess survives
+    # because drivers reattach by pid).
+    try:
+        os.setsid()
+    except OSError:
+        pass
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    ex = Executor(spec)
+    try:
+        ex.launch()
+    except Exception as e:  # noqa: BLE001 - startup failure goes to state file
+        ex.result = {"exit_code": -1, "signal": 0, "error": str(e)}
+        ex.done.set()
+        try:
+            ex.write_state({"result": ex.result, "exited_at": time.time()})
+        except OSError:
+            pass
+        # Still serve the socket briefly so the launching driver reads
+        # the failure instead of a connection error.
+        threading.Thread(target=serve, args=(ex,), daemon=True).start()
+        time.sleep(2.0)
+        return 1
+    serve(ex)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,8 @@ class TaskRunner:
         update_cb: Callable[[str, TaskState], None],
         max_kill_timeout: float = 30.0,
         logger: Optional[logging.Logger] = None,
+        restore_handle_id: str = "",
+        persist_cb: Optional[Callable[[], None]] = None,
     ):
         self.alloc = alloc
         self.task = task
@@ -54,6 +56,13 @@ class TaskRunner:
         self.state = TaskState()
         self.handle = None
         self.handle_id = ""
+        # Persisted handle id from a previous client run; run() tries to
+        # reattach before starting fresh (task_runner.go:189).
+        self.restore_handle_id = restore_handle_id
+        # Called whenever handle_id changes so the client snapshots it
+        # immediately — a crash between task start and the periodic save
+        # would otherwise orphan the executor and duplicate the task.
+        self.persist_cb = persist_cb
         self._kill = threading.Event()
         self._destroy_event: Optional[TaskEvent] = None
         self._thread: Optional[threading.Thread] = None
@@ -128,14 +137,29 @@ class TaskRunner:
             self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
             return
 
+        reattached = self._try_reattach(driver, ctx)
+        if self._kill.is_set():
+            # kill() raced _try_reattach while handle was still None: the
+            # while loop below won't run, so reap any adopted task here
+            # and always report a terminal state.
+            self._finish_killed()
+            return
+
         while not self._kill.is_set():
-            # start
+            # start (unless we reattached to a still-live task)
             try:
-                handle = driver.start(ctx, self.task)
-                with self._lock:
-                    self.handle = handle
-                    self.handle_id = handle.id()
-                    killed_during_start = self._kill.is_set()
+                if reattached:
+                    handle = self.handle
+                    reattached = False
+                    with self._lock:
+                        killed_during_start = self._kill.is_set()
+                else:
+                    handle = driver.start(ctx, self.task)
+                    with self._lock:
+                        self.handle = handle
+                        self.handle_id = handle.id()
+                        killed_during_start = self._kill.is_set()
+                    self._persist_handle()
                 if killed_during_start:
                     # kill() raced driver.start and found handle None;
                     # re-issue so the process isn't orphaned.
@@ -189,6 +213,59 @@ class TaskRunner:
                 self._emit(consts.TASK_STATE_DEAD,
                            new_task_event(consts.TASK_EVENT_KILLED), failed=False)
                 return
+
+        # _kill landed between the pre-loop check and the loop condition
+        # (every in-loop exit returns above): still report terminal.
+        self._finish_killed()
+
+    def _finish_killed(self) -> None:
+        """Reap the handle (if any) and emit the terminal killed state —
+        every run() exit path must leave the task DEAD or the alloc
+        never reaches a terminal client status."""
+        if self.handle is not None:
+            try:
+                self.handle.kill(min(self.task.kill_timeout, self.max_kill_timeout))
+            except Exception:
+                self.logger.exception("kill during shutdown failed")
+        with self._lock:
+            destroy_ev = self._destroy_event
+        self._emit(
+            consts.TASK_STATE_DEAD,
+            destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
+            failed=False,
+        )
+
+    def _try_reattach(self, driver, ctx) -> bool:
+        """Reopen a persisted driver handle after client restart
+        (task_runner.go:189 RestoreState). Returns True when the task is
+        still live under its executor; False falls through to a fresh
+        start."""
+        if not self.restore_handle_id:
+            return False
+        handle_id, self.restore_handle_id = self.restore_handle_id, ""
+        try:
+            handle = driver.open(ctx, handle_id)
+        except Exception:  # noqa: BLE001 - treat as unrecoverable handle
+            self.logger.exception("reattach failed")
+            handle = None
+        if handle is None:
+            ev = new_task_event(consts.TASK_EVENT_DRIVER_FAILURE)
+            ev.driver_error = "failed to reattach to task; restarting"
+            self._emit(consts.TASK_STATE_PENDING, ev)
+            return False
+        with self._lock:
+            self.handle = handle
+            self.handle_id = handle.id()
+        self._persist_handle()
+        # run() emits RUNNING when it picks the handle up.
+        return True
+
+    def _persist_handle(self) -> None:
+        if self.persist_cb is not None:
+            try:
+                self.persist_cb()
+            except Exception:
+                self.logger.exception("handle persist failed")
 
     # ------------------------------------------------------------------
 

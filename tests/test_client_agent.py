@@ -200,3 +200,67 @@ def test_client_state_persists_node_identity(tmp_path):
     finally:
         http.stop()
         server.shutdown()
+
+
+def test_client_restart_reattaches_tasks(tmp_path):
+    """A restarted client reattaches to live executors instead of
+    restarting tasks (task_runner.go:189, plugins.go:31)."""
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    cfg = ClientConfig(
+        servers=[http.addr],
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        options={"driver.raw_exec.enable": "1"},
+        dev_mode=True,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    agent.start()
+    try:
+        job = mock_driver_job()
+        task = job.task_groups[0].tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 600"]}
+        server.job_register(job)
+        assert wait_until(
+            lambda: any(
+                a.client_status == consts.ALLOC_CLIENT_RUNNING
+                for a in server.fsm.state.allocs_by_job(job.id)
+            )
+        )
+        runner = next(iter(agent.alloc_runners.values()))
+        tr = runner.task_runners["web"]
+        assert wait_until(lambda: tr.handle is not None)
+        pid_before = tr.handle.pid()
+        assert pid_before
+
+        # Stop the client without destroying allocs; the executor (own
+        # session) keeps the task alive.
+        agent.shutdown(destroy_allocs=False)
+        os.kill(pid_before, 0)  # still running
+
+        agent2 = ClientAgent(cfg)
+        agent2.start()
+        try:
+            assert agent2.node.id == agent.node.id
+            assert wait_until(
+                lambda: any(
+                    r.task_runners.get("web") is not None
+                    and r.task_runners["web"].handle is not None
+                    for r in agent2.alloc_runners.values()
+                ),
+                timeout=15.0,
+            )
+            runner2 = next(iter(agent2.alloc_runners.values()))
+            tr2 = runner2.task_runners["web"]
+            assert wait_until(lambda: tr2.handle is not None and tr2.handle.pid() == pid_before)
+            # Same pid: the task was adopted, not restarted.
+            assert tr2.handle.pid() == pid_before
+        finally:
+            agent2.shutdown(destroy_allocs=True)
+    finally:
+        http.stop()
+        server.shutdown()
