@@ -183,6 +183,7 @@ class ClientAgent:
                     self.config.max_kill_timeout,
                     restored_handles=self._restored_handles.pop(alloc.id, None),
                     persist_cb=self._save_state,
+                    template_kv=self._template_kv,
                 )
                 self.alloc_runners[alloc.id] = runner
                 runner.run()
@@ -215,6 +216,12 @@ class ClientAgent:
         threading.Thread(
             target=reap, daemon=True, name=f"reap-{alloc_id[:8]}"
         ).start()
+
+    def _template_kv(self, path: str):
+        """KV source for {{ key "..." }} templates: client options under
+        the template.kv. prefix (the service registry supplies richer
+        data once configured)."""
+        return (self.config.options or {}).get(f"template.kv.{path}")
 
     def _mark_dirty(self, alloc: Allocation) -> None:
         with self._dirty_lock:
@@ -262,10 +269,23 @@ class ClientAgent:
     def _save_state(self) -> None:
         with self._runners_lock:
             runners = list(self.alloc_runners.values())
+        alloc_entries = [r.persist() for r in runners]
+        # Restored handles not yet claimed by a runner must survive
+        # rewrites of the state file, or a second restart before the
+        # first alloc pull would orphan their executors.
+        persisted_ids = {e["alloc_id"] for e in alloc_entries}
+        for alloc_id, handles in self._restored_handles.items():
+            if alloc_id not in persisted_ids:
+                alloc_entries.append({
+                    "alloc_id": alloc_id,
+                    "task_runners": [
+                        {"task": t, "handle_id": h} for t, h in handles.items()
+                    ],
+                })
         state = {
             "node_id": self.node.id,
             "secret_id": self.node.secret_id,
-            "allocs": [r.persist() for r in runners],
+            "allocs": alloc_entries,
         }
         tmp = self._state_path() + ".tmp"
         try:

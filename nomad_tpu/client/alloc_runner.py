@@ -27,6 +27,7 @@ class AllocRunner:
         logger: Optional[logging.Logger] = None,
         restored_handles: Optional[Dict[str, str]] = None,
         persist_cb: Optional[Callable[[], None]] = None,
+        template_kv=None,
     ):
         self.alloc = alloc
         self.sync_cb = sync_cb
@@ -41,6 +42,7 @@ class AllocRunner:
         # restart, alloc_runner.go SaveState/RestoreState).
         self.restored_handles = restored_handles or {}
         self.persist_cb = persist_cb
+        self.template_kv = template_kv
         self._lock = threading.Lock()
         self._destroyed = False
 
@@ -63,6 +65,7 @@ class AllocRunner:
                 self.max_kill_timeout,
                 restore_handle_id=self.restored_handles.get(task.name, ""),
                 persist_cb=self.persist_cb,
+                template_kv=self.template_kv,
             )
             self.task_runners[task.name] = runner
             runner.start()

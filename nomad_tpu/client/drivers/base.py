@@ -14,8 +14,11 @@ from ...structs import Node, Task
 @dataclass
 class TaskContext:
     alloc_id: str = ""
-    alloc_dir: str = ""  # alloc root
-    task_dir: str = ""  # this task's dir
+    alloc_dir: str = ""  # alloc shared dir
+    task_dir: str = ""  # this task's local/ dir (NOMAD_TASK_DIR)
+    task_root: str = ""  # this task's root dir (contains local/, secrets/);
+    # the task working dir, and what artifact/template relative paths
+    # resolve against (reference: alloc_dir.go task dir layout)
     log_dir: str = ""
     env: Dict[str, str] = field(default_factory=dict)
     max_kill_timeout: float = 30.0

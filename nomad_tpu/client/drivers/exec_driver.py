@@ -36,7 +36,7 @@ class ExecDriver(Driver):
         # which needs root and an embedded toolchain.
         chroot = None
         if (task.config or {}).get("chroot") and os.geteuid() == 0:
-            chroot = ctx.task_dir
+            chroot = ctx.task_root or ctx.task_dir
         return launch_executor(ctx, task, rlimit_as=mem_bytes, chroot=chroot)
 
     def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:

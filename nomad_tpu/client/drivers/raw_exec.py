@@ -84,7 +84,7 @@ def launch_command(ctx: TaskContext, task: Task, preexec=None) -> subprocess.Pop
     stderr = open(os.path.join(ctx.log_dir, f"{task.name}.stderr.0"), "ab")
     return subprocess.Popen(
         args,
-        cwd=ctx.task_dir,
+        cwd=ctx.task_root or ctx.task_dir,
         env=env,
         stdout=stdout,
         stderr=stderr,

@@ -202,6 +202,13 @@ class ExecutorHandle(DriverHandle):
                             pass
         finally:
             self._client.close()
+            # If the executor is gone its socket file lingers (it only
+            # unlinks on clean exit): sweep it.
+            if not self._executor_alive():
+                try:
+                    os.unlink(self.sock_path)
+                except OSError:
+                    pass
 
     def signal(self, signum: int) -> None:
         self._client.call("signal", signum=signum, _timeout=10.0)
@@ -250,7 +257,7 @@ def launch_executor(ctx: TaskContext, task: Task, *, rlimit_as: Optional[int] = 
         "command": command,
         "args": [str(a) for a in cfg.get("args", [])],
         "env": env,
-        "cwd": ctx.task_dir,
+        "cwd": ctx.task_root or ctx.task_dir,
         "log_dir": ctx.log_dir,
         "max_files": log_cfg.max_files if log_cfg else 10,
         "max_file_size_mb": log_cfg.max_file_size_mb if log_cfg else 10,

@@ -302,13 +302,20 @@ class Executor:
                     self.done.wait(5.0)
         return {"done": self.done.is_set(), "result": self.result}
 
+    def _exit_now(self) -> None:
+        try:
+            os.unlink(self.sock_path)  # sockets in tempdir must not leak
+        except OSError:
+            pass
+        os._exit(0)
+
     def rpc_shutdown(self, req: dict) -> dict:
         if not self.done.is_set():
             self.rpc_kill({"timeout": req.get("timeout", 5.0)})
 
         def _exit():
             time.sleep(0.1)
-            os._exit(0)
+            self._exit_now()
 
         threading.Thread(target=_exit, daemon=True).start()
         return {"ok": True}
@@ -355,7 +362,7 @@ def serve(ex: Executor) -> None:
             if ex.done.is_set() and (
                 time.monotonic() - ex.last_activity > IDLE_EXIT_SECONDS
             ):
-                os._exit(0)
+                ex._exit_now()
 
     threading.Thread(target=idle_watch, daemon=True).start()
     srv.serve_forever(poll_interval=0.5)

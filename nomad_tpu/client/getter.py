@@ -1,0 +1,146 @@
+"""Artifact fetcher: download TaskArtifact sources into the task dir.
+
+Reference: client/getter/getter.go (go-getter based) — supports URL
+sources with checksum verification and automatic archive unpacking,
+invoked from the task prestart phase (task_runner.go:354).
+
+Supported schemes: http://, https://, file://, and bare local paths.
+Getter options (TaskArtifact.GetterOptions):
+  checksum = "<algo>:<hex>"   md5 | sha1 | sha256 | sha512
+  archive  = "false"          disable auto-unpacking
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+
+from ..structs import TaskArtifact
+
+
+class ArtifactError(Exception):
+    pass
+
+
+def _contained(path: str, base: str) -> bool:
+    """True when abspath(path) is base or inside it. A bare
+    startswith() would let sibling dirs sharing the prefix through
+    (e.g. <alloc>/web2 vs base <alloc>/web)."""
+    path = os.path.abspath(path)
+    base = os.path.abspath(base)
+    return path == base or path.startswith(base + os.sep)
+
+
+def _verify_checksum(path: str, spec: str) -> None:
+    try:
+        algo, _, want = spec.partition(":")
+        h = hashlib.new(algo.strip())
+    except (ValueError, TypeError) as e:
+        raise ArtifactError(f"invalid checksum spec {spec!r}: {e}") from e
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    got = h.hexdigest()
+    if got != want.strip().lower():
+        raise ArtifactError(
+            f"checksum mismatch for {os.path.basename(path)}: "
+            f"got {algo}:{got}, want {spec}"
+        )
+
+
+def _unpack(path: str, dest_dir: str) -> bool:
+    """Auto-unpack archives the way go-getter does (by extension).
+    Returns True when the file was an archive and was extracted."""
+    lower = path.lower()
+    if lower.endswith((".tar.gz", ".tgz", ".tar.bz2", ".tbz2", ".tar.xz", ".txz", ".tar")):
+        with tarfile.open(path) as tf:
+            _safe_extract_tar(tf, dest_dir)
+        return True
+    if lower.endswith(".zip"):
+        with zipfile.ZipFile(path) as zf:
+            for info in zf.infolist():
+                target = os.path.join(dest_dir, info.filename)
+                if not _contained(target, dest_dir):
+                    raise ArtifactError(f"zip entry escapes dest: {info.filename}")
+            zf.extractall(dest_dir)
+        return True
+    return False
+
+
+def _safe_extract_tar(tf: tarfile.TarFile, dest_dir: str) -> None:
+    for member in tf.getmembers():
+        target = os.path.join(dest_dir, member.name)
+        if not _contained(target, dest_dir):
+            raise ArtifactError(f"tar entry escapes dest: {member.name}")
+        if member.issym() or member.islnk():
+            link_target = os.path.join(
+                os.path.dirname(target), member.linkname
+            )
+            if not _contained(link_target, dest_dir):
+                raise ArtifactError(f"tar link escapes dest: {member.name}")
+    try:
+        tf.extractall(dest_dir, filter="data")
+    except TypeError:  # pre-3.12 tarfile without filter=
+        tf.extractall(dest_dir)
+
+
+def fetch_artifact(artifact: TaskArtifact, task_dir: str,
+                   timeout: float = 300.0) -> str:
+    """Download one artifact into task_dir/<relative_dest>. Returns the
+    destination directory."""
+    source = artifact.getter_source
+    if not source:
+        raise ArtifactError("artifact has no source")
+    opts = artifact.getter_options or {}
+
+    dest_dir = os.path.join(task_dir, artifact.relative_dest or "")
+    dest_dir = os.path.abspath(dest_dir)
+    if not _contained(dest_dir, task_dir):
+        raise ArtifactError(f"artifact dest escapes task dir: {artifact.relative_dest}")
+    os.makedirs(dest_dir, exist_ok=True)
+
+    parsed = urllib.parse.urlparse(source)
+    filename = os.path.basename(parsed.path or source) or "artifact"
+    staging = os.path.join(dest_dir, f".download-{filename}")
+
+    try:
+        if parsed.scheme in ("http", "https"):
+            req = urllib.request.Request(
+                source, headers={"User-Agent": "nomad-tpu-getter"}
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp, \
+                    open(staging, "wb") as out:
+                shutil.copyfileobj(resp, out)
+        elif parsed.scheme == "file" or not parsed.scheme:
+            src_path = parsed.path if parsed.scheme else source
+            shutil.copyfile(src_path, staging)
+        else:
+            raise ArtifactError(f"unsupported artifact scheme {parsed.scheme!r}")
+
+        checksum = opts.get("checksum")
+        if checksum:
+            _verify_checksum(staging, checksum)
+
+        final = os.path.join(dest_dir, filename)
+        if opts.get("archive") == "false" or not _unpack(staging, dest_dir):
+            os.replace(staging, final)
+            # Downloaded programs are usually meant to run.
+            os.chmod(final, os.stat(final).st_mode | 0o755)
+        else:
+            os.unlink(staging)
+    except ArtifactError:
+        raise
+    except Exception as e:  # noqa: BLE001 - network/fs errors -> typed error
+        raise ArtifactError(f"failed to fetch {source!r}: {e}") from e
+    finally:
+        if os.path.exists(staging):
+            try:
+                os.unlink(staging)
+            except OSError:
+                pass
+    return dest_dir

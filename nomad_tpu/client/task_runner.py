@@ -40,6 +40,7 @@ class TaskRunner:
         logger: Optional[logging.Logger] = None,
         restore_handle_id: str = "",
         persist_cb: Optional[Callable[[], None]] = None,
+        template_kv: Optional[Callable[[str], Optional[str]]] = None,
     ):
         self.alloc = alloc
         self.task = task
@@ -56,6 +57,8 @@ class TaskRunner:
         self.state = TaskState()
         self.handle = None
         self.handle_id = ""
+        self._template_manager = None
+        self._restart_requested = threading.Event()
         # Persisted handle id from a previous client run; run() tries to
         # reattach before starting fresh (task_runner.go:189).
         self.restore_handle_id = restore_handle_id
@@ -63,6 +66,8 @@ class TaskRunner:
         # immediately — a crash between task start and the periodic save
         # would otherwise orphan the executor and duplicate the task.
         self.persist_cb = persist_cb
+        # KV lookup for {{ key "..." }} templates (service registry).
+        self.template_kv = template_kv
         self._kill = threading.Event()
         self._destroy_event: Optional[TaskEvent] = None
         self._thread: Optional[threading.Thread] = None
@@ -120,6 +125,7 @@ class TaskRunner:
             alloc_id=self.alloc.id,
             alloc_dir=self.alloc_dir.shared_dir,
             task_dir=os.path.join(task_dir, TASK_LOCAL),
+            task_root=task_dir,
             log_dir=self.alloc_dir.log_dir(),
             env=build_task_env(
                 self.alloc, self.task, self.alloc_dir.shared_dir,
@@ -146,6 +152,24 @@ class TaskRunner:
             return
 
         while not self._kill.is_set():
+            # prestart: artifacts + initial template render
+            # (task_runner.go:354; re-run on every restart like the
+            # reference, so transient download failures retry under the
+            # restart policy)
+            if reattached:
+                # Prestart already ran in the previous client process,
+                # but the template watcher lives in ours: restart it so
+                # change_mode keeps working across client restarts.
+                self._start_templates(ctx, fail_fast=False)
+            else:
+                prestart_err = self._prestart(ctx)
+                if prestart_err is not None:
+                    result = WaitResult(exit_code=-1, error=prestart_err)
+                    if self._handle_terminated(result):
+                        self._stop_template_manager()
+                        return
+                    continue
+
             # start (unless we reattached to a still-live task)
             try:
                 if reattached:
@@ -180,43 +204,143 @@ class TaskRunner:
                         exit_code=-1, signal=9
                     )
 
-            if self._kill.is_set():
-                with self._lock:
-                    destroy_ev = self._destroy_event
-                self._emit(
-                    consts.TASK_STATE_DEAD,
-                    destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
-                    failed=False,
-                )
-                return
-
-            # terminated: record and consult the restart policy
-            ev = new_task_event(consts.TASK_EVENT_TERMINATED)
-            ev.exit_code = result.exit_code
-            ev.signal = result.signal
-            ev.message = result.error
-            self._emit(consts.TASK_STATE_PENDING, ev)
-
-            decision, wait = self.restart_tracker.next_restart(result.successful())
-            if decision == NO_RESTART:
-                self._emit(
-                    consts.TASK_STATE_DEAD,
-                    new_task_event(consts.TASK_EVENT_NOT_RESTARTING),
-                    failed=not result.successful(),
-                )
-                return
-
-            restart_ev = new_task_event(consts.TASK_EVENT_RESTARTING)
-            restart_ev.start_delay = wait
-            self._emit(consts.TASK_STATE_PENDING, restart_ev)
-            if self._kill.wait(wait):
-                self._emit(consts.TASK_STATE_DEAD,
-                           new_task_event(consts.TASK_EVENT_KILLED), failed=False)
+            if self._handle_terminated(result):
+                self._stop_template_manager()
                 return
 
         # _kill landed between the pre-loop check and the loop condition
         # (every in-loop exit returns above): still report terminal.
+        self._stop_template_manager()
         self._finish_killed()
+
+    def _handle_terminated(self, result: WaitResult) -> bool:
+        """Process one task exit; True when run() should return (task is
+        terminally dead), False to loop around and restart."""
+        if self._kill.is_set():
+            with self._lock:
+                destroy_ev = self._destroy_event
+            self._emit(
+                consts.TASK_STATE_DEAD,
+                destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
+                failed=False,
+            )
+            return True
+
+        # terminated: record the exit
+        ev = new_task_event(consts.TASK_EVENT_TERMINATED)
+        ev.exit_code = result.exit_code
+        ev.signal = result.signal
+        ev.message = result.error
+        self._emit(consts.TASK_STATE_PENDING, ev)
+
+        # A template-triggered restart is deliberate: it neither consults
+        # nor consumes the restart policy (consul_template.go restart).
+        if self._restart_requested.is_set():
+            self._restart_requested.clear()
+            self._emit(consts.TASK_STATE_PENDING,
+                       new_task_event(consts.TASK_EVENT_RESTARTING))
+            return False
+
+        decision, wait = self.restart_tracker.next_restart(result.successful())
+        if decision == NO_RESTART:
+            self._emit(
+                consts.TASK_STATE_DEAD,
+                new_task_event(consts.TASK_EVENT_NOT_RESTARTING),
+                failed=not result.successful(),
+            )
+            return True
+
+        restart_ev = new_task_event(consts.TASK_EVENT_RESTARTING)
+        restart_ev.start_delay = wait
+        self._emit(consts.TASK_STATE_PENDING, restart_ev)
+        if self._kill.wait(wait):
+            self._emit(consts.TASK_STATE_DEAD,
+                       new_task_event(consts.TASK_EVENT_KILLED), failed=False)
+            return True
+        return False
+
+    def _prestart(self, ctx) -> Optional[str]:
+        """Artifacts + initial template render (task_runner.go:354
+        prestart). Returns an error string on failure, None on success."""
+        if self.task.artifacts:
+            self._emit(
+                consts.TASK_STATE_PENDING,
+                new_task_event(consts.TASK_EVENT_DOWNLOADING_ARTIFACTS),
+            )
+            from .getter import ArtifactError, fetch_artifact
+
+            for artifact in self.task.artifacts:
+                try:
+                    fetch_artifact(artifact, ctx.task_root or ctx.task_dir)
+                except ArtifactError as e:
+                    ev = new_task_event(
+                        consts.TASK_EVENT_ARTIFACT_DOWNLOAD_FAILED
+                    )
+                    ev.message = str(e)
+                    self._emit(consts.TASK_STATE_PENDING, ev)
+                    return f"artifact download failed: {e}"
+
+        return self._start_templates(ctx, fail_fast=True)
+
+    def _start_templates(self, ctx, fail_fast: bool) -> Optional[str]:
+        """Create + start the template manager (idempotent). With
+        fail_fast the initial render error is returned (prestart);
+        otherwise it is only logged (reattach path — the task is already
+        running and must not be failed for a render hiccup)."""
+        if not self.task.templates or self._template_manager is not None:
+            return None
+        from .template import TaskTemplateManager
+
+        mgr = TaskTemplateManager(
+            self.task, ctx.env, ctx.task_root or ctx.task_dir,
+            kv=self.template_kv,
+            on_change=self._on_template_change, logger=self.logger,
+        )
+        try:
+            mgr.render_all()
+        except ValueError as e:
+            if fail_fast:
+                return f"template render failed: {e}"
+            self.logger.exception("template render after reattach failed")
+        self._template_manager = mgr
+        mgr.start()
+        return None
+
+    def _on_template_change(self, mode: str, signal_name: str) -> None:
+        """A re-render changed a template (consul_template.go change
+        handling)."""
+        with self._lock:
+            handle = self.handle
+        # Only act on a live task: a change firing during restart
+        # backoff would otherwise set a stale _restart_requested that a
+        # later unrelated crash consumes to bypass the restart policy.
+        if handle is None or self.state.state != consts.TASK_STATE_RUNNING:
+            return
+        if mode == "restart":
+            self._restart_requested.set()
+            ev = new_task_event(consts.TASK_EVENT_RESTART_SIGNAL)
+            ev.message = "Template with change_mode restart re-rendered"
+            self._emit(self.state.state, ev)
+            try:
+                handle.kill(min(self.task.kill_timeout, self.max_kill_timeout))
+            except Exception:
+                self.logger.exception("template restart kill failed")
+        elif mode == "signal":
+            import signal as _signal
+
+            signum = getattr(_signal, signal_name or "SIGHUP", _signal.SIGHUP)
+            ev = new_task_event(consts.TASK_EVENT_SIGNALING)
+            ev.message = f"Template re-rendered; sending {signal_name or 'SIGHUP'}"
+            self._emit(self.state.state, ev)
+            try:
+                handle.signal(int(signum))
+            except Exception:
+                self.logger.exception("template signal failed")
+
+    def _stop_template_manager(self) -> None:
+        if self._template_manager is not None:
+            self._template_manager.stop()
+            self._template_manager = None
 
     def _finish_killed(self) -> None:
         """Reap the handle (if any) and emit the terminal killed state —

@@ -1,0 +1,175 @@
+"""Task template rendering and change watching.
+
+Reference: client/consul_template.go:452 TaskTemplateManager — renders
+Template blocks (inline or from a source file) into the task dir and
+applies change_mode (noop | signal | restart) when a re-render changes
+the output.
+
+The template language is a small consul-template-compatible subset:
+
+    {{ env "NAME" }}    task environment variable
+    {{ key "path" }}    key/value lookup (service registry KV, see
+                        client/servicereg.py; empty when missing)
+    {{ file "path" }}   contents of a file (resolved in the task dir)
+
+Values re-render on a poll loop; a change triggers the configured
+change_mode with the template's splay delay (consul_template.go splay).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..structs import Task, Template
+
+_FUNC_RE = re.compile(
+    r"\{\{\s*(env|key|file)\s+\"([^\"]*)\"\s*\}\}"
+)
+
+KVFunc = Callable[[str], Optional[str]]
+
+
+def render_template(text: str, env: Dict[str, str], kv: Optional[KVFunc],
+                    task_dir: str = "") -> str:
+    def repl(m: re.Match) -> str:
+        fn, arg = m.group(1), m.group(2)
+        if fn == "env":
+            return env.get(arg, "")
+        if fn == "key":
+            if kv is None:
+                return ""
+            return kv(arg) or ""
+        if fn == "file":
+            path = arg if os.path.isabs(arg) else os.path.join(task_dir, arg)
+            try:
+                with open(path) as f:
+                    return f.read()
+            except OSError:
+                return ""
+        return m.group(0)
+
+    return _FUNC_RE.sub(repl, text)
+
+
+class TaskTemplateManager:
+    """Renders a task's templates and watches for changes.
+
+    on_change(mode, signal_name) is invoked (once per poll round, with
+    the strongest mode among changed templates: restart > signal) after
+    the splay delay.
+    """
+
+    POLL_INTERVAL = 2.0
+
+    def __init__(
+        self,
+        task: Task,
+        env: Dict[str, str],
+        task_dir: str,
+        kv: Optional[KVFunc] = None,
+        on_change: Optional[Callable[[str, str], None]] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.task = task
+        self.templates: List[Template] = list(task.templates or [])
+        self.env = env
+        self.task_dir = task_dir
+        self.kv = kv
+        self.on_change = on_change
+        self.logger = logger or logging.getLogger("nomad_tpu.template")
+        self._rendered: Dict[int, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    def _source_text(self, tmpl: Template) -> str:
+        if tmpl.embedded_tmpl:
+            return tmpl.embedded_tmpl
+        path = tmpl.source_path
+        if path and not os.path.isabs(path):
+            path = os.path.join(self.task_dir, path)
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError as e:
+            raise ValueError(f"template source {tmpl.source_path!r}: {e}") from e
+
+    def _dest_path(self, tmpl: Template) -> str:
+        dest = tmpl.dest_path or "rendered.tmpl"
+        path = os.path.abspath(os.path.join(self.task_dir, dest))
+        base = os.path.abspath(self.task_dir)
+        # == or under base + sep: plain startswith would admit sibling
+        # dirs sharing the name prefix.
+        if path != base and not path.startswith(base + os.sep):
+            raise ValueError(f"template dest escapes task dir: {tmpl.dest_path}")
+        return path
+
+    def _render_one(self, i: int, tmpl: Template) -> bool:
+        """Render template i; write + return True when output changed."""
+        out = render_template(
+            self._source_text(tmpl), self.env, self.kv, self.task_dir
+        )
+        if self._rendered.get(i) == out:
+            return False
+        dest = self._dest_path(tmpl)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = dest + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(out)
+        os.replace(tmp, dest)
+        self._rendered[i] = out
+        return True
+
+    def render_all(self) -> None:
+        """Initial render during prestart; raises on any failure."""
+        for i, tmpl in enumerate(self.templates):
+            self._render_one(i, tmpl)
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.templates:
+            return
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True,
+            name=f"templates-{self.task.name}",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.POLL_INTERVAL):
+            changed_modes: List[Template] = []
+            for i, tmpl in enumerate(self.templates):
+                try:
+                    if self._render_one(i, tmpl):
+                        changed_modes.append(tmpl)
+                except ValueError:
+                    self.logger.exception("template re-render failed")
+            if not changed_modes or self.on_change is None:
+                continue
+            # restart dominates signal dominates noop
+            mode, signal_name, splay = "noop", "", 0.0
+            for tmpl in changed_modes:
+                splay = max(splay, tmpl.splay)
+                if tmpl.change_mode == "restart":
+                    mode = "restart"
+                elif tmpl.change_mode == "signal" and mode != "restart":
+                    mode, signal_name = "signal", tmpl.change_signal
+            if mode == "noop":
+                continue
+            if splay and self._stop.wait(splay):
+                return
+            try:
+                self.on_change(mode, signal_name)
+            except Exception:
+                self.logger.exception("template change handler failed")

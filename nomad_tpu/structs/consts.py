@@ -71,6 +71,8 @@ TASK_EVENT_KILLING = "Killing"
 TASK_EVENT_KILLED = "Killed"
 TASK_EVENT_DOWNLOADING_ARTIFACTS = "Downloading Artifacts"
 TASK_EVENT_ARTIFACT_DOWNLOAD_FAILED = "Failed Artifact Download"
+TASK_EVENT_SIGNALING = "Signaling"
+TASK_EVENT_RESTART_SIGNAL = "Restart Signaled"
 
 # --- Constraint operands (structs.go:2713-2715, feasible.go:337-371) ---
 CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"

@@ -1,0 +1,334 @@
+"""Artifact fetching + template rendering (reference client/getter,
+client/consul_template.go) and their task-prestart integration."""
+
+import hashlib
+import http.server
+import os
+import tarfile
+import threading
+import time
+import zipfile
+
+import pytest
+
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.getter import ArtifactError, fetch_artifact
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.client.template import TaskTemplateManager, render_template
+from nomad_tpu import mock
+from nomad_tpu.structs import TaskArtifact, Template, consts
+
+
+# ---------------------------------------------------------------- getter
+
+
+def test_fetch_local_file(tmp_path):
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"data123")
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    art = TaskArtifact(getter_source=str(src))
+    fetch_artifact(art, str(task_dir))
+    out = task_dir / "payload.bin"
+    assert out.read_bytes() == b"data123"
+    assert os.access(out, os.X_OK)  # downloaded artifacts made executable
+
+
+def test_fetch_with_relative_dest_and_checksum(tmp_path):
+    src = tmp_path / "a.txt"
+    src.write_bytes(b"hello")
+    digest = hashlib.sha256(b"hello").hexdigest()
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    art = TaskArtifact(
+        getter_source=f"file://{src}",
+        getter_options={"checksum": f"sha256:{digest}"},
+        relative_dest="sub/dir",
+    )
+    fetch_artifact(art, str(task_dir))
+    assert (task_dir / "sub" / "dir" / "a.txt").read_bytes() == b"hello"
+
+
+def test_fetch_checksum_mismatch(tmp_path):
+    src = tmp_path / "a.txt"
+    src.write_bytes(b"hello")
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    art = TaskArtifact(
+        getter_source=str(src),
+        getter_options={"checksum": "sha256:" + "0" * 64},
+    )
+    with pytest.raises(ArtifactError, match="checksum mismatch"):
+        fetch_artifact(art, str(task_dir))
+    assert not (task_dir / "a.txt").exists()
+
+
+def test_fetch_http(tmp_path):
+    serve_dir = tmp_path / "www"
+    serve_dir.mkdir()
+    (serve_dir / "remote.txt").write_bytes(b"from-http")
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(serve_dir), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        url = f"http://127.0.0.1:{srv.server_port}/remote.txt"
+        fetch_artifact(TaskArtifact(getter_source=url), str(task_dir))
+        assert (task_dir / "remote.txt").read_bytes() == b"from-http"
+    finally:
+        srv.shutdown()
+
+
+def test_fetch_unpacks_tarball(tmp_path):
+    payload = tmp_path / "inner.txt"
+    payload.write_text("inside")
+    tar_path = tmp_path / "bundle.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(payload, arcname="inner.txt")
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    fetch_artifact(TaskArtifact(getter_source=str(tar_path)), str(task_dir))
+    assert (task_dir / "inner.txt").read_text() == "inside"
+    assert not (task_dir / "bundle.tar.gz").exists()
+
+
+def test_fetch_archive_false_keeps_archive(tmp_path):
+    tar_path = tmp_path / "bundle.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        pass
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    fetch_artifact(
+        TaskArtifact(getter_source=str(tar_path),
+                     getter_options={"archive": "false"}),
+        str(task_dir),
+    )
+    assert (task_dir / "bundle.tar").exists()
+
+
+def test_fetch_zip_escape_rejected(tmp_path):
+    zip_path = tmp_path / "evil.zip"
+    with zipfile.ZipFile(zip_path, "w") as zf:
+        zf.writestr("../escape.txt", "boom")
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    with pytest.raises(ArtifactError, match="escapes dest"):
+        fetch_artifact(TaskArtifact(getter_source=str(zip_path)), str(task_dir))
+
+
+def test_dest_escape_rejected(tmp_path):
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    art = TaskArtifact(getter_source="/etc/hostname", relative_dest="../../out")
+    with pytest.raises(ArtifactError, match="escapes task dir"):
+        fetch_artifact(art, str(task_dir))
+
+
+# -------------------------------------------------------------- template
+
+
+def test_render_template_functions(tmp_path):
+    (tmp_path / "inc.txt").write_text("included")
+    out = render_template(
+        'port={{ env "PORT" }} svc={{ key "svc/web" }} body={{ file "inc.txt" }}',
+        env={"PORT": "8080"},
+        kv=lambda p: {"svc/web": "10.0.0.1"}.get(p),
+        task_dir=str(tmp_path),
+    )
+    assert out == "port=8080 svc=10.0.0.1 body=included"
+
+
+def test_render_missing_values_empty():
+    out = render_template('a={{ env "NOPE" }} b={{ key "nope" }}',
+                          env={}, kv=lambda p: None)
+    assert out == "a= b="
+
+
+def test_template_manager_renders_and_watches_change(tmp_path):
+    task = mock.job().task_groups[0].tasks[0]
+    task.templates = [
+        Template(embedded_tmpl='value={{ key "cfg" }}',
+                 dest_path="local/app.conf", change_mode="restart", splay=0.0),
+    ]
+    kv_store = {"cfg": "one"}
+    changes = []
+    mgr = TaskTemplateManager(
+        task, env={}, task_dir=str(tmp_path), kv=kv_store.get,
+        on_change=lambda mode, sig: changes.append((mode, sig)),
+    )
+    mgr.POLL_INTERVAL = 0.1
+    mgr.render_all()
+    dest = tmp_path / "local" / "app.conf"
+    assert dest.read_text() == "value=one"
+
+    mgr.start()
+    try:
+        kv_store["cfg"] = "two"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not changes:
+            time.sleep(0.05)
+        assert changes == [("restart", "")]
+        assert dest.read_text() == "value=two"
+    finally:
+        mgr.stop()
+
+
+def test_template_signal_mode_precedence(tmp_path):
+    task = mock.job().task_groups[0].tasks[0]
+    task.templates = [
+        Template(embedded_tmpl='{{ key "a" }}', dest_path="a",
+                 change_mode="signal", change_signal="SIGHUP", splay=0.0),
+        Template(embedded_tmpl='{{ key "b" }}', dest_path="b",
+                 change_mode="restart", splay=0.0),
+    ]
+    kv_store = {"a": "1", "b": "1"}
+    changes = []
+    mgr = TaskTemplateManager(
+        task, env={}, task_dir=str(tmp_path), kv=kv_store.get,
+        on_change=lambda mode, sig: changes.append((mode, sig)),
+    )
+    mgr.POLL_INTERVAL = 0.1
+    mgr.render_all()
+    mgr.start()
+    try:
+        kv_store["a"] = "2"
+        kv_store["b"] = "2"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not changes:
+            time.sleep(0.05)
+        # restart dominates signal when both changed in one round
+        assert changes[0][0] == "restart"
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------------- task runner prestart
+
+
+def make_runner(tmp_path, task, states):
+    alloc = mock.alloc()
+    alloc.job.task_groups[0].tasks = [task]
+    alloc.task_group = alloc.job.task_groups[0].name
+    adir = AllocDir(str(tmp_path / "alloc"))
+
+    def cb(name, st):
+        states.append((st.state, [e.type for e in st.events]))
+
+    return TaskRunner(alloc, task, adir, cb)
+
+
+def test_prestart_artifact_and_template_e2e(tmp_path):
+    src = tmp_path / "greeting.txt"
+    src.write_text("salutations")
+    task = mock.job().task_groups[0].tasks[0]
+    task.name = "web"
+    task.driver = "raw_exec"
+    task.artifacts = [TaskArtifact(getter_source=str(src))]
+    task.templates = [
+        Template(embedded_tmpl='greet={{ env "GREETING" }}',
+                 dest_path="local/app.conf", change_mode="noop"),
+    ]
+    task.env = {"GREETING": "bonjour"}
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", "cat greeting.txt local/app.conf"],
+    }
+    tg = mock.job().task_groups[0]
+
+    states = []
+    runner = make_runner(tmp_path, task, states)
+    runner.alloc.job.task_groups[0].restart_policy.attempts = 0
+    runner.alloc.job.task_groups[0].restart_policy.mode = "fail"
+    runner.alloc_dir.build([task.name])
+    runner.run()
+
+    assert runner.state.state == consts.TASK_STATE_DEAD
+    assert not runner.state.failed
+    types = [e.type for e in runner.state.events]
+    assert consts.TASK_EVENT_DOWNLOADING_ARTIFACTS in types
+    logs = runner.alloc_dir.log_dir()
+    out = b""
+    for _ in range(50):
+        try:
+            out = open(os.path.join(logs, "web.stdout.0"), "rb").read()
+        except OSError:
+            out = b""
+        if b"salutations" in out:
+            break
+        time.sleep(0.1)
+    assert b"salutations" in out
+    assert b"greet=bonjour" in out
+
+
+def test_prestart_artifact_failure_respects_restart_policy(tmp_path):
+    task = mock.job().task_groups[0].tasks[0]
+    task.name = "web"
+    task.driver = "mock_driver"
+    task.config = {"run_for": 0.1}
+    task.artifacts = [TaskArtifact(getter_source="/no/such/file-xyz")]
+
+    states = []
+    runner = make_runner(tmp_path, task, states)
+    runner.alloc.job.task_groups[0].restart_policy.attempts = 0
+    runner.alloc.job.task_groups[0].restart_policy.mode = "fail"
+    runner.restart_tracker.policy.attempts = 0
+    runner.restart_tracker.policy.mode = "fail"
+    runner.alloc_dir.build([task.name])
+    runner.run()
+
+    assert runner.state.state == consts.TASK_STATE_DEAD
+    assert runner.state.failed
+    types = [e.type for e in runner.state.events]
+    assert consts.TASK_EVENT_ARTIFACT_DOWNLOAD_FAILED in types
+
+
+def test_template_restart_cycles_task_without_policy(tmp_path):
+    """change_mode=restart re-runs the task without consuming restart
+    attempts (consul_template.go deliberate restarts)."""
+    task = mock.job().task_groups[0].tasks[0]
+    task.name = "web"
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 600"]}
+    kv_store = {"cfg": "one"}
+    task.templates = [
+        Template(embedded_tmpl='v={{ key "cfg" }}', dest_path="local/c",
+                 change_mode="restart", splay=0.0),
+    ]
+
+    states = []
+    runner = make_runner(tmp_path, task, states)
+    runner.template_kv = kv_store.get
+    runner.restart_tracker.policy.attempts = 0
+    runner.restart_tracker.policy.mode = "fail"
+    runner.alloc_dir.build([task.name])
+    runner.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and runner.handle is None:
+            time.sleep(0.05)
+        assert runner.handle is not None
+        pid1 = runner.handle.pid()
+        runner._template_manager.POLL_INTERVAL = 0.1
+
+        kv_store["cfg"] = "two"
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            h = runner.handle
+            if h is not None and h.pid() and h.pid() != pid1:
+                break
+            time.sleep(0.05)
+        assert runner.handle.pid() != pid1  # restarted with a fresh process
+        types = [e.type for e in runner.state.events]
+        assert consts.TASK_EVENT_RESTART_SIGNAL in types
+        assert runner.state.state == consts.TASK_STATE_RUNNING
+    finally:
+        runner.kill()
+        runner.join(timeout=15.0)
