@@ -23,9 +23,12 @@ class APIError(Exception):
 
 
 class Client:
-    def __init__(self, address: str, timeout: float = 305.0):
+    def __init__(self, address: str, timeout: float = 305.0, region: str = ""):
         self.address = address.rstrip("/")
         self.timeout = timeout
+        # Target region: forwarded server-side when it differs from the
+        # contacted agent's region (api.go QueryOptions.Region).
+        self.region = region
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -33,6 +36,7 @@ class Client:
         self.system = System(self)
         self.agent = Agent(self)
         self.alloc_fs = AllocFS(self)
+        self.regions = Regions(self)
 
     # ------------------------------------------------------------------
 
@@ -44,6 +48,13 @@ class Client:
         params: Optional[Dict[str, str]] = None,
     ) -> Tuple[Any, int]:
         url = self.address + path
+        if self.region:
+            if isinstance(params, list):
+                if not any(k == "region" for k, _ in params):
+                    params = params + [("region", self.region)]
+            else:
+                params = dict(params or {})
+                params.setdefault("region", self.region)
         if params:
             url += "?" + urllib.parse.urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
@@ -69,6 +80,9 @@ class Client:
     def get_raw(self, path: str, params: Optional[Dict] = None) -> bytes:
         """GET returning raw bytes (fs cat/readat endpoints)."""
         url = self.address + path
+        if self.region:
+            params = dict(params or {})
+            params.setdefault("region", self.region)
         if params:
             url += "?" + urllib.parse.urlencode(params)
         req = urllib.request.Request(url, method="GET")
@@ -247,6 +261,34 @@ class Agent:
 
     def leader(self) -> str:
         out, _ = self.c.get("/v1/status/leader")
+        return out
+
+    def members(self) -> List[dict]:
+        out, _ = self.c.get("/v1/agent/members")
+        return out
+
+    def join(self, addrs: List[str]) -> int:
+        out, _ = self.c.put(
+            "/v1/agent/join", params=[("address", a) for a in addrs]
+        )
+        return out["num_joined"]
+
+    def force_leave(self, name: str) -> None:
+        self.c.put("/v1/agent/force-leave", params={"node": name})
+
+    def servers(self) -> List[str]:
+        out, _ = self.c.get("/v1/agent/servers")
+        return out
+
+
+class Regions:
+    """Region listing (api/regions.go)."""
+
+    def __init__(self, client: Client):
+        self.c = client
+
+    def list(self) -> List[str]:
+        out, _ = self.c.get("/v1/regions")
         return out
 
 

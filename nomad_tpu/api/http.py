@@ -12,7 +12,9 @@ import json
 import re
 import threading
 import time
+import urllib.error
 import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -33,11 +35,15 @@ class HTTPError(Exception):
 
 
 class RawResponse:
-    """Non-JSON reply (file contents for the fs endpoints)."""
+    """Non-JSON reply (file contents for the fs endpoints). A non-None
+    index overrides the X-Nomad-Index header (used by cross-region
+    forwarding so the remote region's index is preserved)."""
 
-    def __init__(self, data: bytes, content_type: str = "application/octet-stream"):
+    def __init__(self, data: bytes, content_type: str = "application/octet-stream",
+                 index: Optional[int] = None):
         self.data = data
         self.content_type = content_type
+        self.index = index
 
 
 class HTTPServer:
@@ -75,6 +81,8 @@ class HTTPServer:
             def _reply(self, status, body, index=None):
                 if isinstance(body, RawResponse):
                     data, ctype = body.data, body.content_type
+                    if body.index is not None:
+                        index = body.index
                 else:
                     data, ctype = json.dumps(body).encode(), "application/json"
                 self.send_response(status)
@@ -114,7 +122,18 @@ class HTTPServer:
         if length:
             body = json.loads(req.rfile.read(length))
 
+        # Cross-region forwarding (rpc.go:178,263 forwardRegion): if the
+        # request names another region, proxy it to a server there.
+        region = query.get("region", [None])[0]
+        if region and region != self.server.config.region:
+            return self._forward_region(region, method, parsed, body)
+
         route_handlers: List[Tuple[str, Callable]] = [
+            (r"^/v1/regions$", self._regions),
+            (r"^/v1/agent/members$", self._agent_members),
+            (r"^/v1/agent/join$", self._agent_join),
+            (r"^/v1/agent/force-leave$", self._agent_force_leave),
+            (r"^/v1/agent/servers$", self._agent_servers),
             (r"^/v1/jobs$", self._jobs),
             (r"^/v1/job/(?P<job_id>[^/]+)$", self._job),
             (r"^/v1/job/(?P<job_id>[^/]+)/allocations$", self._job_allocations),
@@ -392,6 +411,83 @@ class HTTPServer:
     def _system_gc(self, method, query, body):
         self.server.force_gc()
         return {}
+
+    # ------------------------------------------------- regions + gossip
+
+    def _forward_region(self, region: str, method: str, parsed, body):
+        """Proxy the request to a server in the target region, keeping
+        path and query intact (the remote matches the region so it
+        handles locally)."""
+        peer = self.server.peer_http_addr(region)
+        if peer is None:
+            raise HTTPError(500, f"no path to region {region!r}")
+        url = peer.rstrip("/") + parsed.path
+        if parsed.query:
+            url += "?" + parsed.query
+        data = json.dumps(body).encode() if body is not None else None
+        freq = urllib.request.Request(url, data=data, method=method)
+        freq.add_header("Content-Type", "application/json")
+        try:
+            # Outlive the longest server-side blocking query
+            # (MAX_BLOCKING_WAIT) so forwarded long-polls don't 500.
+            with urllib.request.urlopen(
+                freq, timeout=MAX_BLOCKING_WAIT + 10.0
+            ) as resp:
+                # Pass the remote reply through verbatim — content type
+                # (fs endpoints return octet-streams) and the remote
+                # region's X-Nomad-Index both survive the proxy hop.
+                remote_index = resp.headers.get("X-Nomad-Index")
+                return RawResponse(
+                    resp.read(),
+                    resp.headers.get("Content-Type") or "application/json",
+                    index=int(remote_index) if remote_index else None,
+                )
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except ValueError:
+                pass
+            raise HTTPError(e.code, detail)
+        except urllib.error.URLError as e:
+            raise HTTPError(500, f"region {region!r} forward failed: {e.reason}")
+
+    def _regions(self, method, query, body):
+        return self.server.regions()
+
+    def _agent_members(self, method, query, body):
+        return [
+            {
+                "name": m.name,
+                "region": m.region,
+                "datacenter": m.datacenter,
+                "addr": m.addr,
+                "status": m.status,
+                "tags": m.tags,
+            }
+            for m in self.server.serf_members()
+        ]
+
+    def _agent_join(self, method, query, body):
+        addrs = query.get("address", [])
+        joined = self.server.serf_join(addrs)
+        return {"num_joined": joined, "error": "" if joined else "no peers contacted"}
+
+    def _agent_force_leave(self, method, query, body):
+        name = query.get("node", [""])[0]
+        if not name:
+            raise HTTPError(400, "missing ?node= parameter")
+        self.server.serf_force_leave(name)
+        return {}
+
+    def _agent_servers(self, method, query, body):
+        members = [
+            m for m in self.server.serf_members()
+            if m.region == self.server.config.region and m.status == "alive"
+        ]
+        if members:
+            return [m.tags.get("http_addr") or m.addr for m in members]
+        return [self.addr]
 
     # --------------------------------------- client fs + stats routes
 

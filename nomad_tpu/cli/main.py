@@ -68,7 +68,8 @@ job "example" {
 
 def _client(args) -> Client:
     address = args.address or os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
-    return Client(address, timeout=30.0)
+    region = getattr(args, "region", "") or os.environ.get("NOMAD_REGION", "")
+    return Client(address, timeout=30.0, region=region)
 
 
 def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
@@ -450,6 +451,33 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_server_members(args) -> int:
+    client = _client(args)
+    members = client.agent.members()
+    if not members:
+        print("No known members")
+        return 0
+    print(f"{'Name':<28} {'Addr':<22} {'Status':<8} {'Region':<10} DC")
+    for m in sorted(members, key=lambda m: m["name"]):
+        print(f"{m['name']:<28} {m['addr']:<22} {m['status']:<8} "
+              f"{m['region']:<10} {m['datacenter']}")
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    client = _client(args)
+    joined = client.agent.join(args.addrs)
+    print(f"Joined {joined} servers successfully")
+    return 0 if joined else 1
+
+
+def cmd_server_force_leave(args) -> int:
+    client = _client(args)
+    client.agent.force_leave(args.node)
+    print(f"Force-leave of {args.node} requested")
+    return 0
+
+
 def cmd_agent_info(args) -> int:
     client = _client(args)
     info = client.agent.self()
@@ -481,14 +509,25 @@ def cmd_agent(args) -> int:
     scheduler_factories = {}
     if args.tpu:
         scheduler_factories = {"service": "service-tpu", "batch": "batch-tpu"}
+    import socket as _socket
+
+    # Unique gossip identity per agent: two same-region agents with the
+    # same member name would clobber each other in the serf pool.
+    node_name = args.node_name or f"{_socket.gethostname()}-{args.port}"
     server = Server(
         ServerConfig(num_schedulers=args.num_schedulers,
-                     scheduler_factories=scheduler_factories)
+                     scheduler_factories=scheduler_factories,
+                     region=args.region, node_name=node_name)
     )
     server.start()
     http = HTTPServer(server, host=args.bind, port=args.port)
     http.start()
+    serf_addr = server.setup_serf(host=args.bind, http_addr=http.addr)
+    if args.join:
+        joined = server.serf_join(args.join.split(","))
+        print(f"==> Joined {joined} gossip peers")
     print(f"==> nomad-tpu agent started (dev mode)! HTTP: {http.addr}")
+    print(f"    Gossip: {serf_addr} (region {args.region})")
     print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
 
     client_agent = ClientAgent(
@@ -521,6 +560,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="nomad-tpu", description="TPU-native cluster scheduler"
     )
     parser.add_argument("--address", default=None, help="agent HTTP address")
+    parser.add_argument("--region", default=None,
+                        help="target region (forwarded by the agent)")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("agent", help="run an agent")
@@ -529,6 +570,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-bind", dest="bind", default="127.0.0.1")
     p.add_argument("-port", dest="port", type=int, default=4646)
     p.add_argument("-num-schedulers", dest="num_schedulers", type=int, default=2)
+    p.add_argument("-region", dest="region", default="global")
+    p.add_argument("-node-name", dest="node_name", default="",
+                   help="unique agent name (default hostname-port)")
+    p.add_argument("-join", dest="join", default="",
+                   help="comma-separated gossip addrs to join at start")
     p.add_argument("-tpu", dest="tpu", action="store_true",
                    help="route service/batch evals to the TPU backend")
     p.add_argument("-log-level", dest="log_level", default="INFO")
@@ -598,6 +644,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-tail", dest="tail", action="store_true")
     p.add_argument("-n", dest="n", type=int, default=0)
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("server-members", help="display gossip pool members")
+    p.set_defaults(fn=cmd_server_members)
+
+    p = sub.add_parser("server-join", help="join the agent to a gossip pool")
+    p.add_argument("addrs", nargs="+", help="gossip addresses host:port")
+    p.set_defaults(fn=cmd_server_join)
+
+    p = sub.add_parser("server-force-leave", help="force a member to leave")
+    p.add_argument("node", help="member name")
+    p.set_defaults(fn=cmd_server_force_leave)
 
     p = sub.add_parser("agent-info", help="display agent stats")
     p.set_defaults(fn=cmd_agent_info)

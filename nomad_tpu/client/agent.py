@@ -269,12 +269,13 @@ class ClientAgent:
     def _save_state(self) -> None:
         with self._runners_lock:
             runners = list(self.alloc_runners.values())
+            restored = {a: dict(h) for a, h in self._restored_handles.items()}
         alloc_entries = [r.persist() for r in runners]
         # Restored handles not yet claimed by a runner must survive
         # rewrites of the state file, or a second restart before the
         # first alloc pull would orphan their executors.
         persisted_ids = {e["alloc_id"] for e in alloc_entries}
-        for alloc_id, handles in self._restored_handles.items():
+        for alloc_id, handles in restored.items():
             if alloc_id not in persisted_ids:
                 alloc_entries.append({
                     "alloc_id": alloc_id,

@@ -298,7 +298,7 @@ class TaskRunner:
         )
         try:
             mgr.render_all()
-        except ValueError as e:
+        except (ValueError, OSError) as e:
             if fail_fast:
                 return f"template render failed: {e}"
             self.logger.exception("template render after reattach failed")

@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..structs import Task, Template
+from .getter import _contained
 
 _FUNC_RE = re.compile(
     r"\{\{\s*(env|key|file)\s+\"([^\"]*)\"\s*\}\}"
@@ -99,13 +100,12 @@ class TaskTemplateManager:
         except OSError as e:
             raise ValueError(f"template source {tmpl.source_path!r}: {e}") from e
 
-    def _dest_path(self, tmpl: Template) -> str:
-        dest = tmpl.dest_path or "rendered.tmpl"
+    def _dest_path(self, i: int, tmpl: Template) -> str:
+        # Dest-less templates get an index-unique default so two of
+        # them can't silently clobber each other's output.
+        dest = tmpl.dest_path or f"rendered-{i}.tmpl"
         path = os.path.abspath(os.path.join(self.task_dir, dest))
-        base = os.path.abspath(self.task_dir)
-        # == or under base + sep: plain startswith would admit sibling
-        # dirs sharing the name prefix.
-        if path != base and not path.startswith(base + os.sep):
+        if not _contained(path, self.task_dir):
             raise ValueError(f"template dest escapes task dir: {tmpl.dest_path}")
         return path
 
@@ -116,7 +116,7 @@ class TaskTemplateManager:
         )
         if self._rendered.get(i) == out:
             return False
-        dest = self._dest_path(tmpl)
+        dest = self._dest_path(i, tmpl)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         tmp = dest + ".tmp"
         with open(tmp, "w") as f:
@@ -153,7 +153,7 @@ class TaskTemplateManager:
                 try:
                     if self._render_one(i, tmpl):
                         changed_modes.append(tmpl)
-                except ValueError:
+                except (ValueError, OSError):
                     self.logger.exception("template re-render failed")
             if not changed_modes or self.on_change is None:
                 continue

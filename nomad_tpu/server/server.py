@@ -69,6 +69,12 @@ class Server:
         self.cluster: Optional[Dict[str, "Server"]] = None
         self.node_id = self.config.node_name or "server-0"
         self._leadership_lock = threading.Lock()
+        # Gossip membership (serf.go): peers is all known servers keyed
+        # by region, local_peers the same-region subset — mirroring
+        # server.go:100-104 peers/localPeers.
+        self.serf = None
+        self.peers: Dict[str, Dict[str, object]] = {}
+        self._peers_lock = threading.Lock()
 
         self._register_core_scheduler()
 
@@ -181,6 +187,8 @@ class Server:
         if getattr(self, "_telemetry_stop", None) is not None:
             self._telemetry_stop.set()
         self.revoke_leadership()
+        if self.serf is not None:
+            self.serf.shutdown()
         if self.raft is not None:
             self.raft.stop()
         for w in self.workers:
@@ -188,6 +196,73 @@ class Server:
 
     def is_leader(self) -> bool:
         return self._leader
+
+    # ---------------------------------------------------- serf/federation
+
+    def setup_serf(self, host: str = "127.0.0.1", port: int = 0,
+                   http_addr: str = "", rpc_addr: str = "") -> str:
+        """Join the gossip pool, advertising this server's addresses.
+
+        Reference: server.go:740-760 (setupSerf tags) + serf.go
+        (serfEventHandler maintaining peers/localPeers).
+        """
+        from .serf import ALIVE, Serf
+
+        def on_event(event: str, member) -> None:
+            with self._peers_lock:
+                region_peers = self.peers.setdefault(member.region, {})
+                if member.status == ALIVE:
+                    region_peers[member.name] = member
+                else:
+                    region_peers.pop(member.name, None)
+                    if not region_peers:
+                        self.peers.pop(member.region, None)
+
+        self.serf = Serf(
+            name=f"{self.node_id}.{self.config.region}",
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            tags={
+                "role": "nomad",
+                "http_addr": http_addr,
+                "rpc_addr": rpc_addr,
+                "bootstrap_expect": str(self.config.bootstrap_expect),
+            },
+            on_event=on_event,
+        )
+        return self.serf.serve(host, port)
+
+    def serf_join(self, addrs: List[str]) -> int:
+        if self.serf is None:
+            raise ValueError("serf not configured on this server")
+        return self.serf.join(addrs)
+
+    def serf_members(self) -> List[object]:
+        return self.serf.members() if self.serf is not None else []
+
+    def serf_force_leave(self, name: str) -> bool:
+        if self.serf is None:
+            return False
+        return self.serf.force_leave(name)
+
+    def regions(self) -> List[str]:
+        """Sorted known regions (region_endpoint.go:13)."""
+        with self._peers_lock:
+            known = set(self.peers.keys())
+        known.add(self.config.region)
+        return sorted(known)
+
+    def peer_http_addr(self, region: str) -> Optional[str]:
+        """An HTTP address of some alive server in the region, for
+        cross-region request forwarding (rpc.go:263 forwardRegion picks
+        a random server)."""
+        import random as _random
+
+        with self._peers_lock:
+            members = list(self.peers.get(region, {}).values())
+        candidates = [m.tags.get("http_addr") for m in members]
+        candidates = [a for a in candidates if a]
+        return _random.choice(candidates) if candidates else None
 
     def establish_leadership(self) -> None:
         """Enable leader-only services and restore their state

@@ -1,0 +1,196 @@
+"""Gossip membership + multi-region federation tests.
+
+Reference behaviors: serf member join/leave/failure events wiring the
+server peers maps (nomad/serf.go, server.go:100-104), region listing
+(nomad/region_endpoint.go:13), and cross-region request forwarding
+(nomad/rpc.go:178,263).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.api.client import Client
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.serf import ALIVE, FAILED, LEFT, Serf
+
+
+def wait_until(fn, timeout=5.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+class TestSerf:
+    def test_join_and_member_exchange(self):
+        events = []
+        a = Serf("a.global", probe_interval=0.1)
+        b = Serf("b.global", on_event=lambda ev, m: events.append((ev, m.name)),
+                 probe_interval=0.1)
+        try:
+            addr_a = a.serve()
+            b.serve()
+            assert b.join([addr_a]) == 1
+            assert wait_until(lambda: len(a.members()) == 2)
+            assert {m.name for m in a.members()} == {"a.global", "b.global"}
+            assert ("member-join", "a.global") in events
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_transitive_membership_via_gossip(self):
+        """c joins b only; a learns about c through the gossip rounds."""
+        a, b, c = Serf("a", probe_interval=0.05), Serf("b", probe_interval=0.05), \
+            Serf("c", probe_interval=0.05)
+        try:
+            addr_a = a.serve()
+            addr_b = b.serve()
+            c.serve()
+            b.join([addr_a])
+            c.join([addr_b])
+            assert wait_until(lambda: len(a.members()) == 3)
+        finally:
+            for s in (a, b, c):
+                s.shutdown()
+
+    def test_graceful_leave(self):
+        a = Serf("a", probe_interval=0.05)
+        b = Serf("b", probe_interval=0.05)
+        try:
+            addr_a = a.serve()
+            b.serve()
+            b.join([addr_a])
+            wait_until(lambda: len(a.members()) == 2)
+            b.leave()
+            assert wait_until(
+                lambda: any(
+                    m.name == "b" and m.status == LEFT for m in a.members()
+                )
+            )
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_failure_detection(self):
+        a = Serf("a", probe_interval=0.05, suspicion_probes=2)
+        b = Serf("b", probe_interval=0.05)
+        try:
+            addr_a = a.serve()
+            b.serve()
+            b.join([addr_a])
+            wait_until(lambda: len(a.members()) == 2)
+            # Hard-kill b (no graceful leave): a must mark it failed.
+            b.shutdown()
+            assert wait_until(
+                lambda: any(
+                    m.name == "b" and m.status == FAILED for m in a.members()
+                ),
+                timeout=8.0,
+            )
+        finally:
+            a.shutdown()
+
+    def test_force_leave(self):
+        a = Serf("a", probe_interval=0.05)
+        b = Serf("b", probe_interval=0.05)
+        try:
+            addr_a = a.serve()
+            b.serve()
+            b.join([addr_a])
+            wait_until(lambda: len(a.members()) == 2)
+            assert a.force_leave("b")
+            assert [m for m in a.members() if m.name == "b"][0].status == LEFT
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+@pytest.fixture()
+def two_region_cluster():
+    """One dev server per region, gossip-joined, each with HTTP."""
+    servers, https = [], []
+    for region in ("global", "east"):
+        cfg = ServerConfig(region=region, node_name=f"srv-{region}",
+                           num_schedulers=1)
+        srv = Server(cfg)
+        srv.start()
+        http = HTTPServer(srv)
+        http.start()
+        srv.setup_serf(http_addr=http.addr)
+        # speed up gossip for tests
+        srv.serf.probe_interval = 0.05
+        servers.append(srv)
+        https.append(http)
+    servers[1].serf_join([servers[0].serf.local_member.addr])
+    assert wait_until(
+        lambda: len(servers[0].regions()) == 2 and len(servers[1].regions()) == 2
+    )
+    yield servers, https
+    for http in https:
+        http.stop()
+    for srv in servers:
+        srv.shutdown()
+
+
+class TestFederation:
+    def test_regions_endpoint(self, two_region_cluster):
+        servers, https = two_region_cluster
+        client = Client(https[0].addr)
+        assert client.regions.list() == ["east", "global"]
+
+    def test_agent_members(self, two_region_cluster):
+        _, https = two_region_cluster
+        client = Client(https[0].addr)
+        members = client.agent.members()
+        assert {m["name"] for m in members} == {"srv-global.global", "srv-east.east"}
+        assert all(m["status"] == ALIVE for m in members)
+
+    def test_cross_region_forwarding(self, two_region_cluster):
+        """A job registered via region=east through the global agent
+        lands on the east server."""
+        servers, https = two_region_cluster
+        client = Client(https[0].addr, region="east")
+        job = mock.job()
+        client.jobs.register(job)
+        assert servers[1].fsm.state.job_by_id(job.id) is not None
+        assert servers[0].fsm.state.job_by_id(job.id) is None
+        # And reads forward back too.
+        got, _ = client.jobs.info(job.id)
+        assert got.id == job.id
+
+    def test_forward_to_unknown_region_fails(self, two_region_cluster):
+        _, https = two_region_cluster
+        client = Client(https[0].addr, region="mars")
+        from nomad_tpu.api.client import APIError
+
+        with pytest.raises(APIError, match="no path to region"):
+            client.jobs.list()
+
+    def test_agent_join_endpoint(self):
+        cfg_a = ServerConfig(node_name="a", num_schedulers=1)
+        cfg_b = ServerConfig(node_name="b", num_schedulers=1)
+        a, b = Server(cfg_a), Server(cfg_b)
+        a.start()
+        b.start()
+        ha, hb = HTTPServer(a), HTTPServer(b)
+        ha.start()
+        hb.start()
+        try:
+            a.setup_serf(http_addr=ha.addr)
+            b.setup_serf(http_addr=hb.addr)
+            client = Client(ha.addr)
+            joined = client.agent.join([b.serf.local_member.addr])
+            assert joined == 1
+            assert wait_until(lambda: len(client.agent.members()) == 2)
+            servers = client.agent.servers()
+            assert ha.addr in servers and hb.addr in servers
+        finally:
+            ha.stop()
+            hb.stop()
+            a.shutdown()
+            b.shutdown()
