@@ -150,6 +150,8 @@ class HTTPServer:
             (r"^/v1/node/(?P<node_id>[^/]+)/heartbeat$", self._node_heartbeat),
             (r"^/v1/node/(?P<node_id>[^/]+)/status$", self._node_status),
             (r"^/v1/node/(?P<node_id>[^/]+)/allocs$", self._node_update_allocs),
+            (r"^/v1/node/(?P<node_id>[^/]+)/derive-vault$", self._node_derive_vault),
+            (r"^/v1/vault/renew$", self._vault_renew),
             (r"^/v1/allocations$", self._allocations),
             (r"^/v1/allocation/(?P<alloc_id>[^/]+)$", self._allocation),
             (r"^/v1/evaluations$", self._evaluations),
@@ -344,6 +346,20 @@ class HTTPServer:
         allocs = [from_dict(Allocation, a) for a in body["allocs"]]
         index = self.server.node_update_allocs(allocs)
         return {"index": index}
+
+    def _node_derive_vault(self, method, query, body, node_id):
+        """Node.DeriveVaultToken (node_endpoint.go:940)."""
+        tokens, ttl = self.server.derive_vault_token(
+            node_id,
+            (body or {}).get("secret_id", ""),
+            (body or {}).get("alloc_id", ""),
+            (body or {}).get("tasks", []),
+        )
+        return {"tasks": tokens, "ttl": ttl}
+
+    def _vault_renew(self, method, query, body):
+        ttl = self.server.vault_renew((body or {}).get("token", ""))
+        return {"ttl": ttl}
 
     # ----------------------------------------------------- allocs/evals
 

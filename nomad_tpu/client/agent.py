@@ -37,6 +37,7 @@ class ClientAgent:
         if not config.servers:
             raise ValueError("no servers configured")
         self.api = APIClient(config.servers[0], timeout=330.0)
+        self.vault_client = None
 
         if not config.alloc_dir:
             config.alloc_dir = tempfile.mkdtemp(prefix="nomad_tpu_allocs_")
@@ -96,6 +97,13 @@ class ClientAgent:
     def start(self) -> None:
         self.heartbeat_ttl = self.api.nodes.register(self.node)
         self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
+        # Vault tokens are derived through the server once the node has
+        # an identity (client/vaultclient wiring, client.go:166).
+        from .vaultclient import VaultClient
+
+        self.vault_client = VaultClient(
+            self.api, self.node.id, self.node.secret_id
+        )
         for target, name in (
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "alloc-watch"),
@@ -108,6 +116,8 @@ class ClientAgent:
 
     def shutdown(self, destroy_allocs: bool = False) -> None:
         self._stop.set()
+        if self.vault_client is not None:
+            self.vault_client.stop()
         for t in self._threads:
             t.join(timeout=3.0)
         if destroy_allocs:
@@ -184,6 +194,7 @@ class ClientAgent:
                     restored_handles=self._restored_handles.pop(alloc.id, None),
                     persist_cb=self._save_state,
                     template_kv=self._template_kv,
+                    vault_client=self.vault_client,
                 )
                 self.alloc_runners[alloc.id] = runner
                 runner.run()

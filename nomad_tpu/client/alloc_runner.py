@@ -28,6 +28,7 @@ class AllocRunner:
         restored_handles: Optional[Dict[str, str]] = None,
         persist_cb: Optional[Callable[[], None]] = None,
         template_kv=None,
+        vault_client=None,
     ):
         self.alloc = alloc
         self.sync_cb = sync_cb
@@ -43,6 +44,7 @@ class AllocRunner:
         self.restored_handles = restored_handles or {}
         self.persist_cb = persist_cb
         self.template_kv = template_kv
+        self.vault_client = vault_client
         self._lock = threading.Lock()
         self._destroyed = False
 
@@ -66,6 +68,7 @@ class AllocRunner:
                 restore_handle_id=self.restored_handles.get(task.name, ""),
                 persist_cb=self.persist_cb,
                 template_kv=self.template_kv,
+                vault_client=self.vault_client,
             )
             self.task_runners[task.name] = runner
             runner.start()

@@ -41,6 +41,7 @@ class TaskRunner:
         restore_handle_id: str = "",
         persist_cb: Optional[Callable[[], None]] = None,
         template_kv: Optional[Callable[[str], Optional[str]]] = None,
+        vault_client=None,
     ):
         self.alloc = alloc
         self.task = task
@@ -68,6 +69,10 @@ class TaskRunner:
         self.persist_cb = persist_cb
         # KV lookup for {{ key "..." }} templates (service registry).
         self.template_kv = template_kv
+        # Vault token manager (client/vaultclient); None when the task
+        # has no vault block or the client runs without vault.
+        self.vault_client = vault_client
+        self._vault_token = ""
         self._kill = threading.Event()
         self._destroy_event: Optional[TaskEvent] = None
         self._thread: Optional[threading.Thread] = None
@@ -161,6 +166,15 @@ class TaskRunner:
                 # but the template watcher lives in ours: restart it so
                 # change_mode keeps working across client restarts.
                 self._start_templates(ctx, fail_fast=False)
+                # The old process's renewal heap died with it — derive a
+                # fresh token (rewrites secrets/vault_token) and renew
+                # that, or the running task's token expires at TTL.
+                # Fail-soft: the task is already running.
+                vault_err = self._derive_vault_token(ctx)
+                if vault_err is not None:
+                    self.logger.warning(
+                        "vault re-derive after reattach failed: %s", vault_err
+                    )
             else:
                 prestart_err = self._prestart(ctx)
                 if prestart_err is not None:
@@ -280,6 +294,10 @@ class TaskRunner:
                     self._emit(consts.TASK_STATE_PENDING, ev)
                     return f"artifact download failed: {e}"
 
+        vault_err = self._derive_vault_token(ctx)
+        if vault_err is not None:
+            return vault_err
+
         return self._start_templates(ctx, fail_fast=True)
 
     def _start_templates(self, ctx, fail_fast: bool) -> Optional[str]:
@@ -341,6 +359,53 @@ class TaskRunner:
         if self._template_manager is not None:
             self._template_manager.stop()
             self._template_manager = None
+        self._stop_vault_renewal()
+
+    # -------------------------------------------------------------- vault
+
+    def _derive_vault_token(self, ctx) -> Optional[str]:
+        """Fetch this task's vault token through the server, write it to
+        secrets/vault_token, export VAULT_TOKEN, and keep it renewed
+        (task_runner.go prestart vault wait + consul_template vault
+        plumbing). Returns an error string on failure."""
+        vault = self.task.vault
+        if vault is None or self.vault_client is None:
+            return None
+        # A restart loop must not leave the previous token renewing
+        # forever: drop it before deriving a fresh one.
+        self._stop_vault_renewal()
+        try:
+            tokens, ttl = self.vault_client.derive_token(
+                self.alloc.id, [self.task.name]
+            )
+            token = tokens[self.task.name]
+        except Exception as e:  # noqa: BLE001 — API/permission errors
+            return f"vault token derivation failed: {e}"
+        self._vault_token = token
+        secrets_dir = os.path.join(ctx.task_root or ctx.task_dir, TASK_SECRETS)
+        os.makedirs(secrets_dir, exist_ok=True)
+        token_path = os.path.join(secrets_dir, "vault_token")
+        with open(token_path, "w") as f:
+            f.write(token)
+        os.chmod(token_path, 0o600)
+        if vault.env:
+            ctx.env["VAULT_TOKEN"] = token
+
+        def on_renew_fail(err: str) -> None:
+            # Renewal failure applies the vault change_mode
+            # (structs Vault.ChangeMode) like a template change would.
+            if vault.change_mode == "restart":
+                self._on_template_change("restart", "")
+            elif vault.change_mode == "signal":
+                self._on_template_change("signal", vault.change_signal)
+
+        self.vault_client.renew_token(token, ttl, on_renew_fail)
+        return None
+
+    def _stop_vault_renewal(self) -> None:
+        if self.vault_client is not None and self._vault_token:
+            self.vault_client.stop_renew_token(self._vault_token)
+            self._vault_token = ""
 
     def _finish_killed(self) -> None:
         """Reap the handle (if any) and emit the terminal killed state —

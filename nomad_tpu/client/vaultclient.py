@@ -1,0 +1,138 @@
+"""Client-side vault token manager.
+
+Reference: client/vaultclient/vaultclient.go:717 — tokens are derived
+*through the server* (Node.DeriveVaultToken, nomad/node_endpoint.go:940)
+so clients never hold vault credentials of their own, and a renewal
+heap keeps derived tokens alive at half-TTL cadence. Renewal failure is
+reported to the task runner, which applies the task's vault
+change_mode (restart/signal/noop).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class VaultClient:
+    """Derives tokens via the server API and renews them until stopped."""
+
+    def __init__(self, api, node_id: str, secret_id: str = ""):
+        self.api = api
+        self.node_id = node_id
+        self.secret_id = secret_id
+        self.logger = logging.getLogger("nomad_tpu.client.vault")
+        self._lock = threading.Lock()
+        # (next_renew_monotonic, seq, token, lease_expiry, on_fail)
+        self._heap: list = []
+        self._seq = 0
+        self._stopped_tokens: set = set()
+        self._stop = threading.Event()
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ derive
+
+    def derive_token(
+        self, alloc_id: str, tasks: List[str]
+    ) -> Tuple[Dict[str, str], float]:
+        """One server round-trip for all of an alloc's vault tasks.
+        Returns ({task: token}, ttl_seconds)."""
+        out, _ = self.api.put(
+            f"/v1/node/{self.node_id}/derive-vault",
+            {
+                "secret_id": self.secret_id,
+                "alloc_id": alloc_id,
+                "tasks": tasks,
+            },
+        )
+        return out["tasks"], float(out.get("ttl", 3600.0))
+
+    # ----------------------------------------------------------- renewal
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._renew_loop, name="vault-renew", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._wake:
+            self._wake.notify()
+
+    def renew_token(
+        self, token: str, ttl: float, on_fail: Optional[Callable[[str], None]] = None
+    ) -> None:
+        """Schedule periodic renewal at half-TTL (vaultclient.go renewal
+        heap)."""
+        with self._wake:
+            self._stopped_tokens.discard(token)
+            self._seq += 1
+            heapq.heappush(
+                self._heap,
+                (time.monotonic() + ttl / 2.0, self._seq, token,
+                 time.monotonic() + ttl, on_fail or (lambda e: None)),
+            )
+            self._wake.notify()
+        self.start()
+
+    def stop_renew_token(self, token: str) -> None:
+        with self._wake:
+            self._stopped_tokens.add(token)
+
+    RETRY_INTERVAL = 15.0
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._wake:
+                while not self._heap and not self._stop.is_set():
+                    self._wake.wait(1.0)
+                if self._stop.is_set():
+                    return
+                due, seq, token, expiry, on_fail = self._heap[0]
+                now = time.monotonic()
+                if due > now:
+                    self._wake.wait(min(due - now, 1.0))
+                    continue
+                heapq.heappop(self._heap)
+                if token in self._stopped_tokens:
+                    self._stopped_tokens.discard(token)
+                    continue
+            try:
+                out, _ = self.api.put("/v1/vault/renew", {"token": token})
+                ttl = float(out["ttl"])
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                if time.monotonic() < expiry:
+                    # Transient failure with lease time left: retry
+                    # until the lease actually runs out (vaultclient.go
+                    # renews with backoff; one blip must not restart a
+                    # healthy task).
+                    self.logger.warning(
+                        "vault renewal failed, will retry: %s", e
+                    )
+                    with self._wake:
+                        self._seq += 1
+                        heapq.heappush(
+                            self._heap,
+                            (time.monotonic() + self.RETRY_INTERVAL,
+                             self._seq, token, expiry, on_fail),
+                        )
+                    continue
+                self.logger.warning("vault token lease expired: %s", e)
+                try:
+                    on_fail(str(e))
+                except Exception:  # noqa: BLE001
+                    self.logger.exception("vault renewal failure handler")
+                continue
+            with self._wake:
+                self._seq += 1
+                heapq.heappush(
+                    self._heap,
+                    (time.monotonic() + ttl / 2.0, self._seq, token,
+                     time.monotonic() + ttl, on_fail),
+                )

@@ -55,5 +55,11 @@ class ServerConfig:
     # Blocked-evals failed-eval unblock cadence (leader.go:441).
     failed_eval_unblock_interval: float = 60.0
 
+    # Vault token authority (nomad/vault.go; stub provider in-process).
+    vault_enabled: bool = True
+    vault_token_ttl: float = 3600.0
+    # None = any policy except root; else an allowlist.
+    vault_allowed_policies: Optional[List[str]] = None
+
     def factory_for(self, eval_type: str) -> str:
         return self.scheduler_factories.get(eval_type, eval_type)

@@ -31,6 +31,8 @@ ALLOC_UPDATE = "alloc_update"
 ALLOC_CLIENT_UPDATE = "alloc_client_update"
 PERIODIC_LAUNCH = "periodic_launch"
 PERIODIC_LAUNCH_DELETE = "periodic_launch_delete"
+VAULT_ACCESSOR_REGISTER = "vault_accessor_register"
+VAULT_ACCESSOR_DEREGISTER = "vault_accessor_deregister"
 
 
 class FSM:
@@ -59,6 +61,8 @@ class FSM:
             ALLOC_CLIENT_UPDATE: self._apply_alloc_client_update,
             PERIODIC_LAUNCH: self._apply_periodic_launch,
             PERIODIC_LAUNCH_DELETE: self._apply_periodic_launch_delete,
+            VAULT_ACCESSOR_REGISTER: self._apply_vault_accessor_register,
+            VAULT_ACCESSOR_DEREGISTER: self._apply_vault_accessor_deregister,
         }
 
     def apply(self, index: int, msg_type: str, payload: dict) -> object:
@@ -104,6 +108,18 @@ class FSM:
 
     def _apply_node_drain(self, index: int, payload: dict):
         self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+        return None
+
+    # ------------------------------------------------------------ vault
+
+    def _apply_vault_accessor_register(self, index: int, payload: dict):
+        """fsm.go applyUpsertVaultAccessor."""
+        self.state.upsert_vault_accessors(index, payload["accessors"])
+        return None
+
+    def _apply_vault_accessor_deregister(self, index: int, payload: dict):
+        """fsm.go applyDeregisterVaultAccessor."""
+        self.state.delete_vault_accessors(index, payload["accessors"])
         return None
 
     # ------------------------------------------------------------- jobs

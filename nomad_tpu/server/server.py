@@ -75,6 +75,17 @@ class Server:
         self.serf = None
         self.peers: Dict[str, Dict[str, object]] = {}
         self._peers_lock = threading.Lock()
+        # Vault token authority (vault.go; stub provider by default so
+        # the derive→renew→revoke lifecycle works without an external
+        # service — swap in a real provider via set_vault_provider).
+        self.vault = None
+        if self.config.vault_enabled:
+            from .vault import StubVault
+
+            self.vault = StubVault(
+                ttl=self.config.vault_token_ttl,
+                allowed_policies=self.config.vault_allowed_policies,
+            )
 
         self._register_core_scheduler()
 
@@ -330,6 +341,29 @@ class Server:
         errors = job.validate()
         if errors:
             raise ValueError("; ".join(errors))
+        # Vault policy check at submit time (job_endpoint.go:84-120):
+        # reject jobs asking for policies the authority won't grant, so
+        # the failure surfaces at register instead of at task prestart.
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                if task.vault is None:
+                    continue
+                if self.vault is None:
+                    raise ValueError(
+                        f"task {task.name!r} has a vault block but vault "
+                        "is not enabled"
+                    )
+                if not task.vault.policies:
+                    raise ValueError(
+                        f"task {task.name!r} vault block needs policies"
+                    )
+                if "root" in task.vault.policies:
+                    raise ValueError("root policy is not allowed for tasks")
+                allowed = getattr(self.vault, "allowed_policies", None)
+                if allowed is not None:
+                    bad = [p for p in task.vault.policies if p not in allowed]
+                    if bad:
+                        raise ValueError(f"vault policies not allowed: {bad}")
         # The enforce-index gate is decided inside the FSM apply (same
         # log position -> same verdict on every replica), which makes
         # check+commit atomic even when this server is a raft follower
@@ -509,6 +543,90 @@ class Server:
         if drain:
             self._create_node_evals(node_id)
 
+    def derive_vault_token(
+        self, node_id: str, secret_id: str, alloc_id: str, tasks: List[str]
+    ) -> Tuple[Dict[str, str], float]:
+        """Per-task vault token derivation (node_endpoint.go:940
+        DeriveVaultToken): validate node secret + alloc placement + that
+        each task declares a vault block, mint tokens, then commit the
+        accessors through the log before handing tokens out. Returns
+        ({task: token}, min ttl across minted tokens)."""
+        from .vault import VaultAccessor, VaultError
+
+        if self.vault is None:
+            raise ValueError("vault is not enabled on this server")
+        state = self.fsm.state
+        node = state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"node {node_id!r} not found")
+        # A node with a secret always requires it — an empty caller
+        # secret must NOT bypass authentication (minting tokens is the
+        # most sensitive endpoint on the server).
+        if node.secret_id and node.secret_id != secret_id:
+            raise PermissionError("node secret ID does not match")
+        alloc = state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise ValueError(f"alloc {alloc_id!r} not found")
+        if alloc.node_id != node_id:
+            raise PermissionError("allocation not placed on requesting node")
+        if alloc.terminal_status():
+            raise ValueError("cannot derive tokens for terminal allocation")
+        group = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+        by_name = {t.name: t for t in (group.tasks if group else [])}
+        tokens: Dict[str, str] = {}
+        accessors: List[VaultAccessor] = []
+        min_ttl = float("inf")
+        for task_name in tasks:
+            task = by_name.get(task_name)
+            if task is None or task.vault is None:
+                self.vault.revoke_tokens([a.accessor for a in accessors])
+                raise ValueError(
+                    f"task {task_name!r} does not declare a vault block"
+                )
+            try:
+                token, accessor, ttl = self.vault.create_token(task.vault.policies)
+            except VaultError as e:
+                # Revoke tokens already minted this request — a partial
+                # failure must not leave live untracked credentials.
+                self.vault.revoke_tokens([a.accessor for a in accessors])
+                raise ValueError(str(e)) from e
+            min_ttl = min(min_ttl, ttl)
+            tokens[task_name] = token
+            accessors.append(
+                VaultAccessor(
+                    accessor=accessor, alloc_id=alloc_id,
+                    task=task_name, node_id=node_id,
+                    policies=list(task.vault.policies),
+                )
+            )
+        # Accessors are committed before tokens are returned, so a
+        # crash can't leak untracked (unrevokable) tokens.
+        self.log.apply(
+            fsm_msgs.VAULT_ACCESSOR_REGISTER, {"accessors": accessors}
+        )
+        return tokens, (min_ttl if tokens else 0.0)
+
+    def vault_renew(self, token: str) -> float:
+        from .vault import VaultError
+
+        if self.vault is None:
+            raise ValueError("vault is not enabled on this server")
+        try:
+            return self.vault.renew_token(token)
+        except VaultError as e:
+            raise ValueError(str(e)) from e
+
+    def revoke_vault_accessors(self, accessors: List[str]) -> None:
+        """Revoke at the authority, then drop the tracking rows
+        (vault.go RevokeTokens + fsm deregister)."""
+        if not accessors:
+            return
+        if self.vault is not None:
+            self.vault.revoke_tokens(accessors)
+        self.log.apply(
+            fsm_msgs.VAULT_ACCESSOR_DEREGISTER, {"accessors": accessors}
+        )
+
     def node_update_allocs(self, allocs: List[Allocation]) -> int:
         """Node.UpdateAlloc: client-reported status sync
         (node_endpoint.go:664)."""
@@ -601,6 +719,14 @@ class Server:
         return leader.broker.outstanding(eval_id) if leader is not None else None
 
     def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
+        # Reaped allocs take their derived vault tokens with them
+        # (core_sched GC → vault.go RevokeTokens → accessor dereg).
+        accessors = [
+            a.accessor
+            for alloc_id in alloc_ids
+            for a in self.fsm.state.vault_accessors_by_alloc(alloc_id)
+        ]
+        self.revoke_vault_accessors(accessors)
         return self.log.apply(
             fsm_msgs.EVAL_DELETE, {"eval_ids": eval_ids, "alloc_ids": alloc_ids}
         )

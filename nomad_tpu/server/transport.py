@@ -200,4 +200,11 @@ def fsm_payload_decoder(msg_type: str, payload: Any) -> Any:
             out["allocs"] = [from_dict(Allocation, a) for a in out["allocs"]]
         if out.get("job"):
             out["job"] = from_dict(Job, out["job"])
+    elif msg_type == m.VAULT_ACCESSOR_REGISTER and out.get("accessors"):
+        from .vault import VaultAccessor
+
+        out["accessors"] = [
+            a if isinstance(a, VaultAccessor) else from_dict(VaultAccessor, a)
+            for a in out["accessors"]
+        ]
     return out

@@ -1,0 +1,114 @@
+"""Vault integration: per-task token derivation, renewal, revocation.
+
+Reference: nomad/vault.go (vaultClient: CreateToken, RenewToken,
+RevokeTokens, accessor tracking, 844 LoC) and the derive entrypoint
+Node.DeriveVaultToken (nomad/node_endpoint.go:940). The reference talks
+to a real HashiCorp Vault; here the provider is pluggable with an
+in-process stub (token store with TTLs) so the full derive → use →
+renew → revoke lifecycle runs without an external service. A real
+backend would implement the same three-method surface over Vault's
+HTTP API.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.alloc import VaultAccessor  # noqa: F401 — re-export
+from ..utils.ids import generate_uuid
+
+
+class VaultError(Exception):
+    pass
+
+
+class VaultProvider:
+    """Provider surface the server needs (vault.go CreateToken:~,
+    RenewToken, RevokeTokens)."""
+
+    def create_token(self, policies: List[str]) -> Tuple[str, str, float]:
+        """Returns (token, accessor, ttl_seconds)."""
+        raise NotImplementedError
+
+    def renew_token(self, token: str) -> float:
+        """Extends the token lease; returns the new ttl."""
+        raise NotImplementedError
+
+    def revoke_tokens(self, accessors: List[str]) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class _StubToken:
+    token: str
+    accessor: str
+    policies: List[str]
+    expires: float
+
+
+class StubVault(VaultProvider):
+    """In-memory token authority with TTLs.
+
+    Lookup-by-token works too so tests (and the dev agent) can assert a
+    derived token is live, carries the requested policies, and dies on
+    revocation/expiry.
+    """
+
+    def __init__(self, ttl: float = 3600.0, allowed_policies: Optional[List[str]] = None):
+        self.ttl = ttl
+        # None = allow any policy except root (the reference always
+        # rejects root, job_endpoint.go vault checks).
+        self.allowed_policies = allowed_policies
+        self._lock = threading.Lock()
+        self._by_token: Dict[str, _StubToken] = {}
+        self._by_accessor: Dict[str, _StubToken] = {}
+        self.logger = logging.getLogger("nomad_tpu.vault.stub")
+
+    def create_token(self, policies: List[str]) -> Tuple[str, str, float]:
+        if "root" in policies:
+            raise VaultError("root policy cannot be derived for tasks")
+        if self.allowed_policies is not None:
+            bad = [p for p in policies if p not in self.allowed_policies]
+            if bad:
+                raise VaultError(f"policies not allowed: {bad}")
+        tok = _StubToken(
+            token=f"s.{generate_uuid()}",
+            accessor=generate_uuid(),
+            policies=list(policies),
+            expires=time.monotonic() + self.ttl,
+        )
+        with self._lock:
+            self._by_token[tok.token] = tok
+            self._by_accessor[tok.accessor] = tok
+        return tok.token, tok.accessor, self.ttl
+
+    def renew_token(self, token: str) -> float:
+        with self._lock:
+            tok = self._by_token.get(token)
+            if tok is None:
+                raise VaultError("unknown token")
+            if tok.expires < time.monotonic():
+                raise VaultError("token expired")
+            tok.expires = time.monotonic() + self.ttl
+        return self.ttl
+
+    def revoke_tokens(self, accessors: List[str]) -> None:
+        with self._lock:
+            for acc in accessors:
+                tok = self._by_accessor.pop(acc, None)
+                if tok is not None:
+                    self._by_token.pop(tok.token, None)
+
+    # ------------------------------------------------------ test hooks
+
+    def lookup(self, token: str) -> Optional[List[str]]:
+        """Policies of a live token, None if revoked/expired/unknown."""
+        with self._lock:
+            tok = self._by_token.get(token)
+            if tok is None or tok.expires < time.monotonic():
+                return None
+            return list(tok.policies)

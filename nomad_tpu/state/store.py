@@ -103,6 +103,7 @@ TABLES = (
     "periodic_launch",
     "evals",
     "allocs",
+    "vault_accessors",
 )
 
 
@@ -184,6 +185,15 @@ class StateSnapshot:
             a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal
         ]
 
+    def vault_accessors(self) -> List[object]:
+        return list(self._t["vault_accessors"].values())
+
+    def vault_accessors_by_alloc(self, alloc_id: str) -> List[object]:
+        return [
+            a for a in self._t["vault_accessors"].values()
+            if a.alloc_id == alloc_id
+        ]
+
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
         ids = self._i["allocs_by_eval"].get(eval_id, ())
         return [self._t["allocs"][i] for i in ids]
@@ -255,6 +265,8 @@ class StateStore:
             "allocs_by_node",
             "allocs_by_node_terminal",
             "allocs_by_eval",
+            "vault_accessors",
+            "vault_accessors_by_alloc",
         )
         if name in snap_methods:
             return getattr(self.snapshot(), name)
@@ -382,6 +394,27 @@ class StateStore:
             table = self._tables["periodic_launch"].for_write()
             table.pop(job_id, None)
             self._bump(index, "periodic_launch")
+        self.notify.notify(items)
+
+    def upsert_vault_accessors(self, index: int, accessors) -> None:
+        """Track derived vault tokens (state_store.go vault_accessors
+        table; schema.go:18-40)."""
+        items = [watch.table("vault_accessors")]
+        with self._lock:
+            table = self._tables["vault_accessors"].for_write()
+            for acc in accessors:
+                acc.create_index = index
+                table[acc.accessor] = acc
+            self._bump(index, "vault_accessors")
+        self.notify.notify(items)
+
+    def delete_vault_accessors(self, index: int, accessors: List[str]) -> None:
+        items = [watch.table("vault_accessors")]
+        with self._lock:
+            table = self._tables["vault_accessors"].for_write()
+            for acc in accessors:
+                table.pop(acc, None)
+            self._bump(index, "vault_accessors")
         self.notify.notify(items)
 
     def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
@@ -640,6 +673,10 @@ class StateStore:
                 ],
                 "evals": [to_dict(e) for e in self._tables["evals"].data.values()],
                 "allocs": [to_dict(a) for a in self._tables["allocs"].data.values()],
+                "vault_accessors": [
+                    to_dict(v)
+                    for v in self._tables["vault_accessors"].data.values()
+                ],
                 "table_indexes": dict(self._table_indexes),
                 "latest_index": self._latest_index,
             }
@@ -672,6 +709,11 @@ class StateStore:
                 store._indexes["allocs_by_job"].add(a.job_id, a.id)
                 store._indexes["allocs_by_node"].add(a.node_id, a.id)
                 store._indexes["allocs_by_eval"].add(a.eval_id, a.id)
+            from ..structs.alloc import VaultAccessor
+
+            for raw in data.get("vault_accessors", []):
+                v = from_dict(VaultAccessor, raw)
+                store._tables["vault_accessors"].data[v.accessor] = v
             store._table_indexes = dict(data.get("table_indexes", {}))
             store._latest_index = data.get("latest_index", 0)
         return store

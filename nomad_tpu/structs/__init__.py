@@ -1,6 +1,7 @@
 """Data model for nomad_tpu (reference: nomad/structs/)."""
 
 from . import consts
+from .alloc import VaultAccessor
 from .alloc import (
     AllocMetric,
     Allocation,

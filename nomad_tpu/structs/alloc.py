@@ -183,3 +183,16 @@ def filter_terminal_allocs(allocs: List[Allocation]):
 
 def new_task_event(event_type: str) -> TaskEvent:
     return TaskEvent(type=event_type, time=time.time())
+
+
+@dataclass
+class VaultAccessor:
+    """Tracking record for one derived vault token (reference
+    structs.VaultAccessor, persisted in the vault_accessors table)."""
+
+    accessor: str = ""
+    alloc_id: str = ""
+    task: str = ""
+    node_id: str = ""
+    policies: List[str] = field(default_factory=list)
+    create_index: float = 0.0
