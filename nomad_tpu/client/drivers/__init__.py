@@ -9,6 +9,9 @@ from .base import Driver, DriverHandle, TaskContext, DRIVER_REGISTRY, new_driver
 from .mock import MockDriver
 from .raw_exec import RawExecDriver
 from .exec_driver import ExecDriver
+from .docker import DockerDriver
+from .java import JavaDriver
+from .qemu import QemuDriver
 
 __all__ = [
     "Driver",
@@ -19,4 +22,7 @@ __all__ = [
     "MockDriver",
     "RawExecDriver",
     "ExecDriver",
+    "DockerDriver",
+    "JavaDriver",
+    "QemuDriver",
 ]

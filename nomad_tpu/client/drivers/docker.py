@@ -1,0 +1,217 @@
+"""docker driver: run tasks as containers.
+
+Reference: client/driver/docker.go (1156 LoC) — fingerprint probes the
+docker endpoint and advertises `driver.docker` + `driver.docker.version`
+(docker.go:324-360); Start pulls the image if missing, creates a
+container with cpu shares / memory limits, binds the alloc and task
+dirs, maps ports, then starts it; the handle survives client restarts
+by container id (docker.go Open). Kill = stop with a grace period.
+
+TPU-native stance: the container runtime stays an external supervisor
+(like the reference's dockerd); we drive it through the `docker` CLI so
+the driver is a thin, restart-safe shim. The binary is resolved at
+fingerprint time and the driver is absent when docker is not installed
+or not responding, exactly like the reference's endpoint probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import time
+from typing import List, Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
+
+
+def _docker_bin() -> Optional[str]:
+    return shutil.which(os.environ.get("NOMAD_DOCKER_BIN", "docker"))
+
+
+def _run(args: List[str], timeout: float = 60.0) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        args, capture_output=True, text=True, timeout=timeout
+    )
+
+
+class DockerHandle(DriverHandle):
+    """Handle keyed by container id — reattachable across restarts."""
+
+    def __init__(self, docker: str, container_id: str, task_name: str):
+        self.docker = docker
+        self.container_id = container_id
+        self.task_name = task_name
+        self._result: Optional[WaitResult] = None
+        self._done = threading.Event()
+        self._waiter = threading.Thread(target=self._wait_container, daemon=True)
+        self._waiter.start()
+
+    def _wait_container(self) -> None:
+        # `docker wait` blocks until the container exits and prints the
+        # exit code — the same long-poll the reference does over the API.
+        try:
+            proc = subprocess.run(
+                [self.docker, "wait", self.container_id],
+                capture_output=True, text=True,
+            )
+            if proc.returncode == 0:
+                self._result = WaitResult(exit_code=int(proc.stdout.strip()))
+            else:
+                self._result = WaitResult(
+                    exit_code=-1, error=proc.stderr.strip() or "docker wait failed"
+                )
+        except (OSError, ValueError) as e:
+            self._result = WaitResult(exit_code=-1, error=str(e))
+        # Reap the exited container: every (re)start creates a uniquely
+        # named one, so without this a crash-looping task leaks a dead
+        # container per restart.
+        try:
+            _run([self.docker, "rm", self.container_id], timeout=30.0)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        self._done.set()
+
+    def id(self) -> str:
+        return f"docker:{self.container_id}:{self.task_name}"
+
+    def pid(self) -> Optional[int]:
+        try:
+            proc = _run([self.docker, "inspect", "-f", "{{.State.Pid}}",
+                         self.container_id], timeout=10.0)
+            if proc.returncode == 0:
+                pid = int(proc.stdout.strip())
+                return pid or None
+        except (OSError, ValueError, subprocess.TimeoutExpired):
+            pass
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def signal(self, signum: int) -> None:
+        try:
+            _run([self.docker, "kill", "--signal", str(signum),
+                  self.container_id], timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        # docker stop = SIGTERM, grace period, then SIGKILL — the same
+        # ladder the reference configures (docker.go Kill).
+        try:
+            _run([self.docker, "stop", "-t", str(int(max(1, kill_timeout))),
+                  self.container_id], timeout=kill_timeout + 30.0)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        self._done.wait(5.0)
+        try:
+            _run([self.docker, "rm", "-f", self.container_id], timeout=30.0)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+
+@register_driver
+class DockerDriver(Driver):
+    name = "docker"
+
+    def fingerprint(self, node: Node) -> bool:
+        docker = _docker_bin()
+        if not docker:
+            node.attributes.pop("driver.docker", None)
+            return False
+        try:
+            proc = _run([docker, "version", "--format", "{{.Server.Version}}"],
+                        timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            proc = None
+        if proc is None or proc.returncode != 0:
+            node.attributes.pop("driver.docker", None)
+            return False
+        node.attributes["driver.docker"] = "1"
+        node.attributes["driver.docker.version"] = proc.stdout.strip()
+        return True
+
+    def validate_config(self, task: Task) -> None:
+        if not (task.config or {}).get("image"):
+            raise ValueError(f"docker task {task.name!r} missing 'image'")
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        docker = _docker_bin()
+        if not docker:
+            raise RuntimeError("docker binary not found")
+        cfg = task.config or {}
+        image = cfg.get("image")
+        if not image:
+            raise ValueError(f"docker task {task.name!r} missing 'image'")
+
+        args = [docker, "run", "-d",
+                "--name", f"nomad-{ctx.alloc_id[:8]}-{task.name}-{int(time.time())}"]
+        # Resource limits (docker.go createContainer): MHz→shares, MB→bytes.
+        if task.resources is not None:
+            if task.resources.cpu:
+                args += ["--cpu-shares", str(task.resources.cpu)]
+            if task.resources.memory_mb:
+                args += ["--memory", f"{task.resources.memory_mb}m"]
+        # Bind the shared alloc dir and task local dir at the same
+        # in-container paths the reference uses (docker.go:27-33).
+        if ctx.alloc_dir:
+            args += ["-v", f"{os.path.abspath(ctx.alloc_dir)}:/alloc"]
+        if ctx.task_dir:
+            args += ["-v", f"{os.path.abspath(ctx.task_dir)}:/local"]
+        if ctx.task_root:
+            # secrets/ carries vault_token and rendered credentials; the
+            # reference binds it alongside alloc and local (docker.go:27-33).
+            secrets = os.path.join(os.path.abspath(ctx.task_root), "secrets")
+            os.makedirs(secrets, exist_ok=True)
+            args += ["-v", f"{secrets}:/secrets"]
+        for key, val in ctx.env.items():
+            args += ["-e", f"{key}={val}"]
+        # Static port publishing from the first allocated network.
+        for label_port in cfg.get("port_map", []) or []:
+            args += ["-p", str(label_port)]
+        if cfg.get("network_mode"):
+            args += ["--network", str(cfg["network_mode"])]
+        if cfg.get("work_dir"):
+            args += ["-w", str(cfg["work_dir"])]
+        if cfg.get("privileged"):
+            args += ["--privileged"]
+        args.append(image)
+        if cfg.get("command"):
+            args.append(str(cfg["command"]))
+        args += [str(a) for a in cfg.get("args", [])]
+
+        proc = _run(args, timeout=300.0)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"docker run failed: {proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        container_id = proc.stdout.strip().splitlines()[-1]
+        return DockerHandle(docker, container_id, task.name)
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        if not handle_id.startswith("docker:"):
+            return None
+        _, container_id, task_name = handle_id.split(":", 2)
+        docker = _docker_bin()
+        if not docker:
+            return None
+        try:
+            proc = _run([docker, "inspect", "-f", "{{json .State.Running}}",
+                         container_id], timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        try:
+            running = json.loads(proc.stdout.strip())
+        except ValueError:
+            return None
+        if not running:
+            return None
+        return DockerHandle(docker, container_id, task_name)

@@ -1,0 +1,82 @@
+"""java driver: run a jar under the JVM via the out-of-process executor.
+
+Reference: client/driver/java.go:423 — fingerprint shells `java
+-version` and parses the version/runtime from stderr (java.go:71-120);
+Start builds `java [jvm_options...] -jar <jar> [args...]` and hands it
+to the executor, which applies the same isolation as exec
+(java.go:160-220). Config keys: jar_path, jvm_options, args.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+from dataclasses import replace
+from typing import Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, register_driver
+
+
+def _java_version(java: str) -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            [java, "-version"], capture_output=True, text=True, timeout=10.0
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    # `java -version` prints to stderr: java/openjdk version "11.0.x"
+    out = proc.stderr or proc.stdout
+    m = re.search(r'version "([^"]+)"', out)
+    if m:
+        return m.group(1)
+    return None if proc.returncode != 0 else "unknown"
+
+
+@register_driver
+class JavaDriver(Driver):
+    name = "java"
+
+    def fingerprint(self, node: Node) -> bool:
+        java = shutil.which("java")
+        version = _java_version(java) if java else None
+        if version is None:
+            node.attributes.pop("driver.java", None)
+            return False
+        node.attributes["driver.java"] = "1"
+        node.attributes["driver.java.version"] = version
+        return True
+
+    def validate_config(self, task: Task) -> None:
+        if not (task.config or {}).get("jar_path"):
+            raise ValueError(f"java task {task.name!r} missing 'jar_path'")
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        from ..executor import launch_executor
+
+        java = shutil.which("java")
+        if not java:
+            raise RuntimeError("java binary not found")
+        cfg = task.config or {}
+        jar = cfg.get("jar_path")
+        if not jar:
+            raise ValueError(f"java task {task.name!r} missing 'jar_path'")
+        if not os.path.isabs(jar):
+            jar = os.path.join(ctx.task_root or ctx.task_dir, jar)
+        argv = [str(o) for o in cfg.get("jvm_options", [])]
+        argv += ["-jar", jar]
+        argv += [str(a) for a in cfg.get("args", [])]
+        # Rewrite the task config into an exec-shaped command for the
+        # shared executor path (java.go delegates to the same executor).
+        exec_task = replace(task, config={"command": java, "args": argv})
+        mem_bytes = None
+        if task.resources is not None and task.resources.memory_mb:
+            mem_bytes = task.resources.memory_mb * 1024 * 1024
+        return launch_executor(ctx, exec_task, rlimit_as=mem_bytes)
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        from ..executor import reattach_executor
+
+        return reattach_executor(handle_id)

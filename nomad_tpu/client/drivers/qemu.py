@@ -1,0 +1,92 @@
+"""qemu driver: run a VM image under the out-of-process executor.
+
+Reference: client/driver/qemu.go:418 — fingerprint shells
+`qemu-system-x86_64 --version` (qemu.go:77-100); Start builds the qemu
+command line with -m (memory MB), -smp, the image path, optional KVM
+accelerator, and user-net port forwards from port_map (qemu.go:120-230),
+then runs it under the executor. Config keys: image_path, accelerator,
+graceful_shutdown (ignored pre-0.5), port_map, args.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+from dataclasses import replace
+from typing import Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, register_driver
+
+QEMU_BIN = "qemu-system-x86_64"
+
+
+def _qemu_version(qemu: str) -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            [qemu, "--version"], capture_output=True, text=True, timeout=10.0
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    m = re.search(r"version ([\d.]+)", proc.stdout)
+    return m.group(1) if m else "unknown"
+
+
+@register_driver
+class QemuDriver(Driver):
+    name = "qemu"
+
+    def fingerprint(self, node: Node) -> bool:
+        qemu = shutil.which(QEMU_BIN)
+        version = _qemu_version(qemu) if qemu else None
+        if version is None:
+            node.attributes.pop("driver.qemu", None)
+            return False
+        node.attributes["driver.qemu"] = "1"
+        node.attributes["driver.qemu.version"] = version
+        return True
+
+    def validate_config(self, task: Task) -> None:
+        if not (task.config or {}).get("image_path"):
+            raise ValueError(f"qemu task {task.name!r} missing 'image_path'")
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        from ..executor import launch_executor
+
+        qemu = shutil.which(QEMU_BIN)
+        if not qemu:
+            raise RuntimeError(f"{QEMU_BIN} not found")
+        cfg = task.config or {}
+        image = cfg.get("image_path")
+        if not image:
+            raise ValueError(f"qemu task {task.name!r} missing 'image_path'")
+        if not os.path.isabs(image):
+            image = os.path.join(ctx.task_root or ctx.task_dir, image)
+
+        mem_mb = (task.resources.memory_mb if task.resources else 0) or 512
+        argv = ["-machine", "type=pc,accel=" + (cfg.get("accelerator") or "tcg"),
+                "-name", task.name,
+                "-m", f"{mem_mb}M",
+                "-drive", f"file={image}",
+                "-nographic"]
+        # User-net port forwards: guest port ← host port from the task's
+        # allocated dynamic ports (qemu.go:160-190 hostfwd construction).
+        forwards = []
+        for guest, host in (cfg.get("port_map") or {}).items():
+            forwards.append(f"hostfwd=tcp::{host}-:{guest}")
+        if forwards:
+            argv += ["-netdev", "user,id=user.0," + ",".join(forwards),
+                     "-device", "virtio-net,netdev=user.0"]
+        argv += [str(a) for a in cfg.get("args", [])]
+
+        exec_task = replace(task, config={"command": qemu, "args": argv})
+        return launch_executor(ctx, exec_task)
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        from ..executor import reattach_executor
+
+        return reattach_executor(handle_id)

@@ -166,15 +166,21 @@ class TaskRunner:
                 # but the template watcher lives in ours: restart it so
                 # change_mode keeps working across client restarts.
                 self._start_templates(ctx, fail_fast=False)
-                # The old process's renewal heap died with it — derive a
-                # fresh token (rewrites secrets/vault_token) and renew
-                # that, or the running task's token expires at TTL.
-                # Fail-soft: the task is already running.
-                vault_err = self._derive_vault_token(ctx)
-                if vault_err is not None:
-                    self.logger.warning(
-                        "vault re-derive after reattach failed: %s", vault_err
-                    )
+                # The old process's renewal heap died with it. The
+                # running task still holds the ORIGINAL token (in its
+                # environment), so recover that token from
+                # secrets/vault_token and resume renewing it — minting a
+                # fresh one would leave the live process with a token
+                # that silently expires at TTL (reference: client
+                # restore re-renews the persisted token). Fall back to
+                # deriving only if the persisted token is gone.
+                if not self._recover_vault_token(ctx):
+                    vault_err = self._derive_vault_token(ctx)
+                    if vault_err is not None:
+                        self.logger.warning(
+                            "vault re-derive after reattach failed: %s",
+                            vault_err,
+                        )
             else:
                 prestart_err = self._prestart(ctx)
                 if prestart_err is not None:
@@ -391,16 +397,51 @@ class TaskRunner:
         if vault.env:
             ctx.env["VAULT_TOKEN"] = token
 
-        def on_renew_fail(err: str) -> None:
-            # Renewal failure applies the vault change_mode
-            # (structs Vault.ChangeMode) like a template change would.
-            if vault.change_mode == "restart":
-                self._on_template_change("restart", "")
-            elif vault.change_mode == "signal":
-                self._on_template_change("signal", vault.change_signal)
-
-        self.vault_client.renew_token(token, ttl, on_renew_fail)
+        self.vault_client.renew_token(token, ttl, self._vault_on_renew_fail)
         return None
+
+    # Assumed lease for a token recovered from disk after client restart:
+    # the real TTL is unknown until the first successful renewal reports
+    # it, so renew promptly but give transient failures a grace window.
+    RECOVERED_TOKEN_TTL = 60.0
+
+    def _recover_vault_token(self, ctx) -> bool:
+        """Adopt the persisted secrets/vault_token after reattach and
+        resume its renewal. Returns False when there is nothing to
+        recover (caller may derive a fresh token)."""
+        vault = self.task.vault
+        if vault is None or self.vault_client is None:
+            return True  # nothing to do either way
+        token_path = os.path.join(
+            ctx.task_root or ctx.task_dir, TASK_SECRETS, "vault_token"
+        )
+        try:
+            with open(token_path) as f:
+                token = f.read().strip()
+        except OSError:
+            return False
+        if not token:
+            return False
+        self._stop_vault_renewal()
+        self._vault_token = token
+        if vault.env:
+            ctx.env["VAULT_TOKEN"] = token
+        self.vault_client.renew_token(
+            token, self.RECOVERED_TOKEN_TTL, self._vault_on_renew_fail,
+            renew_now=True,
+        )
+        return True
+
+    def _vault_on_renew_fail(self, err: str) -> None:
+        # Renewal failure applies the vault change_mode
+        # (structs Vault.ChangeMode) like a template change would.
+        vault = self.task.vault
+        if vault is None:
+            return
+        if vault.change_mode == "restart":
+            self._on_template_change("restart", "")
+        elif vault.change_mode == "signal":
+            self._on_template_change("signal", vault.change_signal)
 
     def _stop_vault_renewal(self) -> None:
         if self.vault_client is not None and self._vault_token:

@@ -29,7 +29,10 @@ class VaultClient:
         # (next_renew_monotonic, seq, token, lease_expiry, on_fail)
         self._heap: list = []
         self._seq = 0
+        # Tombstones only for the token whose renewal is in flight
+        # outside the lock; heap entries are removed directly.
         self._stopped_tokens: set = set()
+        self._inflight: Optional[str] = None
         self._stop = threading.Event()
         self._wake = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
@@ -66,16 +69,21 @@ class VaultClient:
             self._wake.notify()
 
     def renew_token(
-        self, token: str, ttl: float, on_fail: Optional[Callable[[str], None]] = None
+        self, token: str, ttl: float,
+        on_fail: Optional[Callable[[str], None]] = None,
+        renew_now: bool = False,
     ) -> None:
         """Schedule periodic renewal at half-TTL (vaultclient.go renewal
-        heap)."""
+        heap). renew_now renews immediately — used for tokens recovered
+        from disk whose true remaining lease is unknown; the first
+        successful renewal reports the real TTL."""
         with self._wake:
             self._stopped_tokens.discard(token)
             self._seq += 1
+            due = time.monotonic() if renew_now else time.monotonic() + ttl / 2.0
             heapq.heappush(
                 self._heap,
-                (time.monotonic() + ttl / 2.0, self._seq, token,
+                (due, self._seq, token,
                  time.monotonic() + ttl, on_fail or (lambda e: None)),
             )
             self._wake.notify()
@@ -83,7 +91,16 @@ class VaultClient:
 
     def stop_renew_token(self, token: str) -> None:
         with self._wake:
-            self._stopped_tokens.add(token)
+            before = len(self._heap)
+            self._heap = [e for e in self._heap if e[2] != token]
+            if len(self._heap) != before:
+                heapq.heapify(self._heap)
+            elif token == self._inflight:
+                # The loop popped it and is renewing outside the lock: a
+                # tombstone stops the re-push. Tokens with no heap entry
+                # and no in-flight renewal need nothing — adding them
+                # here would leak tombstones forever.
+                self._stopped_tokens.add(token)
 
     RETRY_INTERVAL = 15.0
 
@@ -100,9 +117,7 @@ class VaultClient:
                     self._wake.wait(min(due - now, 1.0))
                     continue
                 heapq.heappop(self._heap)
-                if token in self._stopped_tokens:
-                    self._stopped_tokens.discard(token)
-                    continue
+                self._inflight = token
             try:
                 out, _ = self.api.put("/v1/vault/renew", {"token": token})
                 ttl = float(out["ttl"])
@@ -116,23 +131,37 @@ class VaultClient:
                         "vault renewal failed, will retry: %s", e
                     )
                     with self._wake:
-                        self._seq += 1
-                        heapq.heappush(
-                            self._heap,
-                            (time.monotonic() + self.RETRY_INTERVAL,
-                             self._seq, token, expiry, on_fail),
-                        )
+                        if not self._finish_inflight(token):
+                            self._seq += 1
+                            heapq.heappush(
+                                self._heap,
+                                (time.monotonic() + self.RETRY_INTERVAL,
+                                 self._seq, token, expiry, on_fail),
+                            )
                     continue
                 self.logger.warning("vault token lease expired: %s", e)
-                try:
-                    on_fail(str(e))
-                except Exception:  # noqa: BLE001
-                    self.logger.exception("vault renewal failure handler")
+                with self._wake:
+                    stopped = self._finish_inflight(token)
+                if not stopped:
+                    try:
+                        on_fail(str(e))
+                    except Exception:  # noqa: BLE001
+                        self.logger.exception("vault renewal failure handler")
                 continue
             with self._wake:
-                self._seq += 1
-                heapq.heappush(
-                    self._heap,
-                    (time.monotonic() + ttl / 2.0, self._seq, token,
-                     time.monotonic() + ttl, on_fail),
-                )
+                if not self._finish_inflight(token):
+                    self._seq += 1
+                    heapq.heappush(
+                        self._heap,
+                        (time.monotonic() + ttl / 2.0, self._seq, token,
+                         time.monotonic() + ttl, on_fail),
+                    )
+
+    def _finish_inflight(self, token: str) -> bool:
+        """Clear in-flight state; True if the token was stopped mid-renewal
+        (caller must drop it instead of re-scheduling). Lock held."""
+        self._inflight = None
+        if token in self._stopped_tokens:
+            self._stopped_tokens.discard(token)
+            return True
+        return False

@@ -1,0 +1,243 @@
+"""docker / java / qemu drivers: fingerprint gating, command
+construction, container handle lifecycle.
+
+Mirrors reference client/driver/docker_test.go, java_test.go,
+qemu_test.go — but against stub binaries on PATH so the plumbing is
+covered without a real dockerd/JVM/qemu (the reference gates these
+tests on environment the same way).
+"""
+
+import os
+import stat
+import textwrap
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.drivers import DockerDriver, JavaDriver, QemuDriver
+from nomad_tpu.client.drivers.base import TaskContext
+from nomad_tpu.structs import LogConfig, Resources, Task
+
+
+def make_ctx(tmp_path):
+    task_dir = tmp_path / "task" / "local"
+    log_dir = tmp_path / "alloc" / "logs"
+    task_dir.mkdir(parents=True)
+    log_dir.mkdir(parents=True)
+    return TaskContext(
+        alloc_id="alloc1234",
+        alloc_dir=str(tmp_path / "alloc"),
+        task_dir=str(task_dir),
+        task_root=str(tmp_path / "task"),
+        log_dir=str(log_dir),
+        env={"NOMAD_ALLOC_ID": "alloc1234"},
+    )
+
+
+def write_stub(bin_dir, name, script):
+    path = bin_dir / name
+    path.write_text("#!/bin/sh\n" + textwrap.dedent(script))
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+@pytest.fixture
+def stub_path(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ.get('PATH', '')}")
+    return bin_dir
+
+
+# ---------------------------------------------------------------- docker
+
+DOCKER_STUB = """
+log="$STUB_LOG"
+echo "$@" >> "$log"
+case "$1" in
+  version) echo "25.0.0" ;;
+  run) echo "cafebabe0001" ;;
+  wait) echo "0" ;;
+  inspect)
+    case "$3" in
+      "{{.State.Pid}}") echo "4242" ;;
+      *) echo "true" ;;
+    esac ;;
+  stop|rm|kill) : ;;
+  *) exit 1 ;;
+esac
+"""
+
+
+@pytest.fixture
+def docker_stub(stub_path, tmp_path, monkeypatch):
+    log = tmp_path / "docker.log"
+    monkeypatch.setenv("STUB_LOG", str(log))
+    write_stub(stub_path, "docker", DOCKER_STUB)
+    return log
+
+
+def test_docker_fingerprint_absent(tmp_path, monkeypatch):
+    # Empty PATH: no docker binary, driver must withdraw its attribute.
+    monkeypatch.setenv("PATH", str(tmp_path))
+    node = mock.node()
+    node.attributes["driver.docker"] = "1"
+    assert DockerDriver().fingerprint(node) is False
+    assert "driver.docker" not in node.attributes
+
+
+def test_docker_fingerprint_present(docker_stub):
+    node = mock.node()
+    assert DockerDriver().fingerprint(node) is True
+    assert node.attributes["driver.docker"] == "1"
+    assert node.attributes["driver.docker.version"] == "25.0.0"
+
+
+def test_docker_start_builds_run_command(docker_stub, tmp_path):
+    ctx = make_ctx(tmp_path)
+    task = Task(
+        name="web", driver="docker",
+        config={"image": "redis:7", "command": "redis-server",
+                "args": ["--port", "6379"], "network_mode": "bridge"},
+        resources=Resources(cpu=500, memory_mb=256),
+    )
+    handle = DockerDriver().start(ctx, task)
+    res = handle.wait(timeout=10.0)
+    assert res is not None and res.successful()
+    lines = docker_stub.read_text().splitlines()
+    run_line = next(l for l in lines if l.startswith("run "))
+    assert "--cpu-shares 500" in run_line
+    assert "--memory 256m" in run_line
+    assert "--network bridge" in run_line
+    assert "redis:7 redis-server --port 6379" in run_line
+    assert f"{os.path.abspath(ctx.alloc_dir)}:/alloc" in run_line
+    assert handle.pid() == 4242
+
+
+def test_docker_handle_reattach(docker_stub, tmp_path):
+    ctx = make_ctx(tmp_path)
+    handle = DockerDriver().open(ctx, "docker:cafebabe0001:web")
+    assert handle is not None
+    assert handle.container_id == "cafebabe0001"
+    res = handle.wait(timeout=10.0)
+    assert res is not None and res.exit_code == 0
+
+
+def test_docker_missing_image_rejected(docker_stub, tmp_path):
+    task = Task(name="web", driver="docker", config={})
+    with pytest.raises(ValueError):
+        DockerDriver().validate_config(task)
+
+
+# ------------------------------------------------------------------ java
+
+JAVA_STUB = """
+if [ "$1" = "-version" ]; then
+  echo 'openjdk version "17.0.9" 2023-10-17' >&2
+  exit 0
+fi
+echo "$@" > "$STUB_LOG"
+exit 0
+"""
+
+
+@pytest.fixture
+def java_stub(stub_path, tmp_path, monkeypatch):
+    log = tmp_path / "java.log"
+    monkeypatch.setenv("STUB_LOG", str(log))
+    write_stub(stub_path, "java", JAVA_STUB)
+    return log
+
+
+def test_java_fingerprint(java_stub):
+    node = mock.node()
+    assert JavaDriver().fingerprint(node) is True
+    assert node.attributes["driver.java"] == "1"
+    assert node.attributes["driver.java.version"] == "17.0.9"
+
+
+def test_java_fingerprint_absent(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATH", str(tmp_path))
+    node = mock.node()
+    assert JavaDriver().fingerprint(node) is False
+    assert "driver.java" not in node.attributes
+
+
+def test_java_start_runs_jar(java_stub, tmp_path):
+    ctx = make_ctx(tmp_path)
+    task = Task(
+        name="svc", driver="java",
+        config={"jar_path": "app.jar", "jvm_options": ["-Xmx64m"],
+                "args": ["serve"]},
+    )
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    handle = JavaDriver().start(ctx, task)
+    try:
+        res = handle.wait(timeout=15.0)
+        assert res is not None and res.successful()
+        argv = java_stub.read_text().split()
+        assert argv[0] == "-Xmx64m"
+        assert argv[1] == "-jar"
+        assert argv[2].endswith("app.jar")
+        # Relative jar_path resolves against the task root dir.
+        assert argv[2].startswith(ctx.task_root)
+        assert argv[3] == "serve"
+    finally:
+        handle.kill(1.0)
+
+
+# ------------------------------------------------------------------ qemu
+
+QEMU_STUB = """
+if [ "$1" = "--version" ]; then
+  echo "QEMU emulator version 8.1.2"
+  exit 0
+fi
+echo "$@" > "$STUB_LOG"
+exit 0
+"""
+
+
+@pytest.fixture
+def qemu_stub(stub_path, tmp_path, monkeypatch):
+    log = tmp_path / "qemu.log"
+    monkeypatch.setenv("STUB_LOG", str(log))
+    write_stub(stub_path, "qemu-system-x86_64", QEMU_STUB)
+    return log
+
+
+def test_qemu_fingerprint(qemu_stub):
+    node = mock.node()
+    assert QemuDriver().fingerprint(node) is True
+    assert node.attributes["driver.qemu"] == "1"
+    assert node.attributes["driver.qemu.version"] == "8.1.2"
+
+
+def test_qemu_start_builds_command(qemu_stub, tmp_path):
+    ctx = make_ctx(tmp_path)
+    (tmp_path / "task" / "local" / "img.qcow2").write_bytes(b"\x00")
+    task = Task(
+        name="vm", driver="qemu",
+        config={"image_path": "local/img.qcow2",
+                "accelerator": "tcg",
+                "port_map": {"22": 22022}},
+        resources=Resources(cpu=1000, memory_mb=384),
+    )
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    handle = QemuDriver().start(ctx, task)
+    try:
+        res = handle.wait(timeout=15.0)
+        assert res is not None and res.successful()
+        line = qemu_stub.read_text()
+        assert "-m 384M" in line
+        assert "accel=tcg" in line
+        assert "hostfwd=tcp::22022-:22" in line
+        assert "img.qcow2" in line
+    finally:
+        handle.kill(1.0)
+
+
+def test_qemu_missing_image_rejected():
+    task = Task(name="vm", driver="qemu", config={})
+    with pytest.raises(ValueError):
+        QemuDriver().validate_config(task)

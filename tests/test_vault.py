@@ -236,3 +236,95 @@ class TestClientVaultE2E:
             agent.shutdown(destroy_allocs=True)
             http.stop()
             srv.shutdown()
+
+
+class TestVaultClientRenewal:
+    """Renewal-heap hygiene: stop_renew_token must not leak tombstones,
+    and reattach must resume renewing the persisted token rather than
+    minting a new one."""
+
+    class FakeAPI:
+        def __init__(self):
+            self.renewed = []
+
+        def put(self, path, body):
+            self.renewed.append(body["token"])
+            return {"ttl": 0.2}, 200
+
+    def test_stop_without_entry_does_not_leak(self):
+        from nomad_tpu.client.vaultclient import VaultClient
+
+        vc = VaultClient(self.FakeAPI(), "n1")
+        # Token whose renewal chain already ended (or never existed):
+        # stopping it must not grow the tombstone set forever.
+        for i in range(100):
+            vc.stop_renew_token(f"dead-token-{i}")
+        assert not vc._stopped_tokens
+        assert not vc._heap
+
+    def test_stop_removes_heap_entry(self):
+        from nomad_tpu.client.vaultclient import VaultClient
+
+        vc = VaultClient(self.FakeAPI(), "n1")
+        vc.renew_token("tok-a", ttl=3600.0)
+        vc.renew_token("tok-b", ttl=3600.0)
+        vc.stop_renew_token("tok-a")
+        assert [e[2] for e in vc._heap] == ["tok-b"]
+        assert not vc._stopped_tokens
+        vc.stop()
+
+    def test_renewal_fires_and_reschedules(self):
+        from nomad_tpu.client.vaultclient import VaultClient
+
+        api = self.FakeAPI()
+        vc = VaultClient(api, "n1")
+        vc.renew_token("tok", ttl=0.2)
+        assert wait_until(lambda: len(api.renewed) >= 2, timeout=10.0)
+        vc.stop_renew_token("tok")
+        vc.stop()
+
+    def test_recover_vault_token_resumes_persisted(self, tmp_path):
+        """_recover_vault_token adopts secrets/vault_token instead of
+        deriving a fresh one (reference: client restore re-renews)."""
+        from nomad_tpu.client.drivers.base import TaskContext
+        from nomad_tpu.client.task_runner import TaskRunner
+        from nomad_tpu.client.vaultclient import VaultClient
+        from nomad_tpu.structs import Task
+
+        api = self.FakeAPI()
+        vc = VaultClient(api, "n1")
+        task = Task(name="t1", driver="mock_driver", vault=Vault(policies=["p"]))
+        runner = TaskRunner.__new__(TaskRunner)  # just the vault methods
+        runner.task = task
+        runner.vault_client = vc
+        runner._vault_token = ""
+
+        root = tmp_path / "task"
+        (root / "secrets").mkdir(parents=True)
+        (root / "secrets" / "vault_token").write_text("persisted-token\n")
+        ctx = TaskContext(task_root=str(root), task_dir=str(root / "local"))
+
+        assert runner._recover_vault_token(ctx) is True
+        assert runner._vault_token == "persisted-token"
+        assert ctx.env["VAULT_TOKEN"] == "persisted-token"
+        # The persisted token — not a fresh derivation — gets renewed.
+        assert wait_until(lambda: "persisted-token" in api.renewed, timeout=10.0)
+        vc.stop()
+
+    def test_recover_vault_token_missing_falls_back(self, tmp_path):
+        from nomad_tpu.client.drivers.base import TaskContext
+        from nomad_tpu.client.task_runner import TaskRunner
+        from nomad_tpu.client.vaultclient import VaultClient
+        from nomad_tpu.structs import Task
+
+        vc = VaultClient(self.FakeAPI(), "n1")
+        runner = TaskRunner.__new__(TaskRunner)
+        runner.task = Task(name="t1", driver="mock_driver",
+                           vault=Vault(policies=["p"]))
+        runner.vault_client = vc
+        runner._vault_token = ""
+        root = tmp_path / "task"
+        root.mkdir()
+        ctx = TaskContext(task_root=str(root), task_dir=str(root / "local"))
+        assert runner._recover_vault_token(ctx) is False
+        vc.stop()
