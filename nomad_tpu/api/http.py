@@ -97,7 +97,8 @@ class HTTPServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
-        self.addr = f"http://{host}:{self._httpd.server_address[1]}"
+        self.port = self._httpd.server_address[1]
+        self.addr = f"http://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:

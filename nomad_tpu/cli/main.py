@@ -530,11 +530,40 @@ def cmd_agent(args) -> int:
     print(f"    Gossip: {serf_addr} (region {args.region})")
     print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
 
+    # Agent-level consul registration: advertise this agent's HTTP
+    # endpoint under the "nomad" catalog service so clients can
+    # bootstrap through discovery (consul/syncer.go agent services).
+    agent_syncer = None
+    if args.consul:
+        from ..consul import ConsulAPI, ConsulService, ConsulSyncer
+
+        # A wildcard bind is not routable — advertise a real interface
+        # address (or whatever -advertise overrides it with).
+        advertise = args.advertise or args.bind
+        if advertise in ("0.0.0.0", "::"):
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            try:
+                s.connect(("10.255.255.255", 1))
+                advertise = s.getsockname()[0]
+            except OSError:
+                advertise = "127.0.0.1"
+            finally:
+                s.close()
+        consul_api = ConsulAPI(args.consul)
+        agent_syncer = ConsulSyncer(consul_api, address=args.consul,
+                                    instance=node_name)
+        agent_syncer.set_services("agent", [
+            ConsulService(name="nomad", tags=["http"],
+                          port=http.port, address=advertise),
+        ])
+        agent_syncer.start()
+
     client_agent = ClientAgent(
         ClientConfig(
             servers=[http.addr],
             dev_mode=True,
             options={"driver.raw_exec.enable": "1"},
+            consul_addr=args.consul,
         )
     )
     client_agent.start()
@@ -547,6 +576,8 @@ def cmd_agent(args) -> int:
     except KeyboardInterrupt:
         print("\n==> Caught interrupt, shutting down...")
         client_agent.shutdown(destroy_allocs=True)
+        if agent_syncer is not None:
+            agent_syncer.shutdown()
         http.stop()
         server.shutdown()
     return 0
@@ -577,6 +608,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated gossip addrs to join at start")
     p.add_argument("-tpu", dest="tpu", action="store_true",
                    help="route service/batch evals to the TPU backend")
+    p.add_argument("-consul", dest="consul", default="",
+                   help="consul agent addr for service sync + discovery")
+    p.add_argument("-advertise", dest="advertise", default="",
+                   help="address advertised to consul (default: bind addr)")
     p.add_argument("-log-level", dest="log_level", default="INFO")
     p.set_defaults(fn=cmd_agent)
 

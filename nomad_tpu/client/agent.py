@@ -25,7 +25,8 @@ from ..utils.ids import generate_uuid
 from .alloc_runner import AllocRunner
 from .config import ClientConfig
 from .drivers import DRIVER_REGISTRY
-from .fingerprint import fingerprint_node
+from .fingerprint import fingerprint_consul, fingerprint_node
+from .servers import ServerList
 
 ALLOC_SYNC_INTERVAL = 0.2  # client.go allocSyncIntv (batched updates)
 
@@ -34,10 +35,33 @@ class ClientAgent:
     def __init__(self, config: ClientConfig, node: Optional[Node] = None):
         self.config = config
         self.logger = logging.getLogger("nomad_tpu.client")
+        self.consul = config.consul_api
+        if self.consul is None and config.consul_addr:
+            from ..consul import ConsulAPI
+
+            self.consul = ConsulAPI(config.consul_addr)
+        if not config.servers and self.consul is None:
+            raise ValueError("no servers configured and no consul for discovery")
+        self.servers = ServerList(config.servers)
         if not config.servers:
-            raise ValueError("no servers configured")
-        self.api = APIClient(config.servers[0], timeout=330.0)
+            self._consul_discover()
+        if not len(self.servers):
+            raise ValueError("no servers configured or discovered")
+        self.api = APIClient(self.servers.get(), timeout=330.0)
         self.vault_client = None
+        self.syncer = None
+        if self.consul is not None:
+            from ..consul import ConsulSyncer
+
+            self.syncer = ConsulSyncer(self.consul)
+        # alloc id -> consul service domains registered for its tasks;
+        # guarded by _consul_lock (mutated from runner callback threads
+        # and the alloc-watch thread). _consul_removed tombstones GC'd
+        # allocs so a late task-state callback can't re-register their
+        # services after removal.
+        self._consul_domains: Dict[str, set] = {}
+        self._consul_removed: set = set()
+        self._consul_lock = threading.Lock()
 
         if not config.alloc_dir:
             config.alloc_dir = tempfile.mkdtemp(prefix="nomad_tpu_allocs_")
@@ -79,6 +103,8 @@ class ClientAgent:
         for k, v in self.config.options.items():
             node.attributes[k] = v
         fingerprint_node(node)
+        if self.consul is not None:
+            fingerprint_consul(node, self.consul)
         if self.config.node_name:
             node.name = self.config.node_name
         # Driver fingerprints advertise availability.
@@ -104,18 +130,28 @@ class ClientAgent:
         self.vault_client = VaultClient(
             self.api, self.node.id, self.node.secret_id
         )
-        for target, name in (
+        if self.syncer is not None:
+            # Scope consul ids to this node so reconcile never reaps
+            # another agent's registrations (see ConsulSyncer.instance).
+            self.syncer.instance = self.node.id[:8]
+            self.syncer.start()
+        targets = [
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "alloc-watch"),
             (self._alloc_sync_loop, "alloc-sync"),
             (self._save_state_loop, "save-state"),
-        ):
+        ]
+        if self.consul is not None:
+            targets.append((self._fingerprint_loop, "fingerprint"))
+        for target, name in targets:
             t = threading.Thread(target=target, name=f"client-{name}", daemon=True)
             t.start()
             self._threads.append(t)
 
     def shutdown(self, destroy_allocs: bool = False) -> None:
         self._stop.set()
+        if self.syncer is not None:
+            self.syncer.shutdown()
         if self.vault_client is not None:
             self.vault_client.stop()
         for t in self._threads:
@@ -129,6 +165,36 @@ class ClientAgent:
 
     # ------------------------------------------------------------------
 
+    def _rpc_failed(self) -> None:
+        """Demote the current server and move to the next-ranked one;
+        fall back to consul catalog discovery when every configured
+        endpoint has failed (serverlist.go + client.go:1762)."""
+        cur = self.api.address
+        self.servers.notify_failure(cur)
+        nxt = self.servers.get()
+        if nxt == cur or nxt is None:
+            self._consul_discover()
+            nxt = self.servers.get()
+        if nxt and nxt != cur:
+            self.logger.warning("rpc failover: %s -> %s", cur, nxt)
+            self.api.address = nxt
+
+    def _consul_discover(self) -> None:
+        if self.consul is None:
+            return
+        from ..consul import discover_servers
+
+        try:
+            found = discover_servers(
+                self.consul, service=self.config.consul_service)
+        except Exception as e:  # noqa: BLE001 - consul down is soft
+            self.logger.debug("consul discovery failed: %s", e)
+            return
+        addrs = [a if "://" in a else f"http://{a}" for a in found]
+        if addrs:
+            merged = list(dict.fromkeys(self.servers.all() + addrs))
+            self.servers.set_servers(merged)
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             interval = max(self.heartbeat_ttl / 2.0, 0.05)
@@ -138,9 +204,11 @@ class ClientAgent:
                 self.heartbeat_ttl = self.api.nodes.heartbeat(
                     self.node.id, self.node.secret_id
                 )
+                self.servers.notify_success(self.api.address)
             except APIError as e:
                 if e.status == 0:
-                    continue  # agent unreachable: transient, retry next tick
+                    self._rpc_failed()
+                    continue  # agent unreachable: try the next server
                 # The server rejected the heartbeat (e.g. it lost our node
                 # after a restart): re-register.
                 self.logger.warning("heartbeat failed: %s", e)
@@ -154,6 +222,25 @@ class ClientAgent:
             except Exception:
                 pass  # unexpected; retry next tick
 
+    def _fingerprint_loop(self) -> None:
+        """Periodic re-run of dynamic fingerprints (client.go:739):
+        consul appearing/vanishing updates node attributes, and the
+        changed node is re-registered so constraints see it."""
+        interval = 3.0 if self.config.dev_mode else 15.0
+        while not self._stop.wait(interval):
+            before = dict(self.node.attributes)
+            fingerprint_consul(self.node, self.consul)
+            if self.node.attributes != before:
+                try:
+                    self.api.nodes.register(self.node)
+                    # register overwrites server-side status with our
+                    # local INIT snapshot; restore ready immediately so
+                    # the node isn't filtered out until next heartbeat.
+                    self.api.nodes.update_status(
+                        self.node.id, consts.NODE_STATUS_READY)
+                except Exception:  # noqa: BLE001 - next heartbeat retries
+                    pass
+
     def _watch_allocations(self) -> None:
         """Blocking-query loop on this node's allocations; apply the
         diff (client.go:1125/1285)."""
@@ -164,6 +251,12 @@ class ClientAgent:
                     self.node.id, secret=self.node.secret_id,
                     index=index, wait=2.0,
                 )
+            except APIError as e:
+                if e.status == 0:
+                    self._rpc_failed()
+                if self._stop.wait(0.5):
+                    return
+                continue
             except Exception:
                 if self._stop.wait(0.5):
                     return
@@ -178,6 +271,7 @@ class ClientAgent:
             for alloc_id in list(self.alloc_runners):
                 if alloc_id not in pulled_ids:
                     runner = self.alloc_runners.pop(alloc_id)
+                    self._remove_alloc_services(alloc_id)
                     threading.Thread(target=runner.destroy, daemon=True).start()
             for alloc in pulled:
                 runner = self.alloc_runners.get(alloc.id)
@@ -229,14 +323,63 @@ class ClientAgent:
         ).start()
 
     def _template_kv(self, path: str):
-        """KV source for {{ key "..." }} templates: client options under
-        the template.kv. prefix (the service registry supplies richer
-        data once configured)."""
+        """KV source for {{ key "..." }} templates: consul KV when an
+        agent is configured (consul_template.go), falling back to client
+        options under the template.kv. prefix."""
+        if self.consul is not None:
+            try:
+                val = self.consul.kv_get(path)
+                if val is not None:
+                    return val
+            except Exception:  # noqa: BLE001 - consul down is soft
+                pass
         return (self.config.options or {}).get(f"template.kv.{path}")
 
     def _mark_dirty(self, alloc: Allocation) -> None:
         with self._dirty_lock:
             self._dirty_allocs[alloc.id] = alloc
+        self._sync_task_services(alloc)
+
+    # ------------------------------------------------ consul services
+
+    def _sync_task_services(self, alloc: Allocation) -> None:
+        """Advertise services of running tasks; withdraw them when the
+        task leaves running (syncer.go SetServices per task domain)."""
+        if self.syncer is None or alloc.job is None:
+            return
+        from ..consul import task_services
+
+        tg = next((g for g in alloc.job.task_groups
+                   if g.name == alloc.task_group), None)
+        if tg is None:
+            return
+        with self._consul_lock:
+            if alloc.id in self._consul_removed:
+                return  # alloc was GC'd; never re-register
+            domains = self._consul_domains.setdefault(alloc.id, set())
+            for task in tg.tasks:
+                state = (alloc.task_states or {}).get(task.name)
+                domain = f"task-{alloc.id}-{task.name}"
+                if (state is not None
+                        and state.state == consts.TASK_STATE_RUNNING):
+                    services = task_services(alloc, task)
+                    if services:
+                        self.syncer.set_services(domain, services)
+                        domains.add(domain)
+                elif domain in domains:
+                    self.syncer.remove_services(domain)
+                    domains.discard(domain)
+            if not domains:
+                self._consul_domains.pop(alloc.id, None)
+
+    def _remove_alloc_services(self, alloc_id: str) -> None:
+        if self.syncer is None:
+            return
+        with self._consul_lock:
+            self._consul_removed.add(alloc_id)
+            domains = self._consul_domains.pop(alloc_id, set())
+        for domain in domains:
+            self.syncer.remove_services(domain)
 
     def _alloc_sync_loop(self) -> None:
         """Batched client->server status updates (client.go:1050)."""

@@ -31,3 +31,10 @@ class ClientConfig:
     save_interval: float = 60.0
     # Dev mode: shorter intervals, temp dirs.
     dev_mode: bool = False
+    # Consul agent address ("host:port") for service registration,
+    # fingerprinting, and server discovery (client.go:1762); an
+    # in-process api object can be injected instead for tests.
+    consul_addr: str = ""
+    consul_api: Optional[object] = None
+    # Catalog service name nomad servers register under.
+    consul_service: str = "nomad"

@@ -1,18 +1,23 @@
 """Node fingerprinting: fill attributes/resources from the host.
 
 Reference: client/fingerprint/ (registry fingerprint.go:38-76; arch,
-cpu + MHz, memory, storage, host, network). Reads /proc and os APIs —
-no third-party deps.
+cpu + MHz, memory, storage, host, network, cgroup, consul, vault,
+env_aws, env_gce). Reads /proc and os APIs — no third-party deps; the
+cloud-metadata fingerprints take an injectable fetcher so tests run
+without a metadata service.
 """
 
 from __future__ import annotations
 
+import json
 import multiprocessing
 import os
 import platform
 import shutil
 import socket
-from typing import Callable, Dict, List
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
 
 from ..structs import NetworkResource, Node, Resources
 
@@ -100,6 +105,153 @@ def fingerprint_network(node: Node) -> bool:
     return True
 
 
+def fingerprint_cgroup(node: Node) -> bool:
+    """Detect a mounted cgroup hierarchy (cgroup_linux.go); drivers that
+    need resource isolation gate on unique.cgroup.mountpoint."""
+    if platform.system() != "Linux":
+        return False
+    mountpoint = ""
+    try:
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 3 and parts[2] in ("cgroup", "cgroup2"):
+                    mountpoint = os.path.dirname(parts[1]) \
+                        if parts[2] == "cgroup" else parts[1]
+                    break
+    except OSError:
+        return False
+    if not mountpoint:
+        return False
+    node.attributes["unique.cgroup.mountpoint"] = mountpoint
+    return True
+
+
+def fingerprint_vault(node: Node, vault_client=None) -> bool:
+    """Advertise vault availability (fingerprint/vault.go): attributes
+    come from the client's vault token source when configured."""
+    if vault_client is None:
+        return False
+    node.attributes["vault.accessible"] = "true"
+    version = getattr(vault_client, "version", "")
+    if version:
+        node.attributes["vault.version"] = version
+    return True
+
+
+def fingerprint_consul(node: Node, consul_api) -> bool:
+    """Attributes from the local consul agent (fingerprint/consul.go):
+    version, datacenter, server mode, unique node name."""
+    try:
+        info = consul_api.self_info()
+    except Exception:  # noqa: BLE001 - consul down: not available
+        # Stale consul attributes are cleared so constraints don't match
+        # a dead agent (the reference clears on periodic re-run).
+        for key in list(node.attributes):
+            if key.startswith("consul.") or key == "unique.consul.name":
+                del node.attributes[key]
+        node.links.pop("consul", None)
+        return False
+    cfg = info.get("Config") or {}
+    node.attributes["consul.version"] = str(cfg.get("Version", ""))
+    node.attributes["consul.revision"] = str(cfg.get("Revision", ""))
+    node.attributes["consul.server"] = str(bool(cfg.get("Server"))).lower()
+    node.attributes["consul.datacenter"] = str(cfg.get("Datacenter", ""))
+    node.attributes["unique.consul.name"] = str(cfg.get("NodeName", ""))
+    node.links["consul"] = (f"{node.attributes['consul.datacenter']}."
+                            f"{node.attributes['unique.consul.name']}")
+    return True
+
+
+MetadataFetcher = Callable[[str], Optional[str]]
+
+
+def _http_fetcher(base: str, headers: Dict[str, str]) -> MetadataFetcher:
+    def fetch(path: str) -> Optional[str]:
+        req = urllib.request.Request(base + path, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=0.4) as resp:
+                return resp.read().decode()
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    return fetch
+
+
+AWS_METADATA = "http://169.254.169.254/latest/meta-data/"
+GCE_METADATA = "http://169.254.169.254/computeMetadata/v1/instance/"
+
+_AWS_KEYS = {
+    "ami-id": "platform.aws.ami-id",
+    "instance-id": "unique.platform.aws.instance-id",
+    "instance-type": "platform.aws.instance-type",
+    "local-hostname": "unique.platform.aws.local-hostname",
+    "local-ipv4": "unique.platform.aws.local-ipv4",
+    "placement/availability-zone": "platform.aws.placement.availability-zone",
+}
+
+
+def fingerprint_env_aws(node: Node,
+                        fetch: Optional[MetadataFetcher] = None) -> bool:
+    """EC2 metadata attributes (fingerprint/env_aws.go). Off unless the
+    metadata service answers (or a fetcher is injected)."""
+    if fetch is None:
+        if not os.environ.get("NOMAD_TPU_FINGERPRINT_AWS"):
+            return False  # don't probe link-local addrs by default
+        fetch = _http_fetcher(AWS_METADATA, {})
+    found = False
+    for path, attr in _AWS_KEYS.items():
+        val = fetch(path)
+        if val:
+            node.attributes[attr] = val.strip()
+            found = True
+    if not found:
+        return False
+    node.attributes["platform.aws"] = "true"
+    ip = node.attributes.get("unique.platform.aws.local-ipv4", "")
+    if ip and not node.resources.networks:
+        node.resources.networks = [
+            NetworkResource(device="eth0", cidr=f"{ip}/32", ip=ip, mbits=1000)
+        ]
+    return True
+
+
+_GCE_KEYS = {
+    "id": "unique.platform.gce.id",
+    "hostname": "unique.platform.gce.hostname",
+    "zone": "platform.gce.zone",
+    "machine-type": "platform.gce.machine-type",
+    "network-interfaces/0/ip": "unique.platform.gce.network.ip",
+}
+
+
+def fingerprint_env_gce(node: Node,
+                        fetch: Optional[MetadataFetcher] = None) -> bool:
+    """GCE metadata attributes (fingerprint/env_gce.go)."""
+    if fetch is None:
+        if not os.environ.get("NOMAD_TPU_FINGERPRINT_GCE"):
+            return False
+        fetch = _http_fetcher(GCE_METADATA, {"Metadata-Flavor": "Google"})
+    found = False
+    for path, attr in _GCE_KEYS.items():
+        val = fetch(path)
+        if val:
+            # zone/machine-type come back as full resource paths
+            node.attributes[attr] = val.strip().rsplit("/", 1)[-1]
+            found = True
+    if not found:
+        return False
+    node.attributes["platform.gce"] = "true"
+    tags = fetch("tags")
+    if tags:
+        try:
+            for tag in json.loads(tags):
+                node.attributes[f"platform.gce.tag.{tag}"] = "true"
+        except ValueError:
+            pass
+    return True
+
+
 BUILTIN_FINGERPRINTS: List[Callable[[Node], bool]] = [
     fingerprint_arch,
     fingerprint_cpu,
@@ -107,6 +259,9 @@ BUILTIN_FINGERPRINTS: List[Callable[[Node], bool]] = [
     fingerprint_storage,
     fingerprint_host,
     fingerprint_network,
+    fingerprint_cgroup,
+    fingerprint_env_aws,
+    fingerprint_env_gce,
 ]
 
 

@@ -1,0 +1,62 @@
+"""Ranked server list with failure-driven rotation.
+
+Reference: client/serverlist.go — the client keeps every known server
+endpoint ranked by observed failures; RPCs go to the front, a failed
+endpoint is demoted (failures++ then re-sort), and `set_servers`
+installs a fresh (shuffled) set from config, heartbeat responses, or
+consul discovery while preserving failure counts of endpoints it keeps.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Optional
+
+
+class ServerList:
+    def __init__(self, servers: Optional[List[str]] = None):
+        self._lock = threading.Lock()
+        self._failures = {}
+        self._servers: List[str] = []
+        if servers:
+            self.set_servers(servers)
+
+    def set_servers(self, servers: List[str]) -> None:
+        with self._lock:
+            fresh = list(dict.fromkeys(servers))  # dedupe, keep order
+            random.shuffle(fresh)
+            self._failures = {
+                s: self._failures.get(s, 0) for s in fresh
+            }
+            self._servers = sorted(fresh, key=self._failures.__getitem__)
+
+    def all(self) -> List[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def get(self) -> Optional[str]:
+        """Best (least-failed) server, or None when empty."""
+        with self._lock:
+            return self._servers[0] if self._servers else None
+
+    def notify_failure(self, server: str) -> None:
+        """Demote a server after a failed RPC (serverlist.go
+        failServer)."""
+        with self._lock:
+            if server not in self._failures:
+                return
+            self._failures[server] += 1
+            self._servers.sort(key=self._failures.__getitem__)
+
+    def notify_success(self, server: str) -> None:
+        """A working endpoint resets its failure count so a past blip
+        doesn't permanently demote it."""
+        with self._lock:
+            if server in self._failures:
+                self._failures[server] = 0
+                self._servers.sort(key=self._failures.__getitem__)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._servers)

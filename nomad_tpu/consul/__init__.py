@@ -1,0 +1,28 @@
+"""Consul integration: agent API client, service/check syncer, and
+server discovery.
+
+Reference: command/agent/consul/syncer.go (service + check registration
+and periodic reconcile), command/agent/consul/check.go (script-check
+runner heartbeating TTL checks), client/client.go:1762 consulDiscovery
+(server bootstrap through the consul catalog).
+"""
+
+from .api import ConsulAPI, FakeConsul, FakeConsulServer
+from .syncer import (
+    ConsulCheck,
+    ConsulService,
+    ConsulSyncer,
+    discover_servers,
+    task_services,
+)
+
+__all__ = [
+    "ConsulAPI",
+    "FakeConsul",
+    "FakeConsulServer",
+    "ConsulCheck",
+    "ConsulService",
+    "ConsulSyncer",
+    "discover_servers",
+    "task_services",
+]

@@ -1,0 +1,327 @@
+"""Consul agent HTTP API client plus an in-process fake.
+
+The real client speaks the Consul v1 agent/catalog/kv API over HTTP
+(the subset the syncer and discovery need). `FakeConsul` implements the
+same Python surface in-process so tests (and consul-less deployments)
+run without a consul binary; `FakeConsulServer` serves a `FakeConsul`
+over real HTTP so `ConsulAPI`'s wire path is testable too.
+
+Reference: the syncer talks to consul through the official Go client
+(command/agent/consul/syncer.go:40-75); the HTTP surface mirrored here
+is what that client hits.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+
+class ConsulError(Exception):
+    pass
+
+
+class ConsulAPI:
+    """Minimal Consul v1 HTTP client (agent services/checks, catalog,
+    KV)."""
+
+    def __init__(self, address: str = "127.0.0.1:8500", timeout: float = 5.0,
+                 token: str = ""):
+        if "://" not in address:
+            address = "http://" + address
+        self.base = address.rstrip("/")
+        self.timeout = timeout
+        self.token = token
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 params: Optional[Dict[str, str]] = None, raw: bool = False):
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Consul-Token"] = self.token
+        if body is not None:
+            data = json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise ConsulError(f"consul {method} {path}: {e.code} "
+                              f"{e.read().decode(errors='replace')}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ConsulError(f"consul {method} {path}: {e}") from e
+        if raw:
+            return payload.decode(errors="replace")
+        if not payload:
+            return None
+        try:
+            return json.loads(payload)
+        except ValueError:
+            return payload.decode(errors="replace")
+
+    # ----------------------------------------------------------- agent
+
+    def self_info(self) -> dict:
+        return self._request("GET", "/v1/agent/self") or {}
+
+    def services(self) -> Dict[str, dict]:
+        return self._request("GET", "/v1/agent/services") or {}
+
+    def checks(self) -> Dict[str, dict]:
+        return self._request("GET", "/v1/agent/checks") or {}
+
+    def register_service(self, svc: dict) -> None:
+        self._request("PUT", "/v1/agent/service/register", body=svc)
+
+    def deregister_service(self, service_id: str) -> None:
+        self._request("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    def register_check(self, chk: dict) -> None:
+        self._request("PUT", "/v1/agent/check/register", body=chk)
+
+    def deregister_check(self, check_id: str) -> None:
+        self._request("PUT", f"/v1/agent/check/deregister/{check_id}")
+
+    def update_ttl(self, check_id: str, status: str, output: str = "") -> None:
+        self._request("PUT", f"/v1/agent/check/update/{check_id}",
+                      body={"Status": status, "Output": output})
+
+    # --------------------------------------------------------- catalog
+
+    def catalog_service(self, name: str, tag: str = "") -> List[dict]:
+        params = {"tag": tag} if tag else None
+        return self._request("GET", f"/v1/catalog/service/{name}",
+                             params=params) or []
+
+    # -------------------------------------------------------------- kv
+
+    def kv_get(self, key: str) -> Optional[str]:
+        try:
+            # raw=True: the body is the stored value verbatim — parsing
+            # it as JSON would rewrite values like "1.50" or "1e3".
+            return self._request("GET", f"/v1/kv/{key}",
+                                 params={"raw": "1"}, raw=True)
+        except ConsulError:
+            return None
+
+
+class FakeConsul:
+    """In-process stand-in with `ConsulAPI`'s surface.
+
+    Registered services feed the catalog, TTL updates land in `checks`,
+    and `set_kv` seeds the KV store — enough to exercise the syncer,
+    discovery, and template KV paths without a consul agent.
+    """
+
+    def __init__(self, datacenter: str = "dc1", node_name: str = "fake-node"):
+        self.datacenter = datacenter
+        self.node_name = node_name
+        self._services: Dict[str, dict] = {}
+        self._checks: Dict[str, dict] = {}
+        self._kv: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- agent
+
+    def self_info(self) -> dict:
+        return {
+            "Config": {
+                "Datacenter": self.datacenter,
+                "NodeName": self.node_name,
+                "Server": False,
+                "Version": "0.7.0-fake",
+                "Revision": "fake",
+            }
+        }
+
+    def services(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._services.items()}
+
+    def checks(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._checks.items()}
+
+    def register_service(self, svc: dict) -> None:
+        sid = svc.get("ID") or svc.get("Name", "")
+        with self._lock:
+            self._services[sid] = {
+                "ID": sid,
+                "Service": svc.get("Name", ""),
+                "Tags": list(svc.get("Tags") or []),
+                "Port": int(svc.get("Port") or 0),
+                "Address": svc.get("Address", ""),
+            }
+            for chk in svc.get("Checks") or []:
+                self._register_check_locked(chk, service_id=sid)
+
+    def deregister_service(self, service_id: str) -> None:
+        with self._lock:
+            self._services.pop(service_id, None)
+            for cid in [c for c, chk in self._checks.items()
+                        if chk.get("ServiceID") == service_id]:
+                self._checks.pop(cid, None)
+
+    def _register_check_locked(self, chk: dict, service_id: str = "") -> None:
+        cid = chk.get("ID") or chk.get("CheckID") or chk.get("Name", "")
+        self._checks[cid] = {
+            "CheckID": cid,
+            "Name": chk.get("Name", ""),
+            "Status": chk.get("Status") or "critical",
+            "Output": "",
+            "ServiceID": service_id or chk.get("ServiceID", ""),
+            "Type": ("ttl" if chk.get("TTL") else
+                     "http" if chk.get("HTTP") else
+                     "tcp" if chk.get("TCP") else "unknown"),
+        }
+
+    def register_check(self, chk: dict) -> None:
+        with self._lock:
+            self._register_check_locked(chk)
+
+    def deregister_check(self, check_id: str) -> None:
+        with self._lock:
+            self._checks.pop(check_id, None)
+
+    def update_ttl(self, check_id: str, status: str, output: str = "") -> None:
+        with self._lock:
+            if check_id not in self._checks:
+                raise ConsulError(f"unknown check {check_id}")
+            self._checks[check_id]["Status"] = status
+            self._checks[check_id]["Output"] = output
+
+    # --------------------------------------------------------- catalog
+
+    def catalog_service(self, name: str, tag: str = "") -> List[dict]:
+        with self._lock:
+            out = []
+            for svc in self._services.values():
+                if svc["Service"] != name:
+                    continue
+                if tag and tag not in svc["Tags"]:
+                    continue
+                out.append({
+                    "Node": self.node_name,
+                    "Address": svc["Address"] or "127.0.0.1",
+                    "ServiceID": svc["ID"],
+                    "ServiceName": svc["Service"],
+                    "ServiceAddress": svc["Address"],
+                    "ServicePort": svc["Port"],
+                    "ServiceTags": svc["Tags"],
+                })
+            return out
+
+    # -------------------------------------------------------------- kv
+
+    def set_kv(self, key: str, value: str) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._kv.get(key)
+
+
+class FakeConsulServer:
+    """Serves a `FakeConsul` over HTTP so `ConsulAPI`'s wire path can be
+    tested end-to-end."""
+
+    def __init__(self, fake: Optional[FakeConsul] = None):
+        import http.server
+
+        self.fake = fake or FakeConsul()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, obj, raw: Optional[str] = None):
+                if raw is not None:
+                    body = raw.encode()
+                else:
+                    body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                return json.loads(self.rfile.read(n))
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                params = dict(urllib.parse.parse_qsl(query))
+                fake = outer.fake
+                if path == "/v1/agent/self":
+                    return self._reply(fake.self_info())
+                if path == "/v1/agent/services":
+                    return self._reply(fake.services())
+                if path == "/v1/agent/checks":
+                    return self._reply(fake.checks())
+                if path.startswith("/v1/catalog/service/"):
+                    name = path.rsplit("/", 1)[1]
+                    return self._reply(
+                        fake.catalog_service(name, params.get("tag", "")))
+                if path.startswith("/v1/kv/"):
+                    val = fake.kv_get(path[len("/v1/kv/"):])
+                    if val is None:
+                        self.send_error(404)
+                        return
+                    return self._reply(None, raw=val)
+                self.send_error(404)
+
+            def do_PUT(self):
+                path = self.path.partition("?")[0]
+                fake = outer.fake
+                if path == "/v1/agent/service/register":
+                    fake.register_service(self._body())
+                    return self._reply(None, raw="")
+                if path.startswith("/v1/agent/service/deregister/"):
+                    fake.deregister_service(path.rsplit("/", 1)[1])
+                    return self._reply(None, raw="")
+                if path == "/v1/agent/check/register":
+                    fake.register_check(self._body())
+                    return self._reply(None, raw="")
+                if path.startswith("/v1/agent/check/deregister/"):
+                    fake.deregister_check(path.rsplit("/", 1)[1])
+                    return self._reply(None, raw="")
+                if path.startswith("/v1/agent/check/update/"):
+                    body = self._body()
+                    try:
+                        fake.update_ttl(path.rsplit("/", 1)[1],
+                                        body.get("Status", ""),
+                                        body.get("Output", ""))
+                    except ConsulError:
+                        self.send_error(404)
+                        return
+                    return self._reply(None, raw="")
+                self.send_error(404)
+
+        import socketserver
+
+        class Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+
+        self._httpd = Server(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fake-consul")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,306 @@
+"""Consul syncer: keeps agent + task services/checks registered.
+
+Reference: command/agent/consul/syncer.go:1007 — services are grouped
+by origin "domain" (agent, or one per running task), every id we own
+carries the `_nomad-` prefix, and a periodic reconcile registers what
+is desired and deregisters what is stale (so a restarted consul agent
+recovers the full set). Script checks follow check.go: the syncer runs
+the command locally on its interval and heartbeats a TTL check with the
+exit status; http/tcp checks are registered consul-native so the consul
+agent probes them itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+NOMAD_PREFIX = "_nomad"
+SYNC_INTERVAL = 5.0
+
+
+@dataclass
+class ConsulCheck:
+    name: str = ""
+    type: str = ""  # http | tcp | script | ttl
+    command: str = ""
+    args: List[str] = field(default_factory=list)
+    path: str = ""
+    protocol: str = "http"
+    port: int = 0
+    interval: float = 10.0
+    timeout: float = 5.0
+    initial_status: str = ""
+
+
+@dataclass
+class ConsulService:
+    name: str = ""
+    tags: List[str] = field(default_factory=list)
+    port: int = 0
+    address: str = ""
+    checks: List[ConsulCheck] = field(default_factory=list)
+
+    def service_id(self, domain: str, instance: str = "") -> str:
+        key = f"{domain}-{self.name}-{','.join(sorted(self.tags))}-{self.port}"
+        digest = hashlib.sha1(key.encode()).hexdigest()[:12]
+        # The "i" marker makes every instance scope a distinct, non-
+        # overlapping prefix — "default" is never a string prefix of
+        # another instance's ids, so reconcile can't cross scopes.
+        prefix = f"{NOMAD_PREFIX}-i{instance or 'default'}"
+        return f"{prefix}-{domain}-{self.name}-{digest}"
+
+
+class _ScriptCheckRunner:
+    """Runs a script check on its interval, heartbeating the TTL check
+    (check.go CheckRunner)."""
+
+    def __init__(self, api, check_id: str, check: ConsulCheck, log):
+        self.api = api
+        self.check_id = check_id
+        self.check = check
+        self.log = log
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"check-{check.name}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(max(self.check.interval, 0.05)):
+            cmd = [self.check.command] + list(self.check.args)
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True,
+                    timeout=max(self.check.timeout, 0.1))
+                output = (proc.stdout + proc.stderr)[-4096:]
+                # Consul's script-check convention: 0 passing, 1 warning,
+                # anything else critical.
+                status = {0: "passing", 1: "warning"}.get(
+                    proc.returncode, "critical")
+            except subprocess.TimeoutExpired:
+                status, output = "critical", "check timed out"
+            except OSError as e:
+                status, output = "critical", str(e)
+            try:
+                self.api.update_ttl(self.check_id, status, output)
+            except Exception as e:  # noqa: BLE001 - consul flaps are soft
+                self.log.debug("ttl update for %s failed: %s",
+                               self.check_id, e)
+
+
+class ConsulSyncer:
+    """Reconciles desired services/checks against the consul agent."""
+
+    def __init__(self, api, sync_interval: float = SYNC_INTERVAL,
+                 address: str = "", instance: str = ""):
+        self.api = api
+        self.address = address
+        # Identity baked into every id we register: reconcile only reaps
+        # THIS agent's stale services (e.g. left by a crashed previous
+        # run), never another nomad agent's. The reference gets the same
+        # isolation from consul-agent locality — each syncer talks to
+        # the consul agent on its own node.
+        self.instance = instance
+        self.sync_interval = sync_interval
+        self.logger = logging.getLogger("nomad_tpu.consul.syncer")
+        self._desired: Dict[str, Dict[str, dict]] = {}  # domain -> id -> payload
+        # domain -> check id -> def (script checks we execute ourselves)
+        self._script_checks: Dict[str, Dict[str, ConsulCheck]] = {}
+        self._runners: Dict[str, _ScriptCheckRunner] = {}
+        self._registered: Dict[str, dict] = {}  # what we believe consul has
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- desired set
+
+    def set_services(self, domain: str, services: List[ConsulService]) -> None:
+        """Replace the desired services for one domain; next sync applies
+        the diff (syncer.go SetServices)."""
+        payloads: Dict[str, dict] = {}
+        scripts: Dict[str, ConsulCheck] = {}
+        for svc in services:
+            sid = svc.service_id(domain, self.instance)
+            checks = []
+            for i, chk in enumerate(svc.checks):
+                cid = f"{sid}-chk{i}"
+                base = {"ID": cid, "Name": chk.name or f"service:{svc.name}",
+                        "ServiceID": sid}
+                if chk.initial_status:
+                    base["Status"] = chk.initial_status
+                if chk.type == "http":
+                    target = svc.address or "127.0.0.1"
+                    port = chk.port or svc.port
+                    base["HTTP"] = (f"{chk.protocol or 'http'}://{target}:"
+                                    f"{port}{chk.path or '/'}")
+                    base["Interval"] = f"{chk.interval:g}s"
+                    base["Timeout"] = f"{chk.timeout:g}s"
+                elif chk.type == "tcp":
+                    target = svc.address or "127.0.0.1"
+                    base["TCP"] = f"{target}:{chk.port or svc.port}"
+                    base["Interval"] = f"{chk.interval:g}s"
+                    base["Timeout"] = f"{chk.timeout:g}s"
+                else:  # script and explicit ttl checks heartbeat a TTL
+                    base["TTL"] = f"{max(chk.interval, 0.1) * 3:g}s"
+                    if chk.type == "script":
+                        scripts[cid] = chk
+                checks.append(base)
+            payloads[sid] = {
+                "ID": sid,
+                "Name": svc.name,
+                "Tags": list(svc.tags),
+                "Port": svc.port,
+                "Address": svc.address,
+                "Checks": checks,
+            }
+        with self._lock:
+            if payloads:
+                self._desired[domain] = payloads
+                self._script_checks[domain] = scripts
+            else:
+                self._desired.pop(domain, None)
+                self._script_checks.pop(domain, None)
+            # Drop script runners for checks no longer desired anywhere.
+            live = {cid for dom in self._script_checks.values() for cid in dom}
+            for cid, runner in list(self._runners.items()):
+                if cid not in live:
+                    runner.stop()
+                    del self._runners[cid]
+        self._wake.set()
+
+    def remove_services(self, domain: str) -> None:
+        self.set_services(domain, [])
+
+    # ------------------------------------------------------------- loop
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="consul-syncer")
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+        with self._lock:
+            for runner in self._runners.values():
+                runner.stop()
+            self._runners.clear()
+            registered = list(self._registered)
+            self._registered.clear()
+        # Best-effort dereg of everything we own (syncer.go Shutdown).
+        for sid in registered:
+            try:
+                self.api.deregister_service(sid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.sync_interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.sync()
+            except Exception as e:  # noqa: BLE001 - consul down is soft
+                self.logger.debug("consul sync failed: %s", e)
+
+    # ------------------------------------------------------------- sync
+
+    def sync(self) -> None:
+        """One reconcile pass: register missing/changed, deregister
+        stale `_nomad-` services (syncer.go syncServices/syncChecks)."""
+        with self._lock:
+            desired: Dict[str, dict] = {}
+            for dom in self._desired.values():
+                desired.update(dom)
+            scripts: Dict[str, ConsulCheck] = {}
+            for dom_scripts in self._script_checks.values():
+                scripts.update(dom_scripts)
+
+        have = self.api.services()
+        # Register anything missing or drifted.
+        for sid, payload in desired.items():
+            cur = have.get(sid)
+            drifted = (cur is None
+                       or cur.get("Port") != payload["Port"]
+                       or cur.get("Address", "") != payload["Address"]
+                       or sorted(cur.get("Tags") or []) != sorted(payload["Tags"]))
+            if drifted:
+                self.api.register_service(payload)
+            with self._lock:
+                self._registered[sid] = payload
+        # Deregister OUR stale services (matching instance scope) that
+        # nobody wants anymore; other agents' registrations survive.
+        prefix = f"{NOMAD_PREFIX}-i{self.instance or 'default'}-"
+        for sid in have:
+            if sid.startswith(prefix) and sid not in desired:
+                self.api.deregister_service(sid)
+                with self._lock:
+                    self._registered.pop(sid, None)
+        # Start runners for script checks now that their TTL checks exist.
+        with self._lock:
+            for cid, chk in scripts.items():
+                if cid not in self._runners:
+                    runner = _ScriptCheckRunner(self.api, cid, chk, self.logger)
+                    self._runners[cid] = runner
+                    runner.start()
+
+
+# --------------------------------------------------------------- helpers
+
+
+def task_services(alloc, task) -> List[ConsulService]:
+    """Build the consul services a running task advertises, resolving
+    port labels against the alloc's assigned networks (the reference
+    maps Service.PortLabel through the task's NetworkResource)."""
+    res = (alloc.task_resources or {}).get(task.name)
+    labels: Dict[str, int] = {}
+    address = ""
+    for net in (res.networks if res is not None else []) or []:
+        labels.update(net.port_labels())
+        address = address or net.ip
+    out = []
+    for svc in task.services or []:
+        port = labels.get(svc.port_label, 0)
+        checks = [
+            ConsulCheck(
+                name=c.name, type=c.type, command=c.command,
+                args=list(c.args), path=c.path, protocol=c.protocol,
+                port=labels.get(c.port_label, port),
+                interval=c.interval or 10.0, timeout=c.timeout or 5.0,
+                initial_status=c.initial_status,
+            )
+            for c in svc.checks or []
+        ]
+        out.append(ConsulService(
+            name=svc.name, tags=list(svc.tags), port=port,
+            address=address, checks=checks,
+        ))
+    return out
+
+
+def discover_servers(api, service: str = "nomad",
+                     tag: str = "http") -> List[str]:
+    """Find nomad servers through the consul catalog
+    (client.go:1762 consulDiscovery)."""
+    out = []
+    for entry in api.catalog_service(service, tag=tag):
+        addr = entry.get("ServiceAddress") or entry.get("Address") or ""
+        port = entry.get("ServicePort") or 0
+        if addr and port:
+            out.append(f"{addr}:{port}")
+    return out

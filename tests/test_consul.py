@@ -1,0 +1,292 @@
+"""Consul integration tests: API client wire path, syncer reconcile,
+script checks, task service registration, discovery, and client
+failover (mirror command/agent/consul/syncer_test.go and
+client/serverlist_test.go scenarios without a consul binary)."""
+
+import sys
+import time
+
+from nomad_tpu.consul import (
+    ConsulAPI,
+    ConsulCheck,
+    ConsulService,
+    ConsulSyncer,
+    FakeConsul,
+    FakeConsulServer,
+    discover_servers,
+    task_services,
+)
+from nomad_tpu.client.servers import ServerList
+from nomad_tpu.structs import (
+    Allocation,
+    NetworkResource,
+    Port,
+    Resources,
+)
+from nomad_tpu.structs.job import Service, ServiceCheck, Task
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ----------------------------------------------------------- api client
+
+
+def test_consul_api_over_http():
+    server = FakeConsulServer()
+    try:
+        api = ConsulAPI(server.addr)
+        info = api.self_info()
+        assert info["Config"]["Datacenter"] == "dc1"
+
+        api.register_service({
+            "ID": "_nomad-x", "Name": "web", "Tags": ["a"], "Port": 8080,
+            "Address": "1.2.3.4",
+            "Checks": [{"ID": "_nomad-x-chk0", "Name": "alive",
+                        "TTL": "30s"}],
+        })
+        assert "_nomad-x" in api.services()
+        assert api.checks()["_nomad-x-chk0"]["Status"] == "critical"
+        api.update_ttl("_nomad-x-chk0", "passing", "ok")
+        assert api.checks()["_nomad-x-chk0"]["Status"] == "passing"
+
+        cat = api.catalog_service("web")
+        assert cat and cat[0]["ServicePort"] == 8080
+        assert api.catalog_service("web", tag="missing") == []
+
+        server.fake.set_kv("app/config", "value1")
+        assert api.kv_get("app/config") == "value1"
+        assert api.kv_get("missing/key") is None
+        # raw values must come back verbatim, not JSON round-tripped
+        server.fake.set_kv("app/num", "1.50")
+        assert api.kv_get("app/num") == "1.50"
+
+        api.deregister_service("_nomad-x")
+        assert api.services() == {}
+        assert api.checks() == {}
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------- syncer
+
+
+def test_syncer_registers_and_deregisters():
+    fake = FakeConsul()
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("agent", [
+        ConsulService(name="nomad", tags=["http"], port=4646,
+                      address="127.0.0.1"),
+    ])
+    syncer.sync()
+    services = fake.services()
+    assert len(services) == 1
+    svc = next(iter(services.values()))
+    assert svc["Service"] == "nomad"
+    assert svc["Port"] == 4646
+
+    # Adding a second domain keeps the first.
+    syncer.set_services("task-a", [ConsulService(name="web", port=8080)])
+    syncer.sync()
+    assert len(fake.services()) == 2
+
+    # Removing a domain deregisters only its services.
+    syncer.remove_services("task-a")
+    syncer.sync()
+    services = fake.services()
+    assert len(services) == 1
+    assert next(iter(services.values()))["Service"] == "nomad"
+
+    # Shutdown deregisters everything nomad-owned.
+    syncer.shutdown()
+    assert fake.services() == {}
+
+
+def test_syncer_recovers_after_consul_restart():
+    """A wiped consul agent gets the full set re-registered on the next
+    reconcile (the point of periodic sync, syncer.go)."""
+    fake = FakeConsul()
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("agent", [ConsulService(name="nomad", port=4646)])
+    syncer.sync()
+    assert len(fake.services()) == 1
+
+    fake._services.clear()  # simulated agent restart
+    syncer.sync()
+    assert len(fake.services()) == 1
+
+
+def test_syncer_removes_foreign_nomad_services_only():
+    fake = FakeConsul()
+    # A stale service from this agent's previous run, one from another
+    # nomad instance, and one registered by an operator.
+    fake.register_service({"ID": "_nomad-idefault-stale", "Name": "old",
+                           "Port": 1})
+    fake.register_service({"ID": "_nomad-iother-live", "Name": "x", "Port": 2})
+    fake.register_service({"ID": "operator-svc", "Name": "db", "Port": 5432})
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("agent", [ConsulService(name="nomad", port=4646)])
+    syncer.sync()
+    ids = set(fake.services())
+    assert "_nomad-idefault-stale" not in ids  # reaped: ours, not desired
+    assert "_nomad-iother-live" in ids  # another instance's: untouched
+    assert "operator-svc" in ids  # untouched: not nomad-owned
+
+
+def test_instance_scoped_syncers_do_not_reap_each_other():
+    """Two agents sharing one consul view: each reconciles only its own
+    ids; each still reaps ITS stale leftovers (crashed previous run)."""
+    fake = FakeConsul()
+    a = ConsulSyncer(fake, instance="nodeA")
+    b = ConsulSyncer(fake, instance="nodeB")
+    a.set_services("agent", [ConsulService(name="nomad", port=1)])
+    b.set_services("agent", [ConsulService(name="nomad", port=2)])
+    a.sync()
+    b.sync()
+    assert len(fake.services()) == 2
+    a.sync()  # must not reap b's registration
+    assert len(fake.services()) == 2
+    # A stale id from a's previous run IS reaped by a, not by b.
+    fake.register_service({"ID": "_nomad-inodeA-task-dead-x", "Name": "old"})
+    b.sync()
+    assert "_nomad-inodeA-task-dead-x" in fake.services()
+    a.sync()
+    assert "_nomad-inodeA-task-dead-x" not in fake.services()
+
+
+def test_script_check_heartbeats_ttl():
+    fake = FakeConsul()
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("task-x", [
+        ConsulService(name="web", port=80, checks=[
+            ConsulCheck(name="ok", type="script",
+                        command=sys.executable,
+                        args=["-c", "print('fine')"],
+                        interval=0.05, timeout=5.0),
+        ]),
+    ])
+    syncer.start()
+    try:
+        assert wait_until(lambda: any(
+            c["Status"] == "passing" for c in fake.checks().values()))
+        out = [c for c in fake.checks().values() if c["Status"] == "passing"]
+        assert "fine" in out[0]["Output"]
+    finally:
+        syncer.shutdown()
+
+
+def test_script_check_failure_is_critical():
+    fake = FakeConsul()
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("task-x", [
+        ConsulService(name="web", port=80, checks=[
+            ConsulCheck(name="bad", type="script",
+                        command=sys.executable,
+                        args=["-c", "raise SystemExit(2)"],
+                        interval=0.05, timeout=5.0),
+        ]),
+    ])
+    syncer.start()
+    try:
+        assert wait_until(lambda: any(
+            c["Status"] == "critical" and c["Type"] == "ttl"
+            for c in fake.checks().values()))
+    finally:
+        syncer.shutdown()
+
+
+def test_http_and_tcp_checks_registered_consul_native():
+    fake = FakeConsul()
+    syncer = ConsulSyncer(fake, sync_interval=0.05)
+    syncer.set_services("task-x", [
+        ConsulService(name="web", port=8080, address="10.0.0.1", checks=[
+            ConsulCheck(name="h", type="http", path="/health",
+                        interval=10, timeout=2),
+            ConsulCheck(name="t", type="tcp", interval=10, timeout=2),
+        ]),
+    ])
+    syncer.sync()
+    types = sorted(c["Type"] for c in fake.checks().values())
+    assert types == ["http", "tcp"]
+    syncer.shutdown()
+
+
+# ------------------------------------------------- task service mapping
+
+
+def _alloc_with_service():
+    task = Task(name="web", driver="mock")
+    task.services = [Service(
+        name="frontend", port_label="http", tags=["urlprefix-/"],
+        checks=[ServiceCheck(name="alive", type="tcp", port_label="http",
+                             interval=10, timeout=2)],
+    )]
+    alloc = Allocation(id="a1", task_group="web")
+    alloc.task_resources = {
+        "web": Resources(networks=[NetworkResource(
+            ip="10.1.2.3",
+            dynamic_ports=[Port(label="http", value=23456)],
+        )]),
+    }
+    return alloc, task
+
+
+def test_task_services_resolves_port_labels():
+    alloc, task = _alloc_with_service()
+    services = task_services(alloc, task)
+    assert len(services) == 1
+    svc = services[0]
+    assert svc.name == "frontend"
+    assert svc.port == 23456
+    assert svc.address == "10.1.2.3"
+    assert svc.checks[0].port == 23456
+    # Stable id derivation per domain + instance scope
+    assert svc.service_id("task-a1-web").startswith(
+        "_nomad-idefault-task-a1-web-")
+    assert svc.service_id("task-a1-web", "n1").startswith(
+        "_nomad-in1-task-a1-web-")
+
+
+# ---------------------------------------------------- discovery + list
+
+
+def test_discover_servers_from_catalog():
+    fake = FakeConsul()
+    fake.register_service({"ID": "_nomad-agent-1", "Name": "nomad",
+                           "Tags": ["http"], "Port": 4646,
+                           "Address": "10.0.0.5"})
+    fake.register_service({"ID": "other", "Name": "db", "Port": 5432})
+    assert discover_servers(fake) == ["10.0.0.5:4646"]
+    # tag filter: db isn't tagged http
+    assert discover_servers(fake, service="db", tag="http") == []
+    # untagged query falls back to the node address
+    assert discover_servers(fake, service="db", tag="") == ["127.0.0.1:5432"]
+
+
+def test_server_list_rotation():
+    sl = ServerList(["a", "b", "c"])
+    assert len(sl) == 3
+    first = sl.get()
+    sl.notify_failure(first)
+    second = sl.get()
+    assert second != first
+    # Success resets the failure count: demoted server becomes eligible.
+    sl.notify_failure(second)
+    sl.notify_failure(sl.get())
+    sl.notify_success(first)
+    assert sl.get() == first
+    # set_servers keeps failure counts for retained entries.
+    sl.set_servers(["b", "d"])
+    assert set(sl.all()) == {"b", "d"}
+
+
+def test_server_list_empty():
+    sl = ServerList()
+    assert sl.get() is None
+    sl.notify_failure("ghost")  # no-op, no crash

@@ -1,0 +1,156 @@
+"""End-to-end consul wiring through a live server + client agent:
+fingerprint attributes, task service registration lifecycle, and
+discovery-driven client bootstrap (client.go:1762)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.client import ClientAgent, ClientConfig
+from nomad_tpu.consul import FakeConsul
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.job import Service
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def consul_cluster(tmp_path):
+    fake = FakeConsul()
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    cfg = ClientConfig(
+        servers=[http.addr],
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        options={"driver.raw_exec.enable": "1"},
+        dev_mode=True,
+        consul_api=fake,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    agent.syncer.sync_interval = 0.05  # fast reconcile for tests
+    agent.start()
+    yield server, agent, fake, http
+    agent.shutdown(destroy_allocs=True)
+    http.stop()
+    server.shutdown()
+
+
+def service_job():
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": 1e9}
+    # one dynamic port the service advertises
+    task.resources.networks[0].mbits = 1
+    task.services = [Service(name="frontend", port_label="http",
+                             tags=["web"])]
+    return job
+
+
+def test_consul_fingerprint_on_node(consul_cluster):
+    server, agent, fake, _ = consul_cluster
+    node = server.fsm.state.node_by_id(agent.node.id)
+    assert node.attributes["consul.version"] == "0.7.0-fake"
+    assert node.attributes["consul.datacenter"] == "dc1"
+    assert node.attributes["unique.consul.name"] == "fake-node"
+    assert node.links["consul"] == "dc1.fake-node"
+
+
+def test_task_services_registered_and_withdrawn(consul_cluster):
+    server, agent, fake, _ = consul_cluster
+    job = service_job()
+    server.job_register(job)
+
+    def frontend_registered():
+        return any(s["Service"] == "frontend"
+                   for s in fake.services().values())
+
+    assert wait_until(frontend_registered)
+    svc = next(s for s in fake.services().values()
+               if s["Service"] == "frontend")
+    assert svc["Port"] >= 20000  # a real dynamically-assigned port
+    assert svc["Tags"] == ["web"]
+
+    # Stopping the job withdraws the service.
+    server.job_deregister(job.id)
+    assert wait_until(lambda: not frontend_registered())
+
+
+def test_client_bootstraps_through_consul_discovery(tmp_path):
+    """A client with NO configured servers finds them in the catalog."""
+    fake = FakeConsul()
+    server = Server(ServerConfig(num_schedulers=1))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    host, port = http.addr.removeprefix("http://").rsplit(":", 1)
+    fake.register_service({"ID": "_nomad-agent-x", "Name": "nomad",
+                           "Tags": ["http"], "Port": int(port),
+                           "Address": host})
+    cfg = ClientConfig(
+        servers=[],  # nothing configured: discovery must fill this
+        state_dir=str(tmp_path / "state"),
+        alloc_dir=str(tmp_path / "allocs"),
+        dev_mode=True,
+        consul_api=fake,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    agent.start()
+    try:
+        assert wait_until(
+            lambda: server.fsm.state.node_by_id(agent.node.id) is not None
+            and server.fsm.state.node_by_id(agent.node.id).status
+            == consts.NODE_STATUS_READY
+        )
+    finally:
+        agent.shutdown()
+        http.stop()
+        server.shutdown()
+
+
+def test_client_fails_over_to_discovered_server(consul_cluster, tmp_path):
+    """Kill the configured server; the client discovers a replacement
+    through consul and keeps heartbeating."""
+    server, agent, fake, http = consul_cluster
+
+    # A second server joins and registers in consul.
+    server2 = Server(ServerConfig(num_schedulers=1))
+    server2.start()
+    http2 = HTTPServer(server2)
+    http2.start()
+    host2, port2 = http2.addr.removeprefix("http://").rsplit(":", 1)
+    fake.register_service({"ID": "_nomad-agent-2", "Name": "nomad",
+                           "Tags": ["http"], "Port": int(port2),
+                           "Address": host2})
+    try:
+        # Fail the original endpoint.
+        http.stop()
+        assert wait_until(lambda: agent.api.address == http2.addr,
+                          timeout=15.0)
+        # The client re-registers with the new server via its heartbeat
+        # recovery path.
+        assert wait_until(
+            lambda: server2.fsm.state.node_by_id(agent.node.id) is not None,
+            timeout=15.0,
+        )
+    finally:
+        http2.stop()
+        server2.shutdown()

@@ -1,0 +1,104 @@
+"""Fingerprint tests (mirror client/fingerprint/*_test.go): cloud
+metadata via injected fetchers, cgroup detection, consul attributes."""
+
+import platform
+
+from nomad_tpu.client.fingerprint import (
+    _AWS_KEYS,
+    _GCE_KEYS,
+    fingerprint_cgroup,
+    fingerprint_consul,
+    fingerprint_env_aws,
+    fingerprint_env_gce,
+    fingerprint_node,
+)
+from nomad_tpu.consul import FakeConsul
+from nomad_tpu.structs import Node, Resources
+
+
+def fresh_node():
+    node = Node()
+    node.resources = Resources()
+    return node
+
+
+def test_env_aws_with_fetcher():
+    answers = {
+        "ami-id": "ami-1234",
+        "instance-id": "i-abcdef",
+        "instance-type": "m4.large",
+        "local-hostname": "ip-10-0-0-207",
+        "local-ipv4": "10.0.0.207",
+        "placement/availability-zone": "us-west-2a",
+    }
+    node = fresh_node()
+    assert fingerprint_env_aws(node, fetch=answers.get)
+    assert node.attributes["platform.aws.ami-id"] == "ami-1234"
+    assert node.attributes["unique.platform.aws.instance-id"] == "i-abcdef"
+    assert node.attributes["platform.aws"] == "true"
+    # local-ipv4 populated the network resource
+    assert node.resources.networks[0].ip == "10.0.0.207"
+
+
+def test_env_aws_absent_metadata():
+    node = fresh_node()
+    assert not fingerprint_env_aws(node, fetch=lambda p: None)
+    assert "platform.aws" not in node.attributes
+
+
+def test_env_gce_with_fetcher():
+    answers = {
+        "id": "1234567890",
+        "hostname": "vm.c.project.internal",
+        "zone": "projects/123/zones/us-central1-f",
+        "machine-type": "projects/123/machineTypes/n1-standard-1",
+        "network-interfaces/0/ip": "10.128.0.2",
+        "tags": '["web", "db"]',
+    }
+    node = fresh_node()
+    assert fingerprint_env_gce(node, fetch=answers.get)
+    # full resource paths are trimmed to their last segment
+    assert node.attributes["platform.gce.zone"] == "us-central1-f"
+    assert node.attributes["platform.gce.machine-type"] == "n1-standard-1"
+    assert node.attributes["platform.gce.tag.web"] == "true"
+    assert node.attributes["platform.gce.tag.db"] == "true"
+
+
+def test_cgroup_fingerprint_linux():
+    node = fresh_node()
+    applied = fingerprint_cgroup(node)
+    if platform.system() == "Linux":
+        assert applied
+        assert node.attributes["unique.cgroup.mountpoint"]
+    else:
+        assert not applied
+
+
+def test_consul_fingerprint_clears_on_outage():
+    node = fresh_node()
+    fake = FakeConsul(datacenter="dc9", node_name="c1")
+    assert fingerprint_consul(node, fake)
+    assert node.attributes["consul.datacenter"] == "dc9"
+    assert node.links["consul"] == "dc9.c1"
+
+    class Down:
+        def self_info(self):
+            raise OSError("connection refused")
+
+    assert not fingerprint_consul(node, Down())
+    assert not any(k.startswith("consul.") for k in node.attributes)
+    assert "unique.consul.name" not in node.attributes
+
+
+def test_fingerprint_node_includes_new_entries():
+    node = fresh_node()
+    applied = fingerprint_node(node)
+    assert "arch" in applied and "cpu" in applied
+    # cloud fingerprints are gated off without the opt-in env var
+    assert "env_aws" not in applied
+    assert "env_gce" not in applied
+
+
+def test_aws_gce_key_maps_cover_reference_attributes():
+    assert "instance-type" in _AWS_KEYS
+    assert "machine-type" in _GCE_KEYS
