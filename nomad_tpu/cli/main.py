@@ -485,101 +485,242 @@ def cmd_agent_info(args) -> int:
     return 0
 
 
+def _resolve_agent_config(args):
+    """defaults (< dev) < -config files in order < CLI flags
+    (command.go:909 flag overlay)."""
+    from .agent_config import (
+        default_config,
+        dev_config,
+        load_config,
+        merge_config,
+    )
+
+    cfg = dev_config() if args.dev else default_config()
+    for path in args.config or []:
+        cfg = merge_config(cfg, load_config(path))
+    if args.bind:
+        cfg.bind_addr = args.bind
+    if args.port:
+        cfg.ports.http = args.port
+    if args.region:
+        cfg.region = args.region
+    if args.node_name:
+        cfg.name = args.node_name
+    if args.num_schedulers is not None:
+        cfg.server.num_schedulers = args.num_schedulers
+    if args.statsd:
+        cfg.telemetry.statsd_address = args.statsd
+    if args.consul:
+        cfg.consul.address = args.consul
+    if args.advertise:
+        cfg.advertise_addr = args.advertise
+    if args.join:
+        cfg.server.start_join = cfg.server.start_join + args.join.split(",")
+    if args.log_level:
+        cfg.log_level = args.log_level
+    return cfg
+
+
+def _advertise_addr(cfg):
+    """A wildcard bind is not routable — advertise a real interface
+    address instead."""
+    import socket as _socket
+
+    advertise = cfg.advertise_addr or cfg.bind_addr
+    if advertise in ("0.0.0.0", "::"):
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            advertise = s.getsockname()[0]
+        except OSError:
+            advertise = "127.0.0.1"
+        finally:
+            s.close()
+    return advertise
+
+
 def cmd_agent(args) -> int:
-    """Run a combined server+client agent (dev mode)."""
+    """Run an agent: server, client, or both, from merged config
+    (agent.go:61 — the Agent composes nomad.Server and client.Client
+    per config; -dev enables both with permissive defaults)."""
     import logging
+    import socket as _socket
 
     from ..api import HTTPServer
     from ..client import ClientAgent, ClientConfig
     from ..server import Server, ServerConfig
+    from ..utils import metrics
+    from .agent_config import parse_duration
 
+    try:
+        cfg = _resolve_agent_config(args)
+        collection_interval = parse_duration(cfg.telemetry.collection_interval)
+        heartbeat_grace = (parse_duration(cfg.server.heartbeat_grace)
+                           if cfg.server.heartbeat_grace else None)
+        node_gc_threshold = (parse_duration(cfg.server.node_gc_threshold)
+                             if cfg.server.node_gc_threshold else None)
+    except (ValueError, OSError) as e:
+        print(f"error loading config: {e}", file=sys.stderr)
+        return 1
     logging.basicConfig(
-        level=logging.DEBUG if args.log_level == "DEBUG" else logging.INFO,
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
         format="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
     )
-    if not args.dev:
-        print("only -dev mode is supported for now", file=sys.stderr)
+    if not cfg.server.enabled and not cfg.client.enabled:
+        print("agent must have server, client, or both enabled "
+              "(use -dev or a -config file)", file=sys.stderr)
         return 1
 
-    if args.statsd:
-        from ..utils import metrics
-
-        metrics.configure(statsd_addr=args.statsd)
+    metrics.configure(
+        statsd_addr=cfg.telemetry.statsd_address,
+        statsite_addr=cfg.telemetry.statsite_address,
+        disable_hostname=cfg.telemetry.disable_hostname,
+        interval=collection_interval,
+    )
+    # SIGUSR1 dumps recent telemetry to stderr (in-memory sink).
+    try:
+        metrics.install_signal_dump()
+    except ValueError:
+        pass  # not on the main thread (tests)
 
     scheduler_factories = {}
     if args.tpu:
         scheduler_factories = {"service": "service-tpu", "batch": "batch-tpu"}
-    import socket as _socket
 
     # Unique gossip identity per agent: two same-region agents with the
     # same member name would clobber each other in the serf pool.
-    node_name = args.node_name or f"{_socket.gethostname()}-{args.port}"
-    server = Server(
-        ServerConfig(num_schedulers=args.num_schedulers,
-                     scheduler_factories=scheduler_factories,
-                     region=args.region, node_name=node_name)
-    )
-    server.start()
-    http = HTTPServer(server, host=args.bind, port=args.port)
-    http.start()
-    serf_addr = server.setup_serf(host=args.bind, http_addr=http.addr)
-    if args.join:
-        joined = server.serf_join(args.join.split(","))
-        print(f"==> Joined {joined} gossip peers")
-    print(f"==> nomad-tpu agent started (dev mode)! HTTP: {http.addr}")
-    print(f"    Gossip: {serf_addr} (region {args.region})")
-    print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
+    node_name = cfg.name or f"{_socket.gethostname()}-{cfg.ports.http}"
+
+    server = http = None
+    server_addr = None
+    if cfg.server.enabled:
+        server_cfg = ServerConfig(
+            num_schedulers=(cfg.server.num_schedulers
+                            if cfg.server.num_schedulers is not None else 2),
+            scheduler_factories=scheduler_factories,
+            region=cfg.region, datacenter=cfg.datacenter,
+            node_name=node_name,
+            bootstrap_expect=cfg.server.bootstrap_expect or 1,
+            statsd_addr=cfg.telemetry.statsd_address,
+        )
+        if cfg.server.enabled_schedulers:
+            server_cfg.enabled_schedulers = list(cfg.server.enabled_schedulers)
+            if "_core" not in server_cfg.enabled_schedulers:
+                server_cfg.enabled_schedulers.append("_core")
+        if heartbeat_grace is not None:
+            server_cfg.heartbeat_grace = heartbeat_grace
+        if node_gc_threshold is not None:
+            server_cfg.node_gc_threshold = node_gc_threshold
+        server = Server(server_cfg)
+        server.start()
+        http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http)
+        http.start()
+        server_addr = http.addr
+        serf_addr = server.setup_serf(host=cfg.bind_addr, http_addr=http.addr)
+        if cfg.server.start_join:
+            joined = server.serf_join(cfg.server.start_join)
+            print(f"==> Joined {joined} gossip peers")
+        if cfg.server.retry_join:
+            # retry_join keeps trying until it lands (command.go
+            # retryJoin loop) — that's its difference from start_join.
+            import threading as _threading
+
+            def _retry_join(srv=server, addrs=list(cfg.server.retry_join),
+                            interval=3.0 if cfg.dev_mode else 15.0):
+                while True:
+                    try:
+                        if srv.serf_join(addrs) > 0:
+                            print(f"==> Retry-join succeeded: {addrs}")
+                            return
+                    except Exception:  # noqa: BLE001 - keep retrying
+                        pass
+                    time.sleep(interval)
+
+            _threading.Thread(target=_retry_join, daemon=True,
+                              name="retry-join").start()
+        mode = "dev mode" if cfg.dev_mode else "server"
+        print(f"==> nomad-tpu agent started ({mode})! HTTP: {http.addr}")
+        print(f"    Gossip: {serf_addr} (region {cfg.region})")
+        print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
 
     # Agent-level consul registration: advertise this agent's HTTP
-    # endpoint under the "nomad" catalog service so clients can
+    # endpoint under the configured catalog service so clients can
     # bootstrap through discovery (consul/syncer.go agent services).
     agent_syncer = None
-    if args.consul:
+    if cfg.consul.address and cfg.consul.auto_advertise:
         from ..consul import ConsulAPI, ConsulService, ConsulSyncer
 
-        # A wildcard bind is not routable — advertise a real interface
-        # address (or whatever -advertise overrides it with).
-        advertise = args.advertise or args.bind
-        if advertise in ("0.0.0.0", "::"):
-            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-            try:
-                s.connect(("10.255.255.255", 1))
-                advertise = s.getsockname()[0]
-            except OSError:
-                advertise = "127.0.0.1"
-            finally:
-                s.close()
-        consul_api = ConsulAPI(args.consul)
-        agent_syncer = ConsulSyncer(consul_api, address=args.consul,
+        consul_api = ConsulAPI(cfg.consul.address)
+        agent_syncer = ConsulSyncer(consul_api, address=cfg.consul.address,
                                     instance=node_name)
-        agent_syncer.set_services("agent", [
-            ConsulService(name="nomad", tags=["http"],
-                          port=http.port, address=advertise),
-        ])
+        services = []
+        if server is not None:
+            services.append(ConsulService(
+                name=cfg.consul.server_service_name, tags=["http"],
+                port=http.port, address=_advertise_addr(cfg)))
+        agent_syncer.set_services("agent", services)
         agent_syncer.start()
 
-    client_agent = ClientAgent(
-        ClientConfig(
-            servers=[http.addr],
-            dev_mode=True,
-            options={"driver.raw_exec.enable": "1"},
-            consul_addr=args.consul,
+    client_agent = None
+    if cfg.client.enabled:
+        servers = list(cfg.client.servers)
+        if server_addr and server_addr not in servers:
+            servers.insert(0, server_addr)
+        servers = [s if "://" in s else f"http://{s}" for s in servers]
+        client_cfg = ClientConfig(
+            servers=servers,
+            region=cfg.region, datacenter=cfg.datacenter,
+            node_name=node_name if cfg.name else "",
+            node_class=cfg.client.node_class,
+            options=dict(cfg.client.options),
+            meta=dict(cfg.client.meta),
+            dev_mode=cfg.dev_mode,
+            consul_addr=cfg.consul.address,
+            consul_service=cfg.consul.server_service_name,
         )
-    )
-    client_agent.start()
-    # fs/stats endpoints are served off the co-located client.
-    http.client = client_agent
-    print(f"    Client node: {client_agent.node.id}")
+        if cfg.client.state_dir:
+            client_cfg.state_dir = cfg.client.state_dir
+        elif cfg.data_dir:
+            client_cfg.state_dir = os.path.join(cfg.data_dir, "client")
+        if cfg.client.alloc_dir:
+            client_cfg.alloc_dir = cfg.client.alloc_dir
+        elif cfg.data_dir:
+            client_cfg.alloc_dir = os.path.join(cfg.data_dir, "alloc")
+        for d in (client_cfg.state_dir, client_cfg.alloc_dir):
+            if d:
+                os.makedirs(d, exist_ok=True)
+        try:
+            client_agent = ClientAgent(client_cfg)
+            client_agent.start()
+        except (ValueError, APIError) as e:
+            print(f"error starting client: {e}", file=sys.stderr)
+            if client_agent is not None:
+                client_agent.shutdown()
+            if agent_syncer is not None:
+                agent_syncer.shutdown()
+            if http is not None:
+                http.stop()
+            if server is not None:
+                server.shutdown()
+            return 1
+        if http is not None:
+            # fs/stats endpoints are served off the co-located client.
+            http.client = client_agent
+        print(f"    Client node: {client_agent.node.id}")
+
     try:
         while True:
             time.sleep(1)
     except KeyboardInterrupt:
         print("\n==> Caught interrupt, shutting down...")
-        client_agent.shutdown(destroy_allocs=True)
+        if client_agent is not None:
+            client_agent.shutdown(destroy_allocs=cfg.dev_mode)
         if agent_syncer is not None:
             agent_syncer.shutdown()
-        http.stop()
-        server.shutdown()
+        if http is not None:
+            http.stop()
+        if server is not None:
+            server.shutdown()
     return 0
 
 
@@ -597,11 +738,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("agent", help="run an agent")
     p.add_argument("-dev", dest="dev", action="store_true")
+    p.add_argument("-config", dest="config", action="append", default=[],
+                   help="config file or directory (repeatable; merged in order)")
     p.add_argument("-statsd", dest="statsd", default="", help="statsd UDP addr host:port")
-    p.add_argument("-bind", dest="bind", default="127.0.0.1")
-    p.add_argument("-port", dest="port", type=int, default=4646)
-    p.add_argument("-num-schedulers", dest="num_schedulers", type=int, default=2)
-    p.add_argument("-region", dest="region", default="global")
+    p.add_argument("-bind", dest="bind", default="")
+    p.add_argument("-port", dest="port", type=int, default=0)
+    p.add_argument("-num-schedulers", dest="num_schedulers", type=int,
+                   default=None)
+    p.add_argument("-region", dest="region", default="")
     p.add_argument("-node-name", dest="node_name", default="",
                    help="unique agent name (default hostname-port)")
     p.add_argument("-join", dest="join", default="",
@@ -612,7 +756,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consul agent addr for service sync + discovery")
     p.add_argument("-advertise", dest="advertise", default="",
                    help="address advertised to consul (default: bind addr)")
-    p.add_argument("-log-level", dest="log_level", default="INFO")
+    p.add_argument("-log-level", dest="log_level", default="")
     p.set_defaults(fn=cmd_agent)
 
     p = sub.add_parser("init", help="create an example job file")

@@ -57,10 +57,11 @@ class ClientAgent:
         # alloc id -> consul service domains registered for its tasks;
         # guarded by _consul_lock (mutated from runner callback threads
         # and the alloc-watch thread). _consul_removed tombstones GC'd
-        # allocs so a late task-state callback can't re-register their
-        # services after removal.
+        # allocs (insertion-ordered dict used as a bounded set) so a
+        # late task-state callback can't re-register their services
+        # after removal.
         self._consul_domains: Dict[str, set] = {}
-        self._consul_removed: set = set()
+        self._consul_removed: Dict[str, None] = {}
         self._consul_lock = threading.Lock()
 
         if not config.alloc_dir:
@@ -121,8 +122,18 @@ class ClientAgent:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        self.heartbeat_ttl = self.api.nodes.register(self.node)
-        self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
+        try:
+            self.heartbeat_ttl = self.api.nodes.register(self.node)
+            self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
+        except APIError as e:
+            if e.status != 0:
+                raise  # the server rejected us: a real config problem
+            # Server unreachable at boot: rotate endpoints and let the
+            # heartbeat loop's re-register path bring us online
+            # (client.go registerAndHeartbeat retries forever).
+            self.logger.warning(
+                "initial registration failed (%s); will retry", e)
+            self._rpc_failed()
         # Vault tokens are derived through the server once the node has
         # an identity (client/vaultclient wiring, client.go:166).
         from .vaultclient import VaultClient
@@ -376,7 +387,12 @@ class ClientAgent:
         if self.syncer is None:
             return
         with self._consul_lock:
-            self._consul_removed.add(alloc_id)
+            self._consul_removed[alloc_id] = None
+            # The tombstone only needs to outlive in-flight task-state
+            # callbacks for its alloc — bound the set so a long-lived
+            # client with batch churn doesn't grow it forever.
+            while len(self._consul_removed) > 512:
+                self._consul_removed.pop(next(iter(self._consul_removed)))
             domains = self._consul_domains.pop(alloc_id, set())
         for domain in domains:
             self.syncer.remove_services(domain)

@@ -12,6 +12,7 @@ from .exec_driver import ExecDriver
 from .docker import DockerDriver
 from .java import JavaDriver
 from .qemu import QemuDriver
+from .rkt import RktDriver
 
 __all__ = [
     "Driver",
@@ -25,4 +26,5 @@ __all__ = [
     "DockerDriver",
     "JavaDriver",
     "QemuDriver",
+    "RktDriver",
 ]

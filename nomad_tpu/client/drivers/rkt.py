@@ -1,0 +1,139 @@
+"""rkt driver: run an appc/OCI pod image via the rkt CLI.
+
+Reference: client/driver/rkt.go:441 — fingerprint shells `rkt version`
+and requires a minimum rkt version (rkt.go:100-140); Start optionally
+trusts a key prefix (`rkt trust --prefix=`), then builds
+`rkt run <image>` with the alloc dir volume-mounted, --exec/args
+overrides, dns servers/search domains, --net and port forwards from
+port_map (rkt.go:150-330), all under the out-of-process executor.
+Config keys: image, command, args, trust_prefix, dns_servers,
+dns_search_domains, net, port_map, volumes, insecure_options, debug.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+from dataclasses import replace
+from typing import Optional
+
+from ...structs import Node, Task
+from .base import Driver, DriverHandle, TaskContext, register_driver
+
+RKT_BIN = "rkt"
+MIN_VERSION = (1, 0, 0)
+
+
+def _rkt_version(rkt: str) -> Optional[dict]:
+    try:
+        proc = subprocess.run(
+            [rkt, "version"], capture_output=True, text=True, timeout=10.0
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out = {}
+    m = re.search(r"rkt [Vv]ersion:?\s*([\d.]+)", proc.stdout)
+    if m:
+        out["version"] = m.group(1)
+    m = re.search(r"appc [Vv]ersion:?\s*([\d.+]+)", proc.stdout)
+    if m:
+        out["appc.version"] = m.group(1)
+    return out or None
+
+
+@register_driver
+class RktDriver(Driver):
+    name = "rkt"
+
+    def fingerprint(self, node: Node) -> bool:
+        rkt = shutil.which(RKT_BIN)
+        info = _rkt_version(rkt) if rkt else None
+        if info is None:
+            node.attributes.pop("driver.rkt", None)
+            return False
+        version = info.get("version", "0")
+        parts = tuple(int(p) for p in version.split(".")[:3] if p.isdigit())
+        if parts < MIN_VERSION:
+            # Old rkt lacks --net/--dns flags the driver uses (rkt.go
+            # minimum-version gate).
+            node.attributes.pop("driver.rkt", None)
+            return False
+        node.attributes["driver.rkt"] = "1"
+        node.attributes["driver.rkt.version"] = version
+        if "appc.version" in info:
+            node.attributes["driver.rkt.appc.version"] = info["appc.version"]
+        return True
+
+    def validate_config(self, task: Task) -> None:
+        if not (task.config or {}).get("image"):
+            raise ValueError(f"rkt task {task.name!r} missing 'image'")
+
+    def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
+        from ..executor import launch_executor
+
+        rkt = shutil.which(RKT_BIN)
+        if not rkt:
+            raise RuntimeError(f"{RKT_BIN} not found")
+        cfg = task.config or {}
+        image = cfg.get("image")
+        if not image:
+            raise ValueError(f"rkt task {task.name!r} missing 'image'")
+
+        # Establish trust for signed images before run (rkt.go:180-200).
+        trust_prefix = cfg.get("trust_prefix")
+        if trust_prefix:
+            subprocess.run(
+                [rkt, "trust", "--skip-fingerprint-review=true",
+                 f"--prefix={trust_prefix}"],
+                capture_output=True, timeout=30.0, check=False,
+            )
+
+        argv = ["run"]
+        for opt in cfg.get("insecure_options", []):
+            argv.append(f"--insecure-options={opt}")
+        if not trust_prefix and not cfg.get("insecure_options"):
+            # unsigned local images still need image verification off
+            argv.append("--insecure-options=image")
+        if cfg.get("debug"):
+            argv.append("--debug=true")
+
+        # Mount the alloc shared dir into the pod (rkt.go volume setup).
+        argv += [f"--volume=alloc,kind=host,source={ctx.alloc_dir}",
+                 "--mount=volume=alloc,target=/alloc"]
+        for i, vol in enumerate(cfg.get("volumes", [])):
+            # "host_path:container_path" pairs
+            host, _, container = str(vol).partition(":")
+            argv += [f"--volume=vol{i},kind=host,source={host}",
+                     f"--mount=volume=vol{i},target={container or host}"]
+
+        for server in cfg.get("dns_servers", []):
+            argv.append(f"--dns={server}")
+        for domain in cfg.get("dns_search_domains", []):
+            argv.append(f"--dns-search={domain}")
+        net = cfg.get("net")
+        if net:
+            argv.append(f"--net={','.join(net) if isinstance(net, list) else net}")
+        # Host-port forwards from the task's allocated ports
+        # (rkt.go:260-300 port_map handling).
+        for container_port, host_port in (cfg.get("port_map") or {}).items():
+            argv.append(f"--port={container_port}:{host_port}")
+
+        argv.append(image)
+        command = cfg.get("command")
+        if command:
+            argv.append(f"--exec={command}")
+        args = cfg.get("args", [])
+        if args:
+            argv.append("--")
+            argv += [str(a) for a in args]
+
+        exec_task = replace(task, config={"command": rkt, "args": argv})
+        return launch_executor(ctx, exec_task)
+
+    def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
+        from ..executor import reattach_executor
+
+        return reattach_executor(handle_id)

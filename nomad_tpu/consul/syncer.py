@@ -23,6 +23,15 @@ NOMAD_PREFIX = "_nomad"
 SYNC_INTERVAL = 5.0
 
 
+def instance_prefix(instance: str) -> str:
+    """Fixed-width hashed instance scope: no instance name can be a
+    string prefix of another's scope (names like "web" vs "web-2" would
+    collide if embedded raw), so reconcile can never reap across
+    scopes."""
+    iid = hashlib.sha1((instance or "default").encode()).hexdigest()[:8]
+    return f"{NOMAD_PREFIX}-i{iid}-"
+
+
 @dataclass
 class ConsulCheck:
     name: str = ""
@@ -48,11 +57,7 @@ class ConsulService:
     def service_id(self, domain: str, instance: str = "") -> str:
         key = f"{domain}-{self.name}-{','.join(sorted(self.tags))}-{self.port}"
         digest = hashlib.sha1(key.encode()).hexdigest()[:12]
-        # The "i" marker makes every instance scope a distinct, non-
-        # overlapping prefix — "default" is never a string prefix of
-        # another instance's ids, so reconcile can't cross scopes.
-        prefix = f"{NOMAD_PREFIX}-i{instance or 'default'}"
-        return f"{prefix}-{domain}-{self.name}-{digest}"
+        return f"{instance_prefix(instance)}{domain}-{self.name}-{digest}"
 
 
 class _ScriptCheckRunner:
@@ -245,7 +250,7 @@ class ConsulSyncer:
                 self._registered[sid] = payload
         # Deregister OUR stale services (matching instance scope) that
         # nobody wants anymore; other agents' registrations survive.
-        prefix = f"{NOMAD_PREFIX}-i{self.instance or 'default'}-"
+        prefix = instance_prefix(self.instance)
         for sid in have:
             if sid.startswith(prefix) and sid not in desired:
                 self.api.deregister_service(sid)

@@ -114,12 +114,61 @@ class StatsdSink:
         self._send(f"{name}:{v}|ms")
 
 
+class StatsiteSink:
+    """Statsite speaks the statsd line protocol over a persistent TCP
+    stream (go-metrics statsite.go). Reconnects lazily; telemetry
+    errors never propagate."""
+
+    def __init__(self, addr: str, timeout: float = 3.0):
+        host, _, port = addr.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 8125))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _send(self, payload: str) -> None:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self._addr, timeout=self._timeout)
+                self._sock.sendall((payload + "\n").encode())
+            except OSError:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+
+    def incr_counter(self, name: str, n: float) -> None:
+        self._send(f"{name}:{n}|c")
+
+    def set_gauge(self, name: str, v: float) -> None:
+        self._send(f"{name}:{v}|g")
+
+    def add_sample(self, name: str, v: float) -> None:
+        self._send(f"{name}:{v}|ms")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
 class Metrics:
     """Fanout front-end; the module-global instance is what call sites
     use (go-metrics global metrics object)."""
 
-    def __init__(self, prefix: str = "nomad_tpu"):
+    def __init__(self, prefix: str = "nomad_tpu", hostname: str = ""):
         self.prefix = prefix
+        # go-metrics tags gauges with the hostname unless
+        # disable_hostname is set (command.go:582-585).
+        self.hostname = hostname
         self.inmem = InmemSink()
         self._sinks: List[object] = [self.inmem]
         self._statsd_addrs: set = set()
@@ -136,9 +185,11 @@ class Metrics:
         self.add_sink(StatsdSink(addr))
 
     def _name(self, parts) -> str:
+        head = (f"{self.prefix}.{self.hostname}" if self.hostname
+                else self.prefix)
         if isinstance(parts, str):
-            return f"{self.prefix}.{parts}"
-        return ".".join([self.prefix, *parts])
+            return f"{head}.{parts}"
+        return ".".join([head, *parts])
 
     def incr_counter(self, parts, n: float = 1) -> None:
         name = self._name(parts)
@@ -170,15 +221,59 @@ def get_metrics() -> Metrics:
     return _global
 
 
-def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None) -> Metrics:
+def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None,
+              statsite_addr: Optional[str] = None,
+              disable_hostname: bool = True,
+              interval: Optional[float] = None) -> Metrics:
     """Re-init the global registry from agent telemetry config
-    (command.go:570 setupTelemetry)."""
+    (command.go:570 setupTelemetry): inmem sink always, statsd (UDP)
+    and statsite (TCP) fanout when configured, hostname tagging unless
+    disabled."""
+    import socket as _socket
+
     global _global
-    m = Metrics(prefix or "nomad_tpu")
+    hostname = "" if disable_hostname else _socket.gethostname()
+    m = Metrics(prefix or "nomad_tpu", hostname=hostname)
+    if interval:
+        m.inmem.interval = interval
     if statsd_addr:
         m.add_statsd(statsd_addr)
+    if statsite_addr:
+        m.add_sink(StatsiteSink(statsite_addr))
     _global = m
     return m
+
+
+def format_snapshot(snapshot: List[dict]) -> str:
+    """Human-readable dump of inmem intervals (go-metrics InmemSignal
+    output shape)."""
+    lines = []
+    for iv in snapshot:
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(iv["start"]))
+        lines.append(f"[{stamp}]")
+        for name, c in sorted(iv["counters"].items()):
+            lines.append(f"  counter {name}: count={c['count']} sum={c['sum']:g}")
+        for name, v in sorted(iv["gauges"].items()):
+            lines.append(f"  gauge {name}: {v:g}")
+        for name, s in sorted(iv["samples"].items()):
+            lines.append(
+                f"  sample {name}: count={s['count']} mean={s['mean']:.3f} "
+                f"min={s['min']:.3f} max={s['max']:.3f}")
+    return "\n".join(lines)
+
+
+def install_signal_dump(signum: Optional[int] = None) -> None:
+    """SIGUSR1 dumps the recent telemetry intervals to stderr
+    (command.go in-memory sink + InmemSignal). Main thread only."""
+    import signal
+    import sys
+
+    signum = signum or signal.SIGUSR1
+
+    def dump(_sig, _frame):
+        print(format_snapshot(_global.snapshot()), file=sys.stderr)
+
+    signal.signal(signum, dump)
 
 
 def incr_counter(parts, n: float = 1) -> None:

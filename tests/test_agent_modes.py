@@ -1,0 +1,148 @@
+"""External-binary agent tests: config-file-driven server-only and
+client-only agents wired into one cluster (mirror testutil/server.go's
+exec-a-real-binary harness and agent.go's server/client composition)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_http(url, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as resp:
+                return json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f"{url} never became ready: {last}")
+
+
+def spawn_agent(config_path, *extra):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.cli", "agent",
+         "-config", str(config_path), *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    return proc
+
+
+@pytest.fixture
+def server_client_cluster(tmp_path):
+    """A server-only agent and a client-only agent from config files."""
+    server_cfg = tmp_path / "server.hcl"
+    server_cfg.write_text("""
+        bind_addr = "127.0.0.1"
+        ports { http = 14846 }
+        server {
+          enabled        = true
+          num_schedulers = 1
+        }
+    """)
+    client_cfg = tmp_path / "client.json"
+    client_cfg.write_text(json.dumps({
+        "bind_addr": "127.0.0.1",
+        "client": {
+            "enabled": True,
+            "servers": ["127.0.0.1:14846"],
+            "state_dir": str(tmp_path / "state"),
+            "alloc_dir": str(tmp_path / "alloc"),
+            "node_class": "cfg-test",
+            "meta": {"origin": "configfile"},
+            "options": {"driver.raw_exec.enable": "1"},
+        },
+    }))
+    server = spawn_agent(server_cfg)
+    try:
+        wait_http("http://127.0.0.1:14846/v1/status/leader")
+        client = spawn_agent(client_cfg)
+        try:
+            yield server, client
+        finally:
+            client.terminate()
+            client.wait(timeout=10)
+    finally:
+        server.terminate()
+        server.wait(timeout=10)
+
+
+def test_server_only_and_client_only_agents(server_client_cluster, tmp_path):
+    server, client = server_client_cluster
+    # The client registers against the server-only agent with the
+    # attributes from its config file.
+    deadline = time.monotonic() + 20
+    nodes = []
+    while time.monotonic() < deadline:
+        nodes = wait_http("http://127.0.0.1:14846/v1/nodes")
+        if nodes and nodes[0].get("status") == "ready":
+            break
+        time.sleep(0.3)
+    assert nodes, "client never registered"
+    assert nodes[0]["node_class"] == "cfg-test"
+
+    node = wait_http(f"http://127.0.0.1:14846/v1/node/{nodes[0]['id']}")
+    assert node["meta"]["origin"] == "configfile"
+
+    # A job submitted to the server runs on the client-only agent.
+    jobfile = tmp_path / "job.hcl"
+    jobfile.write_text("""
+        job "cfgjob" {
+          datacenters = ["dc1"]
+          type = "batch"
+          group "g" {
+            restart { attempts = 0  mode = "fail" }
+            task "t" {
+              driver = "raw_exec"
+              config { command = "/bin/sh"  args = ["-c", "exit 0"] }
+              resources { cpu = 50  memory = 32 }
+            }
+          }
+        }
+    """)
+    out = subprocess.run(
+        [sys.executable, "-m", "nomad_tpu.cli",
+         "--address", "http://127.0.0.1:14846", "run", "-detach",
+         str(jobfile)],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    deadline = time.monotonic() + 30
+    final = None
+    while time.monotonic() < deadline:
+        allocs = wait_http(
+            "http://127.0.0.1:14846/v1/job/cfgjob/allocations")
+        if allocs and allocs[0]["client_status"] == "complete":
+            final = allocs[0]
+            break
+        time.sleep(0.3)
+    assert final is not None, "batch job never completed on client agent"
+
+
+def test_agent_requires_role(tmp_path):
+    """An agent with neither server nor client enabled refuses to start."""
+    cfg = tmp_path / "empty.hcl"
+    cfg.write_text('region = "eu"\n')
+    proc = spawn_agent(cfg)
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 1
+    assert "must have server, client, or both" in out
+
+
+def test_agent_bad_config_errors(tmp_path):
+    cfg = tmp_path / "bad.hcl"
+    cfg.write_text('nonsense_key = true\n')
+    proc = spawn_agent(cfg)
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 1
+    assert "unknown config keys" in out

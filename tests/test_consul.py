@@ -126,16 +126,19 @@ def test_syncer_removes_foreign_nomad_services_only():
     fake = FakeConsul()
     # A stale service from this agent's previous run, one from another
     # nomad instance, and one registered by an operator.
-    fake.register_service({"ID": "_nomad-idefault-stale", "Name": "old",
-                           "Port": 1})
-    fake.register_service({"ID": "_nomad-iother-live", "Name": "x", "Port": 2})
+    from nomad_tpu.consul.syncer import instance_prefix
+
+    mine = instance_prefix("") + "stale"
+    other = instance_prefix("other") + "live"
+    fake.register_service({"ID": mine, "Name": "old", "Port": 1})
+    fake.register_service({"ID": other, "Name": "x", "Port": 2})
     fake.register_service({"ID": "operator-svc", "Name": "db", "Port": 5432})
     syncer = ConsulSyncer(fake, sync_interval=0.05)
     syncer.set_services("agent", [ConsulService(name="nomad", port=4646)])
     syncer.sync()
     ids = set(fake.services())
-    assert "_nomad-idefault-stale" not in ids  # reaped: ours, not desired
-    assert "_nomad-iother-live" in ids  # another instance's: untouched
+    assert mine not in ids  # reaped: ours, not desired
+    assert other in ids  # another instance's: untouched
     assert "operator-svc" in ids  # untouched: not nomad-owned
 
 
@@ -153,11 +156,33 @@ def test_instance_scoped_syncers_do_not_reap_each_other():
     a.sync()  # must not reap b's registration
     assert len(fake.services()) == 2
     # A stale id from a's previous run IS reaped by a, not by b.
-    fake.register_service({"ID": "_nomad-inodeA-task-dead-x", "Name": "old"})
+    from nomad_tpu.consul.syncer import instance_prefix
+
+    stale_a = instance_prefix("nodeA") + "task-dead-x"
+    fake.register_service({"ID": stale_a, "Name": "old"})
     b.sync()
-    assert "_nomad-inodeA-task-dead-x" in fake.services()
+    assert stale_a in fake.services()
     a.sync()
-    assert "_nomad-inodeA-task-dead-x" not in fake.services()
+    assert stale_a not in fake.services()
+
+
+def test_hyphenated_instance_names_cannot_cross_reap():
+    """Instance 'web' must not reap instance 'web-2' ids even though a
+    raw embedding would make 'web' a string prefix of 'web-2'."""
+    from nomad_tpu.consul.syncer import instance_prefix
+
+    fake = FakeConsul()
+    web = ConsulSyncer(fake, instance="web")
+    web2 = ConsulSyncer(fake, instance="web-2")
+    web2.set_services("agent", [ConsulService(name="nomad", port=2)])
+    web2.sync()
+    assert len(fake.services()) == 1
+    assert not instance_prefix("web-2").startswith(
+        instance_prefix("web").rstrip("-"))
+    web.set_services("agent", [ConsulService(name="nomad", port=1)])
+    web.sync()  # must not touch web-2's registration
+    ids = set(fake.services())
+    assert len(ids) == 2
 
 
 def test_script_check_heartbeats_ttl():
@@ -247,10 +272,13 @@ def test_task_services_resolves_port_labels():
     assert svc.address == "10.1.2.3"
     assert svc.checks[0].port == 23456
     # Stable id derivation per domain + instance scope
+    from nomad_tpu.consul.syncer import instance_prefix
+
     assert svc.service_id("task-a1-web").startswith(
-        "_nomad-idefault-task-a1-web-")
+        instance_prefix("") + "task-a1-web-")
     assert svc.service_id("task-a1-web", "n1").startswith(
-        "_nomad-in1-task-a1-web-")
+        instance_prefix("n1") + "task-a1-web-")
+    assert svc.service_id("task-a1-web") != svc.service_id("task-a1-web", "n1")
 
 
 # ---------------------------------------------------- discovery + list

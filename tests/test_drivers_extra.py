@@ -241,3 +241,129 @@ def test_qemu_missing_image_rejected():
     task = Task(name="vm", driver="qemu", config={})
     with pytest.raises(ValueError):
         QemuDriver().validate_config(task)
+
+
+# ------------------------------------------------------------------- rkt
+
+RKT_STUB = """
+if [ "$1" = "version" ]; then
+  echo "rkt Version: 1.29.0"
+  echo "appc Version: 0.8.11"
+  exit 0
+fi
+echo "$@" >> "$STUB_LOG"
+exit 0
+"""
+
+RKT_OLD_STUB = """
+if [ "$1" = "version" ]; then
+  echo "rkt Version: 0.14.0"
+  exit 0
+fi
+exit 0
+"""
+
+
+@pytest.fixture
+def rkt_stub(stub_path, tmp_path, monkeypatch):
+    log = tmp_path / "rkt.log"
+    monkeypatch.setenv("STUB_LOG", str(log))
+    write_stub(stub_path, "rkt", RKT_STUB)
+    return log
+
+
+def test_rkt_fingerprint(rkt_stub):
+    from nomad_tpu.client.drivers import RktDriver
+
+    node = mock.node()
+    assert RktDriver().fingerprint(node) is True
+    assert node.attributes["driver.rkt"] == "1"
+    assert node.attributes["driver.rkt.version"] == "1.29.0"
+    assert node.attributes["driver.rkt.appc.version"] == "0.8.11"
+
+
+def test_rkt_fingerprint_version_gate(stub_path, tmp_path, monkeypatch):
+    """rkt below the minimum version is not advertised (rkt.go
+    minimum-version gate)."""
+    from nomad_tpu.client.drivers import RktDriver
+
+    monkeypatch.setenv("STUB_LOG", str(tmp_path / "rkt.log"))
+    write_stub(stub_path, "rkt", RKT_OLD_STUB)
+    node = mock.node()
+    node.attributes["driver.rkt"] = "1"  # from a previous fingerprint
+    assert RktDriver().fingerprint(node) is False
+    assert "driver.rkt" not in node.attributes
+
+
+def test_rkt_fingerprint_absent(tmp_path, monkeypatch):
+    from nomad_tpu.client.drivers import RktDriver
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # no rkt anywhere
+    node = mock.node()
+    assert RktDriver().fingerprint(node) is False
+
+
+def test_rkt_start_builds_command(rkt_stub, tmp_path):
+    from nomad_tpu.client.drivers import RktDriver
+
+    ctx = make_ctx(tmp_path)
+    task = Task(
+        name="pod", driver="rkt",
+        config={"image": "coreos.com/etcd:v2.0.4",
+                "command": "/etcd",
+                "args": ["--version"],
+                "dns_servers": ["8.8.8.8"],
+                "net": "host",
+                "port_map": {"http": 8080},
+                "volumes": ["/tmp/data:/data"]},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    handle = RktDriver().start(ctx, task)
+    try:
+        res = handle.wait(timeout=15.0)
+        assert res is not None and res.successful()
+        line = rkt_stub.read_text()
+        assert line.startswith("run ")
+        assert "--insecure-options=image" in line
+        assert "coreos.com/etcd:v2.0.4" in line
+        assert "--exec=/etcd" in line
+        assert "--dns=8.8.8.8" in line
+        assert "--net=host" in line
+        assert "--port=http:8080" in line
+        assert "source=/tmp/data" in line and "target=/data" in line
+        assert "--mount=volume=alloc,target=/alloc" in line
+        assert line.rstrip().endswith("-- --version")
+    finally:
+        handle.kill(1.0)
+
+
+def test_rkt_trust_prefix_invoked(rkt_stub, tmp_path):
+    from nomad_tpu.client.drivers import RktDriver
+
+    ctx = make_ctx(tmp_path)
+    task = Task(
+        name="pod", driver="rkt",
+        config={"image": "example.com/app", "trust_prefix": "example.com"},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    handle = RktDriver().start(ctx, task)
+    try:
+        handle.wait(timeout=15.0)
+        lines = rkt_stub.read_text().splitlines()
+        assert any(l.startswith("trust ") and "--prefix=example.com" in l
+                   for l in lines)
+        run_line = next(l for l in lines if l.startswith("run "))
+        # trusted images don't get the insecure fallback
+        assert "--insecure-options" not in run_line
+    finally:
+        handle.kill(1.0)
+
+
+def test_rkt_missing_image_rejected():
+    from nomad_tpu.client.drivers import RktDriver
+
+    task = Task(name="pod", driver="rkt", config={})
+    with pytest.raises(ValueError):
+        RktDriver().validate_config(task)

@@ -109,3 +109,93 @@ def test_server_emits_worker_and_fsm_samples():
     finally:
         s.shutdown()
         gm.configure()  # reset global for other tests
+
+
+def test_statsite_sink_tcp():
+    """Statsite speaks statsd lines over persistent TCP
+    (go-metrics statsite.go)."""
+    import socket
+    import threading
+
+    from nomad_tpu.utils.metrics import StatsiteSink
+
+    received = []
+    ready = threading.Event()
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def accept():
+        ready.set()
+        conn, _ = srv.accept()
+        buf = b""
+        while b"\n" not in buf or buf.count(b"\n") < 3:
+            data = conn.recv(4096)
+            if not data:
+                break
+            buf += data
+        received.extend(buf.decode().strip().splitlines())
+        conn.close()
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    ready.wait(2.0)
+
+    sink = StatsiteSink(f"127.0.0.1:{port}")
+    sink.incr_counter("a.b", 2)
+    sink.set_gauge("a.g", 7.5)
+    sink.add_sample("a.s", 12.0)
+    t.join(timeout=3.0)
+    sink.close()
+    srv.close()
+    assert "a.b:2|c" in received
+    assert "a.g:7.5|g" in received
+    assert "a.s:12.0|ms" in received
+
+
+def test_statsite_sink_survives_down_target():
+    from nomad_tpu.utils.metrics import StatsiteSink
+
+    sink = StatsiteSink("127.0.0.1:1")  # nothing listens there
+    sink.incr_counter("x", 1)  # must not raise
+    sink.close()
+
+
+def test_hostname_tagging():
+    from nomad_tpu.utils.metrics import Metrics
+
+    m = Metrics("nomad_tpu", hostname="host1")
+    m.incr_counter("worker.dequeue", 1)
+    snap = m.snapshot()
+    names = set()
+    for iv in snap:
+        names |= set(iv["counters"])
+    assert "nomad_tpu.host1.worker.dequeue" in names
+
+
+def test_format_snapshot():
+    from nomad_tpu.utils.metrics import Metrics, format_snapshot
+
+    m = Metrics("t")
+    m.incr_counter("c1", 3)
+    m.set_gauge("g1", 9)
+    m.add_sample("s1", 4.5)
+    text = format_snapshot(m.snapshot())
+    assert "counter t.c1: count=1 sum=3" in text
+    assert "gauge t.g1: 9" in text
+    assert "sample t.s1: count=1 mean=4.500" in text
+
+
+def test_configure_full():
+    import nomad_tpu.utils.metrics as gm
+
+    m = gm.configure(statsd_addr="127.0.0.1:18125",
+                     statsite_addr="",
+                     disable_hostname=False, interval=5.0)
+    try:
+        assert m.hostname  # hostname tagging on
+        assert m.inmem.interval == 5.0
+        m.incr_counter("x", 1)  # statsd UDP send must not raise
+    finally:
+        gm.configure()
