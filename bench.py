@@ -1,18 +1,27 @@
 """Benchmark: scheduler placement throughput, CPU iterator stack vs
-batched TPU kernel.
+batched TPU kernel, across the BASELINE.md config matrix.
 
-Scenario (BASELINE.md config 2): 1k-node cluster, evals placing a
-batch job via CPU+mem bin-packing. The CPU baseline runs the reference
-iterator pipeline (stack.select per placement); the TPU path runs the
-same placements as one batched dense program (ops/binpack.py), B evals
-vmapped per dispatch — the broker drain-to-batch design from
-BASELINE.json's north star.
+Configs (BASELINE.md "Numbers we must produce"):
+  1  100 nodes, service job with 3 task groups (smoke)
+  2  1k nodes, batch job, CPU+mem bin-pack only          <- default headline
+  3  5k nodes, datacenter + meta constraints, mixed service/batch
+  4  10k nodes, 50k existing allocs, ports + distinct_hosts (north star)
+  5  system drain storm: system jobs replanned on node drain (CPU path;
+     system scheduling is pinned-placement, no search to accelerate)
 
-Prints ONE JSON line:
-  {"metric": ..., "value": evals_per_sec_tpu, "unit": "evals/sec",
-   "vs_baseline": tpu/cpu}
+The CPU baseline runs the reference iterator pipeline (stack.select per
+placement, scheduler/stack.go:37); the TPU path runs the same
+placements as one batched dense program (ops/binpack.py), B evals
+vmapped per dispatch against a shared on-device cluster matrix — the
+broker drain-to-batch design from BASELINE.json's north star.
+
+Usage:
+  python bench.py            # headline config, ONE JSON line
+  python bench.py --config 4 # one config, ONE JSON line
+  python bench.py --all      # full matrix, one JSON line per config
 """
 
+import argparse
 import json
 import random
 import sys
@@ -22,55 +31,113 @@ sys.path.insert(0, ".")
 
 import numpy as np
 
-N_NODES = 1000
-K_PLACEMENTS = 8  # allocs placed per eval
-CPU_EVALS = 30  # evals timed on the CPU path
-TPU_BATCH = 2048  # evals per TPU dispatch
-TPU_ROUNDS = 8  # timed dispatches (after warmup)
+HEADLINE_CONFIG = 4  # the north-star 10k-node/50k-alloc scenario
 
 
-def build_cluster():
+# ------------------------------------------------------------- builders
+
+
+def build_cluster(n_nodes, datacenters=("dc1",), meta_partitions=0,
+                  allocs_per_node=0, seed=0):
+    """A mock cluster: nodes spread over datacenters, optional 'rack'
+    meta partitions (stack_test.go's 64-way partition shape), optional
+    pre-existing allocations consuming capacity."""
     from nomad_tpu import mock
     from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import consts
 
+    rng = random.Random(seed)
     store = StateStore()
-    for i in range(N_NODES):
+    index = 0
+    filler = None
+    if allocs_per_node:
+        filler = mock.job()
+        filler.id = "filler"
+        filler.type = "service"
+        filler.task_groups[0].tasks[0].resources.networks = []
+    for i in range(n_nodes):
         node = mock.node()
-        store.upsert_node(i + 1, node)
+        node.datacenter = datacenters[i % len(datacenters)]
+        if meta_partitions:
+            node.meta["rack"] = f"r{i % meta_partitions}"
+        node.compute_class()
+        index += 1
+        store.upsert_node(index, node)
+        if allocs_per_node:
+            allocs = []
+            for _ in range(allocs_per_node):
+                alloc = mock.alloc()
+                alloc.node_id = node.id
+                alloc.job_id = filler.id
+                alloc.job = filler
+                alloc.desired_status = consts.ALLOC_DESIRED_RUN
+                alloc.client_status = consts.ALLOC_CLIENT_RUNNING
+                # modest footprint so nodes stay schedulable
+                for tr in alloc.task_resources.values():
+                    tr.cpu = rng.choice([50, 100])
+                    tr.memory_mb = rng.choice([64, 128])
+                    tr.networks = []
+                alloc.resources = None
+                allocs.append(alloc)
+            index += 1
+            store.upsert_allocs(index, allocs)
+    return store, index
+
+
+def service_job(n_groups=1, constraints=None, networks=True,
+                distinct_hosts=False, job_type="service"):
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Constraint, consts
+
     job = mock.job()
-    job.type = "batch"
-    job.task_groups[0].count = K_PLACEMENTS
-    # config 2 is CPU+mem only: strip the network ask
-    job.task_groups[0].tasks[0].resources.networks = []
-    store.upsert_job(N_NODES + 1, job)
-    return store, job
+    job.type = job_type
+    tg0 = job.task_groups[0]
+    job.task_groups = []
+    for gi in range(n_groups):
+        tg = tg0.copy()
+        tg.name = f"g{gi}"
+        if not networks:
+            tg.tasks[0].resources.networks = []
+        if distinct_hosts:
+            tg.constraints.append(
+                Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS))
+        job.task_groups.append(tg)
+    for c in constraints or []:
+        job.constraints.append(c)
+    return job
 
 
-def bench_cpu(store, job):
+# ---------------------------------------------------------------- paths
+
+
+def bench_cpu(store, job, k_placements, evals, tg_cycle=None):
     """Reference pipeline: per-eval stack.select loop."""
     from nomad_tpu.scheduler.context import EvalContext
     from nomad_tpu.scheduler.stack import GenericStack
     from nomad_tpu.scheduler.util import ready_nodes_in_dcs
-    from nomad_tpu.structs import Plan
+    from nomad_tpu.structs import Allocation, Plan
+    from nomad_tpu.utils.ids import generate_uuid
 
     snap = store.snapshot()
+    groups = job.task_groups
+    tg_cycle = tg_cycle or [0] * k_placements
     latencies = []
+    placed = 0
     start = time.perf_counter()
-    for i in range(CPU_EVALS):
+    for i in range(evals):
         t0 = time.perf_counter()
         plan = Plan(job=job)
         ctx = EvalContext(snap, plan, rng=random.Random(i))
-        stack = GenericStack(True, ctx)
+        stack = GenericStack(job.type == "batch", ctx)
         stack.set_job(job)
         nodes, _ = ready_nodes_in_dcs(snap, job.datacenters)
         stack.set_nodes(nodes)
-        tg = job.task_groups[0]
-        for _ in range(K_PLACEMENTS):
+        for gi in tg_cycle:
+            tg = groups[gi]
             option, _ = stack.select(tg)
-            assert option is not None
-            from nomad_tpu.structs import Allocation
-            from nomad_tpu.utils.ids import generate_uuid
-
+            if option is None:
+                continue
+            placed += 1
             plan.append_alloc(
                 Allocation(
                     id=generate_uuid(),
@@ -82,11 +149,14 @@ def bench_cpu(store, job):
             )
         latencies.append(time.perf_counter() - t0)
     elapsed = time.perf_counter() - start
-    return CPU_EVALS / elapsed, latencies
+    assert placed == evals * len(tg_cycle), (
+        f"cpu path placed {placed}/{evals * len(tg_cycle)}")
+    return evals / elapsed, float(np.percentile(latencies, 99))
 
 
-def bench_tpu(store, job):
-    """Batched dense program: TPU_BATCH evals per dispatch."""
+def bench_tpu(store, job, k_placements, batch, rounds, tg_cycle=None,
+              require_all=True):
+    """Batched dense program: `batch` evals per dispatch."""
     import jax
 
     from nomad_tpu.models.matrix import ClusterMatrix
@@ -104,24 +174,27 @@ def bench_tpu(store, job):
         matrix.bw_avail, matrix.bw_used, matrix.ports_free,
         matrix.job_count, matrix.tg_count, matrix.feasible, matrix.node_ok,
     )
-    asks = make_asks(*matrix.build_asks([0] * K_PLACEMENTS))
+    tg_cycle = tg_cycle or [0] * k_placements
+    asks = make_asks(*matrix.build_asks(tg_cycle))
 
     # The cluster matrix lives on device across dispatches (it changes
     # only when the snapshot does); per dispatch only keys move.
     state = jax.tree.map(jax.device_put, state)
     asks = jax.tree.map(jax.device_put, asks)
-    config = PlacementConfig(anti_affinity_penalty=5.0)
+    penalty = 5.0 if job.type == "batch" else 10.0
+    config = PlacementConfig(anti_affinity_penalty=penalty)
 
     def dispatch(seed):
-        keys = jax.random.split(jax.random.PRNGKey(seed), TPU_BATCH)
+        keys = jax.random.split(jax.random.PRNGKey(seed), batch)
         choices, scores, _ = batched_placement_program_shared(
             state, asks, keys, config
         )
         return choices
 
-    # Warmup / compile
     warm = np.asarray(dispatch(0))
-    assert (warm >= 0).all(), "warmup produced failed placements"
+    if require_all:
+        assert (warm[:, : len(tg_cycle)] >= 0).all(), \
+            "warmup produced failed placements"
 
     # Latency: one synchronous round including its result fetch — the
     # submit-to-answer time every eval in that batch observes.
@@ -133,37 +206,166 @@ def bench_tpu(store, job):
     # them) and fetch all results in one device->host transfer — the
     # broker sidecar streams results the same way.
     start = time.perf_counter()
-    outs = [dispatch(r + 2) for r in range(TPU_ROUNDS)]
+    outs = [dispatch(r + 2) for r in range(rounds)]
     results = [np.asarray(o) for o in outs]
     elapsed = time.perf_counter() - start
-    for out in results:
-        assert (out >= 0).all()
-    evals_per_sec = TPU_BATCH * TPU_ROUNDS / elapsed
-    return evals_per_sec, sync_latency
+    if require_all:
+        for out in results:
+            assert (out[:, : len(tg_cycle)] >= 0).all()
+    return batch * rounds / elapsed, sync_latency
+
+
+# -------------------------------------------------------------- configs
+
+
+def config_1():
+    """100-node smoke: service job, 3 task groups."""
+    store, _ = build_cluster(100)
+    job = service_job(n_groups=3, networks=False)
+    cycle = [0, 1, 2] * 2  # 6 placements across the 3 groups
+    cpu_rate, cpu_p99 = bench_cpu(store, job, len(cycle), evals=50,
+                                  tg_cycle=cycle)
+    tpu_rate, tpu_p99 = bench_tpu(store, job, len(cycle), batch=2048,
+                                  rounds=8, tg_cycle=cycle)
+    return "100 nodes, service x3 task groups", cpu_rate, cpu_p99, \
+        tpu_rate, tpu_p99
+
+
+def config_2():
+    """1k nodes, batch, CPU+mem only."""
+    store, _ = build_cluster(1000)
+    job = service_job(networks=False, job_type="batch")
+    job.task_groups[0].count = 8
+    cpu_rate, cpu_p99 = bench_cpu(store, job, 8, evals=30)
+    tpu_rate, tpu_p99 = bench_tpu(store, job, 8, batch=2048, rounds=8)
+    return "1k nodes x 8 allocs/eval (cpu+mem bin-pack)", cpu_rate, \
+        cpu_p99, tpu_rate, tpu_p99
+
+
+def config_3():
+    """5k nodes, dc + meta constraints, mixed service/batch."""
+    from nomad_tpu.structs import Constraint
+
+    store, _ = build_cluster(
+        5000, datacenters=("dc1", "dc2", "dc3", "dc4"), meta_partitions=64)
+    cons = [Constraint(ltarget="${meta.rack}", operand="regexp",
+                       rtarget="^r(1?[0-9]|2[0-9]|3[01])$")]  # racks 0-31
+    svc = service_job(constraints=cons, networks=False)
+    svc.datacenters = ["dc1", "dc2"]
+    bat = service_job(constraints=cons, networks=False, job_type="batch")
+    bat.datacenters = ["dc3", "dc4"]
+
+    cpu_s, cpu_p99_s = bench_cpu(store, svc, 8, evals=10)
+    cpu_b, cpu_p99_b = bench_cpu(store, bat, 8, evals=10)
+    tpu_s, tpu_p99_s = bench_tpu(store, svc, 8, batch=1024, rounds=4)
+    tpu_b, tpu_p99_b = bench_tpu(store, bat, 8, batch=1024, rounds=4)
+    # mixed workload: aggregate rate = half service + half batch
+    cpu_rate = 2.0 / (1.0 / cpu_s + 1.0 / cpu_b)
+    tpu_rate = 2.0 / (1.0 / tpu_s + 1.0 / tpu_b)
+    return "5k nodes, dc + rack-regexp constraints, mixed svc/batch", \
+        cpu_rate, max(cpu_p99_s, cpu_p99_b), tpu_rate, \
+        max(tpu_p99_s, tpu_p99_b)
+
+
+def config_4():
+    """North star: 10k nodes, 50k existing allocs, dynamic ports +
+    distinct_hosts."""
+    store, _ = build_cluster(10_000, datacenters=("dc1", "dc2"),
+                             allocs_per_node=5)
+    job = service_job(networks=True, distinct_hosts=True)
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    cpu_rate, cpu_p99 = bench_cpu(store, job, 8, evals=5)
+    tpu_rate, tpu_p99 = bench_tpu(store, job, 8, batch=512, rounds=4)
+    return "10k nodes, 50k allocs, ports + distinct_hosts", cpu_rate, \
+        cpu_p99, tpu_rate, tpu_p99
+
+
+def config_5():
+    """System drain storm: every system job replans when nodes drain.
+    System scheduling pins each placement to its node (no search), so
+    this measures the CPU reference path end-to-end; the TPU column
+    reports the same number (nothing to accelerate — util.go:170)."""
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.testing import Harness
+    from nomad_tpu.structs import consts
+
+    n_nodes, n_jobs = 1000, 50  # scaled drain storm
+    harness = Harness()
+    store = harness.state
+    index = 0
+    for i in range(n_nodes):
+        node = mock.node()
+        node.compute_class()
+        index += 1
+        store.upsert_node(index, node)
+    jobs = []
+    for j in range(n_jobs):
+        job = mock.system_job()
+        job.id = f"sys-{j}"
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].tasks[0].resources.cpu = 5
+        job.task_groups[0].tasks[0].resources.memory_mb = 8
+        index += 1
+        store.upsert_job(index, job)
+        jobs.append(job)
+
+    # Drain 10% of nodes -> server creates one eval per system job
+    # (node_endpoint.go:812 createNodeEvals).
+    drained = store.nodes()[: n_nodes // 10]
+    for node in drained:
+        index += 1
+        store.update_node_drain(index, node.id, True)
+
+    evals = []
+    for job in jobs:
+        ev = mock.eval()
+        ev.job_id = job.id
+        ev.type = consts.JOB_TYPE_SYSTEM
+        ev.triggered_by = consts.EVAL_TRIGGER_NODE_UPDATE
+        evals.append(ev)
+
+    latencies = []
+    start = time.perf_counter()
+    for ev in evals:
+        t0 = time.perf_counter()
+        harness.process("system", ev)
+        latencies.append(time.perf_counter() - t0)
+    elapsed = time.perf_counter() - start
+    rate = len(evals) / elapsed
+    p99 = float(np.percentile(latencies, 99))
+    return (f"drain storm: {n_nodes} nodes x {n_jobs} system jobs, "
+            f"10% drained (cpu reference path)"), rate, p99, rate, p99
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+
+
+def run_config(n):
+    name, cpu_rate, cpu_p99, tpu_rate, tpu_p99 = CONFIGS[n]()
+    return {
+        "metric": (
+            f"[config {n}] {name}; cpu={cpu_rate:.1f} evals/s "
+            f"p99={cpu_p99 * 1000:.1f}ms, tpu p99/batch={tpu_p99 * 1000:.1f}ms"
+        ),
+        "value": round(tpu_rate, 1),
+        "unit": "evals/sec",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+    }
 
 
 def main():
-    store, job = build_cluster()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, default=HEADLINE_CONFIG,
+                        choices=sorted(CONFIGS))
+    parser.add_argument("--all", action="store_true")
+    args = parser.parse_args()
 
-    cpu_rate, cpu_lat = bench_cpu(store, job)
-    tpu_rate, tpu_p99 = bench_tpu(store, job)
-    cpu_p99 = float(np.percentile(cpu_lat, 99))
-
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"scheduler placement throughput, {N_NODES} nodes x "
-                    f"{K_PLACEMENTS} allocs/eval (cpu+mem bin-pack); "
-                    f"cpu={cpu_rate:.1f} evals/s p99={cpu_p99*1000:.1f}ms, "
-                    f"tpu p99/batch={tpu_p99*1000:.1f}ms"
-                ),
-                "value": round(tpu_rate, 1),
-                "unit": "evals/sec",
-                "vs_baseline": round(tpu_rate / cpu_rate, 2),
-            }
-        )
-    )
+    if args.all:
+        for n in sorted(CONFIGS):
+            print(json.dumps(run_config(n)))
+    else:
+        print(json.dumps(run_config(args.config)))
 
 
 if __name__ == "__main__":

@@ -49,7 +49,12 @@ class RawResponse:
 class HTTPServer:
     """Embeds the server; serves the public API on localhost. When a
     co-located client agent is attached (dev agent), the /v1/client/*
-    fs + stats endpoints are served too (command/agent/fs_endpoint.go)."""
+    fs + stats endpoints are served too (command/agent/fs_endpoint.go).
+
+    `server` may be None for a client-only agent: every agent serves
+    HTTP in the reference (agent.go), and a client-only node must still
+    expose its fs/logs/stats endpoints — server-backed routes answer
+    501 there."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0, client=None):
         self.server = server
@@ -74,7 +79,8 @@ class HTTPServer:
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": str(e)})
                 else:
-                    index = api.server.fsm.state.latest_index()
+                    index = (api.server.fsm.state.latest_index()
+                             if api.server is not None else 0)
                     self._reply(200, body, index)
                 metrics.measure_since(("http", "request"), _start)
 
@@ -126,7 +132,8 @@ class HTTPServer:
         # Cross-region forwarding (rpc.go:178,263 forwardRegion): if the
         # request names another region, proxy it to a server there.
         region = query.get("region", [None])[0]
-        if region and region != self.server.config.region:
+        if (region and self.server is not None
+                and region != self.server.config.region):
             return self._forward_region(region, method, parsed, body)
 
         route_handlers: List[Tuple[str, Callable]] = [
@@ -170,9 +177,17 @@ class HTTPServer:
             (r"^/v1/client/stats$", self._client_stats),
             (r"^/v1/client/allocation/(?P<alloc_id>[^/]+)/stats$", self._client_alloc_stats),
         ]
+        client_only_ok = {
+            self._fs_ls, self._fs_stat, self._fs_cat, self._fs_readat,
+            self._fs_logs, self._client_stats, self._client_alloc_stats,
+            self._agent_self, self._agent_servers,
+        }
         for pattern, handler in route_handlers:
             m = re.match(pattern, path)
             if m:
+                if self.server is None and handler not in client_only_ok:
+                    raise HTTPError(
+                        501, "server not enabled on this agent")
                 return handler(method, query, body, **m.groupdict())
         raise HTTPError(404, f"no handler for {path!r}")
 
@@ -419,11 +434,13 @@ class HTTPServer:
         return [self.addr]
 
     def _agent_self(self, method, query, body):
-        return {
-            "stats": self.server.stats(),
-            "config": to_dict(self.server.config),
-            "metrics": metrics.get_metrics().snapshot(),
-        }
+        out = {"metrics": metrics.get_metrics().snapshot()}
+        if self.server is not None:
+            out["stats"] = self.server.stats()
+            out["config"] = to_dict(self.server.config)
+        if self.client is not None:
+            out["client"] = self.client.stats()
+        return out
 
     def _system_gc(self, method, query, body):
         self.server.force_gc()
@@ -498,6 +515,9 @@ class HTTPServer:
         return {}
 
     def _agent_servers(self, method, query, body):
+        if self.server is None:
+            # client-only agent: the servers it talks to
+            return self.client.servers.all() if self.client else []
         members = [
             m for m in self.server.serf_members()
             if m.region == self.server.config.region and m.status == "alive"

@@ -286,6 +286,11 @@ def merge_config(a: AgentConfig, b: AgentConfig) -> AgentConfig:
         val = copy.deepcopy(getattr(obj, parts[-1]))
         if isinstance(val, dict):
             getattr(dst, parts[-1]).update(val)
+        elif dotted in ("server.retry_join", "server.start_join"):
+            # Join seed lists accumulate across files (config.go Merge
+            # appends); other lists follow later-file-wins.
+            merged = getattr(dst, parts[-1]) + val
+            setattr(dst, parts[-1], list(dict.fromkeys(merged)))
         else:
             setattr(dst, parts[-1], val)
         out.set_keys.add(dotted)

@@ -611,12 +611,18 @@ def cmd_agent(args) -> int:
             server_cfg.heartbeat_grace = heartbeat_grace
         if node_gc_threshold is not None:
             server_cfg.node_gc_threshold = node_gc_threshold
+        if "vault.enabled" in cfg.set_keys:
+            server_cfg.vault_enabled = cfg.vault.enabled
         server = Server(server_cfg)
         server.start()
         http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http)
         http.start()
         server_addr = http.addr
-        serf_addr = server.setup_serf(host=cfg.bind_addr, http_addr=http.addr)
+        # Gossip peers and federated regions must receive a routable
+        # address, not a wildcard bind (server.go setupSerf tags).
+        advertised_http = f"http://{_advertise_addr(cfg)}:{http.port}"
+        serf_addr = server.setup_serf(host=cfg.bind_addr,
+                                      http_addr=advertised_http)
         if cfg.server.start_join:
             joined = server.serf_join(cfg.server.start_join)
             print(f"==> Joined {joined} gossip peers")
@@ -643,24 +649,6 @@ def cmd_agent(args) -> int:
         print(f"    Gossip: {serf_addr} (region {cfg.region})")
         print(f"    Scheduler factories: {scheduler_factories or 'cpu defaults'}")
 
-    # Agent-level consul registration: advertise this agent's HTTP
-    # endpoint under the configured catalog service so clients can
-    # bootstrap through discovery (consul/syncer.go agent services).
-    agent_syncer = None
-    if cfg.consul.address and cfg.consul.auto_advertise:
-        from ..consul import ConsulAPI, ConsulService, ConsulSyncer
-
-        consul_api = ConsulAPI(cfg.consul.address)
-        agent_syncer = ConsulSyncer(consul_api, address=cfg.consul.address,
-                                    instance=node_name)
-        services = []
-        if server is not None:
-            services.append(ConsulService(
-                name=cfg.consul.server_service_name, tags=["http"],
-                port=http.port, address=_advertise_addr(cfg)))
-        agent_syncer.set_services("agent", services)
-        agent_syncer.start()
-
     client_agent = None
     if cfg.client.enabled:
         servers = list(cfg.client.servers)
@@ -677,7 +665,18 @@ def cmd_agent(args) -> int:
             dev_mode=cfg.dev_mode,
             consul_addr=cfg.consul.address,
             consul_service=cfg.consul.server_service_name,
+            network_speed=cfg.client.network_speed,
         )
+        if cfg.client.reserved:
+            from ..structs import Resources
+
+            res = cfg.client.reserved
+            client_cfg.reserved = Resources(
+                cpu=int(res.get("cpu", 0)),
+                memory_mb=int(res.get("memory", 0)),
+                disk_mb=int(res.get("disk", 0)),
+                iops=int(res.get("iops", 0)),
+            )
         if cfg.client.state_dir:
             client_cfg.state_dir = cfg.client.state_dir
         elif cfg.data_dir:
@@ -696,17 +695,44 @@ def cmd_agent(args) -> int:
             print(f"error starting client: {e}", file=sys.stderr)
             if client_agent is not None:
                 client_agent.shutdown()
-            if agent_syncer is not None:
-                agent_syncer.shutdown()
             if http is not None:
                 http.stop()
             if server is not None:
                 server.shutdown()
             return 1
-        if http is not None:
+        if http is None:
+            # Every agent serves HTTP (agent.go): a client-only node
+            # still exposes its fs/logs/stats endpoints.
+            http = HTTPServer(None, host=cfg.bind_addr,
+                              port=cfg.ports.http, client=client_agent)
+            http.start()
+            print(f"==> nomad-tpu agent started (client)! HTTP: {http.addr}")
+        else:
             # fs/stats endpoints are served off the co-located client.
             http.client = client_agent
         print(f"    Client node: {client_agent.node.id}")
+
+    # Agent-level consul registration: advertise this agent's HTTP
+    # endpoint under the configured catalog services so clients can
+    # bootstrap through discovery (consul/syncer.go agent services).
+    agent_syncer = None
+    if cfg.consul.address and cfg.consul.auto_advertise:
+        from ..consul import ConsulAPI, ConsulService, ConsulSyncer
+
+        consul_api = ConsulAPI(cfg.consul.address)
+        agent_syncer = ConsulSyncer(consul_api, address=cfg.consul.address,
+                                    instance=node_name)
+        services = []
+        if server is not None:
+            services.append(ConsulService(
+                name=cfg.consul.server_service_name, tags=["http"],
+                port=http.port, address=_advertise_addr(cfg)))
+        if client_agent is not None:
+            services.append(ConsulService(
+                name=cfg.consul.client_service_name, tags=["http"],
+                port=http.port, address=_advertise_addr(cfg)))
+        agent_syncer.set_services("agent", services)
+        agent_syncer.start()
 
     try:
         while True:

@@ -104,6 +104,9 @@ class ClientAgent:
         for k, v in self.config.options.items():
             node.attributes[k] = v
         fingerprint_node(node)
+        if self.config.network_speed:
+            for net in node.resources.networks:
+                net.mbits = self.config.network_speed
         if self.consul is not None:
             fingerprint_consul(node, self.consul)
         if self.config.node_name:

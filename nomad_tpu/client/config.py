@@ -38,3 +38,6 @@ class ClientConfig:
     consul_api: Optional[object] = None
     # Catalog service name nomad servers register under.
     consul_service: str = "nomad"
+    # Override the fingerprinted network link speed in mbits
+    # (client config network_speed).
+    network_speed: int = 0

@@ -184,9 +184,9 @@ class Metrics:
         self._statsd_addrs.add(addr)
         self.add_sink(StatsdSink(addr))
 
-    def _name(self, parts) -> str:
-        head = (f"{self.prefix}.{self.hostname}" if self.hostname
-                else self.prefix)
+    def _name(self, parts, tag_host: bool = False) -> str:
+        head = (f"{self.prefix}.{self.hostname}"
+                if tag_host and self.hostname else self.prefix)
         if isinstance(parts, str):
             return f"{head}.{parts}"
         return ".".join([head, *parts])
@@ -197,7 +197,9 @@ class Metrics:
             s.incr_counter(name, n)
 
     def set_gauge(self, parts, v: float) -> None:
-        name = self._name(parts)
+        # Only gauges carry the hostname (go-metrics SetGauge applies
+        # HostName; counters/samples stay cluster-aggregatable).
+        name = self._name(parts, tag_host=True)
         for s in self._sinks:
             s.set_gauge(name, v)
 

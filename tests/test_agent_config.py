@@ -153,6 +153,20 @@ def test_merge_can_set_back_to_default(tmp_path):
     assert cfg.bind_addr == "127.0.0.1"
 
 
+def test_merge_join_lists_accumulate(tmp_path):
+    """retry_join/start_join seed lists concatenate across files
+    (config.go Merge appends); other lists follow later-file-wins."""
+    (tmp_path / "10-a.hcl").write_text(
+        'server { retry_join = ["10.0.0.1:4648"] '
+        'enabled_schedulers = ["service"] }\n')
+    (tmp_path / "20-b.hcl").write_text(
+        'server { retry_join = ["10.0.0.2:4648"] '
+        'enabled_schedulers = ["batch"] }\n')
+    cfg = load_config(str(tmp_path))
+    assert cfg.server.retry_join == ["10.0.0.1:4648", "10.0.0.2:4648"]
+    assert cfg.server.enabled_schedulers == ["batch"]
+
+
 def test_load_configs_order(tmp_path):
     p1 = tmp_path / "a.hcl"
     p2 = tmp_path / "b.hcl"

@@ -7,6 +7,7 @@ import os
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -52,6 +53,7 @@ def server_client_cluster(tmp_path):
     client_cfg = tmp_path / "client.json"
     client_cfg.write_text(json.dumps({
         "bind_addr": "127.0.0.1",
+        "ports": {"http": 14847},
         "client": {
             "enabled": True,
             "servers": ["127.0.0.1:14846"],
@@ -127,6 +129,20 @@ def test_server_only_and_client_only_agents(server_client_cluster, tmp_path):
             break
         time.sleep(0.3)
     assert final is not None, "batch job never completed on client agent"
+
+    # The client-only agent serves its own HTTP endpoint: fs/logs for
+    # its allocations are reachable there (every agent serves HTTP,
+    # agent.go), while server-backed routes answer 501.
+    listing = wait_http(
+        f"http://127.0.0.1:14847/v1/client/fs/ls/{final['id']}")
+    assert any(e["name"] == "alloc" for e in listing)
+    servers = wait_http("http://127.0.0.1:14847/v1/agent/servers")
+    assert servers == ["http://127.0.0.1:14846"]
+    try:
+        urllib.request.urlopen("http://127.0.0.1:14847/v1/jobs", timeout=5)
+        raise AssertionError("server route should 501 on client-only agent")
+    except urllib.error.HTTPError as e:
+        assert e.code == 501
 
 
 def test_agent_requires_role(tmp_path):

@@ -162,16 +162,23 @@ def test_statsite_sink_survives_down_target():
     sink.close()
 
 
-def test_hostname_tagging():
+def test_hostname_tagging_gauges_only():
+    """Only gauges carry the hostname (go-metrics SetGauge semantics);
+    counters/samples stay cluster-aggregatable."""
     from nomad_tpu.utils.metrics import Metrics
 
     m = Metrics("nomad_tpu", hostname="host1")
     m.incr_counter("worker.dequeue", 1)
-    snap = m.snapshot()
-    names = set()
-    for iv in snap:
-        names |= set(iv["counters"])
-    assert "nomad_tpu.host1.worker.dequeue" in names
+    m.add_sample("worker.invoke", 2.0)
+    m.set_gauge("broker.ready", 3)
+    counters, gauges, samples = set(), set(), set()
+    for iv in m.snapshot():
+        counters |= set(iv["counters"])
+        gauges |= set(iv["gauges"])
+        samples |= set(iv["samples"])
+    assert "nomad_tpu.worker.dequeue" in counters
+    assert "nomad_tpu.worker.invoke" in samples
+    assert "nomad_tpu.host1.broker.ready" in gauges
 
 
 def test_format_snapshot():
