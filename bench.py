@@ -284,58 +284,65 @@ def config_4():
 def config_5():
     """System drain storm: every system job replans when nodes drain.
     System scheduling pins each placement to its node (no search), so
-    this measures the CPU reference path end-to-end; the TPU column
-    reports the same number (nothing to accelerate — util.go:170)."""
+    the dense path ("system-tpu", scheduler/tpu.py
+    DenseSystemScheduler) replaces the per-node iterator stack with one
+    vectorized feasibility+fit pass per eval."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.testing import Harness
     from nomad_tpu.structs import consts
 
     n_nodes, n_jobs = 1000, 50  # scaled drain storm
-    harness = Harness()
-    store = harness.state
-    index = 0
-    for i in range(n_nodes):
-        node = mock.node()
-        node.compute_class()
-        index += 1
-        store.upsert_node(index, node)
-    jobs = []
-    for j in range(n_jobs):
-        job = mock.system_job()
-        job.id = f"sys-{j}"
-        job.task_groups[0].tasks[0].resources.networks = []
-        job.task_groups[0].tasks[0].resources.cpu = 5
-        job.task_groups[0].tasks[0].resources.memory_mb = 8
-        index += 1
-        store.upsert_job(index, job)
-        jobs.append(job)
 
-    # Drain 10% of nodes -> server creates one eval per system job
-    # (node_endpoint.go:812 createNodeEvals).
-    drained = store.nodes()[: n_nodes // 10]
-    for node in drained:
-        index += 1
-        store.update_node_drain(index, node.id, True)
+    def build():
+        harness = Harness()
+        store = harness.state
+        index = 0
+        for i in range(n_nodes):
+            node = mock.node()
+            node.compute_class()
+            index += 1
+            store.upsert_node(index, node)
+        jobs = []
+        for j in range(n_jobs):
+            job = mock.system_job()
+            job.id = f"sys-{j}"
+            job.task_groups[0].tasks[0].resources.networks = []
+            job.task_groups[0].tasks[0].resources.cpu = 5
+            job.task_groups[0].tasks[0].resources.memory_mb = 8
+            index += 1
+            store.upsert_job(index, job)
+            jobs.append(job)
+        # Drain 10% of nodes -> server creates one eval per system job
+        # (node_endpoint.go:812 createNodeEvals).
+        for node in store.nodes()[: n_nodes // 10]:
+            index += 1
+            store.update_node_drain(index, node.id, True)
+        harness._next_index = index + 1
+        evals = []
+        for job in jobs:
+            ev = mock.eval()
+            ev.job_id = job.id
+            ev.type = consts.JOB_TYPE_SYSTEM
+            ev.triggered_by = consts.EVAL_TRIGGER_NODE_UPDATE
+            evals.append(ev)
+        return harness, evals
 
-    evals = []
-    for job in jobs:
-        ev = mock.eval()
-        ev.job_id = job.id
-        ev.type = consts.JOB_TYPE_SYSTEM
-        ev.triggered_by = consts.EVAL_TRIGGER_NODE_UPDATE
-        evals.append(ev)
+    def run(scheduler_name):
+        harness, evals = build()
+        latencies = []
+        start = time.perf_counter()
+        for ev in evals:
+            t0 = time.perf_counter()
+            harness.process(scheduler_name, ev)
+            latencies.append(time.perf_counter() - t0)
+        elapsed = time.perf_counter() - start
+        return len(evals) / elapsed, float(np.percentile(latencies, 99))
 
-    latencies = []
-    start = time.perf_counter()
-    for ev in evals:
-        t0 = time.perf_counter()
-        harness.process("system", ev)
-        latencies.append(time.perf_counter() - t0)
-    elapsed = time.perf_counter() - start
-    rate = len(evals) / elapsed
-    p99 = float(np.percentile(latencies, 99))
+    cpu_rate, cpu_p99 = run("system")
+    dense_rate, dense_p99 = run("system-tpu")
     return (f"drain storm: {n_nodes} nodes x {n_jobs} system jobs, "
-            f"10% drained (cpu reference path)"), rate, p99, rate, p99
+            f"10% drained (host stack vs dense pass)"), cpu_rate, cpu_p99, \
+        dense_rate, dense_p99
 
 
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}

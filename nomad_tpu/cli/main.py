@@ -585,7 +585,9 @@ def cmd_agent(args) -> int:
 
     scheduler_factories = {}
     if args.tpu:
-        scheduler_factories = {"service": "service-tpu", "batch": "batch-tpu"}
+        scheduler_factories = {"service": "service-tpu",
+                               "batch": "batch-tpu",
+                               "system": "system-tpu"}
 
     # Unique gossip identity per agent: two same-region agents with the
     # same member name would clobber each other in the serf pool.

@@ -80,7 +80,7 @@ register_scheduler(
 def _register_tpu_factories() -> None:
     """TPU-backed factories are registered lazily so importing the
     scheduler package doesn't pull in JAX."""
-    from .tpu import BatchedTPUScheduler  # noqa
+    from .tpu import BatchedTPUScheduler, DenseSystemScheduler  # noqa
 
     register_scheduler(
         "service-tpu",
@@ -92,6 +92,12 @@ def _register_tpu_factories() -> None:
         "batch-tpu",
         lambda logger, state, planner, rng=None: BatchedTPUScheduler(
             logger, state, planner, batch=True, rng=rng
+        ),
+    )
+    register_scheduler(
+        "system-tpu",
+        lambda logger, state, planner, rng=None: DenseSystemScheduler(
+            logger, state, planner, rng=rng
         ),
     )
 

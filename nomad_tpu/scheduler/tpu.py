@@ -1,13 +1,20 @@
-"""TPU-backed scheduler factories ("service-tpu", "batch-tpu").
+"""TPU/dense scheduler factories ("service-tpu", "batch-tpu",
+"system-tpu").
 
 The north-star design (BASELINE.json): identical control flow to the
-GenericScheduler — same reconciliation, same blocked-eval/rolling
+host schedulers — same reconciliation, same blocked-eval/rolling
 semantics, same plan shape — but computePlacements runs as one dense
-JAX program instead of per-node iterators. In-place updates and
+program instead of per-node iterators. In-place updates and
 sticky-disk preferences stay host-side (SURVEY.md section 7 hard
 parts); exact port numbers are assigned host-side on the chosen nodes;
 the plan applier re-verifies every node so kernel approximations cost
 retries, not correctness.
+
+The generic path searches (masked argmax on the TPU); the system path
+(system_sched.go) pins every placement to its node, so its dense
+reformulation is pure vectorized feasibility+fit over the pinned rows
+— no search, one ClusterMatrix build instead of a per-node iterator
+stack per placement.
 """
 
 from __future__ import annotations
@@ -28,7 +35,59 @@ from ..structs import (
 )
 from ..utils.ids import generate_uuid
 from .generic import GenericScheduler
+from .system import SystemScheduler
 from .util import AllocTuple
+
+
+def _offer_networks(rng, missing: AllocTuple, node, net_indexes, matrix):
+    """Exact per-task network offers on a dense-path-chosen node.
+    Returns {task: Resources} or None if a port can't be assigned."""
+    idx = net_indexes.get(node.id)
+    if idx is None:
+        idx = NetworkIndex()
+        idx.set_node(node)
+        idx.add_allocs(matrix._proposed_allocs(node.id))
+        net_indexes[node.id] = idx
+
+    task_resources: Dict[str, Resources] = {}
+    for task in missing.task_group.tasks:
+        resources = task.resources.copy()
+        if resources.networks:
+            ask = resources.networks[0]
+            offer, err = idx.assign_network(ask, rng)
+            if offer is None:
+                # Drop the partially-updated index; it is rebuilt
+                # from the plan on next use.
+                net_indexes.pop(node.id, None)
+                return None
+            idx.add_reserved(offer)
+            resources.networks = [offer]
+        task_resources[task.name] = resources
+    return task_resources
+
+
+def _build_allocation(sched, missing: AllocTuple, node, task_resources,
+                      metrics) -> Allocation:
+    """The Allocation literal both dense schedulers append to the plan
+    (shared so the field set can't drift between them)."""
+    alloc = Allocation(
+        id=generate_uuid(),
+        eval_id=sched.eval.id,
+        name=missing.name,
+        job_id=sched.job.id,
+        task_group=missing.task_group.name,
+        metrics=metrics,
+        node_id=node.id,
+        task_resources=task_resources,
+        desired_status=consts.ALLOC_DESIRED_RUN,
+        client_status=consts.ALLOC_CLIENT_PENDING,
+        shared_resources=Resources(
+            disk_mb=missing.task_group.ephemeral_disk.size_mb
+        ),
+    )
+    if missing.alloc is not None and missing.alloc.id:
+        alloc.previous_allocation = missing.alloc.id
+    return alloc
 
 
 class BatchedTPUScheduler(GenericScheduler):
@@ -118,8 +177,8 @@ class BatchedTPUScheduler(GenericScheduler):
                 continue
 
             metrics.score_node(node, "binpack", float(scores[j]))
-            task_resources = self._offer_networks(
-                missing, node, net_indexes, matrix
+            task_resources = _offer_networks(
+                self.rng, missing, node, net_indexes, matrix
             )
             if task_resources is None:
                 # Dense port-count approximation missed a real collision:
@@ -127,24 +186,8 @@ class BatchedTPUScheduler(GenericScheduler):
                 super()._compute_placements([missing])
                 continue
 
-            alloc = Allocation(
-                id=generate_uuid(),
-                eval_id=self.eval.id,
-                name=missing.name,
-                job_id=self.job.id,
-                task_group=missing.task_group.name,
-                metrics=metrics,
-                node_id=node.id,
-                task_resources=task_resources,
-                desired_status=consts.ALLOC_DESIRED_RUN,
-                client_status=consts.ALLOC_CLIENT_PENDING,
-                shared_resources=Resources(
-                    disk_mb=missing.task_group.ephemeral_disk.size_mb
-                ),
-            )
-            if missing.alloc is not None:
-                alloc.previous_allocation = missing.alloc.id
-            self.plan.append_alloc(alloc)
+            self.plan.append_alloc(_build_allocation(
+                self, missing, node, task_resources, metrics))
 
     # ------------------------------------------------------------------
 
@@ -167,28 +210,124 @@ class BatchedTPUScheduler(GenericScheduler):
                     bool(matrix.feasible[i, gi]), name, node.computed_class
                 )
 
-    def _offer_networks(self, missing: AllocTuple, node, net_indexes, matrix):
-        """Exact per-task network offers on the kernel-chosen node.
-        Returns {task: Resources} or None if a port can't be assigned."""
-        idx = net_indexes.get(node.id)
-        if idx is None:
-            idx = NetworkIndex()
-            idx.set_node(node)
-            idx.add_allocs(matrix._proposed_allocs(node.id))
-            net_indexes[node.id] = idx
+class DenseSystemScheduler(SystemScheduler):
+    """SystemScheduler whose placement loop is one vectorized pass.
 
-        task_resources: Dict[str, Resources] = {}
-        for task in missing.task_group.tasks:
-            resources = task.resources.copy()
-            if resources.networks:
-                ask = resources.networks[0]
-                offer, err = idx.assign_network(ask, self.rng)
-                if offer is None:
-                    # Drop the partially-updated index; it is rebuilt
-                    # from the plan on next use.
-                    net_indexes.pop(node.id, None)
-                    return None
-                idx.add_reserved(offer)
-                resources.networks = [offer]
-            task_resources[task.name] = resources
-        return task_resources
+    The host loop (system_sched.go:255) builds a one-node iterator
+    stack per pinned placement; here the whole placement set is checked
+    against a single ClusterMatrix: constraint feasibility comes from
+    the [N, G] mask, resource fit is a vectorized AllocsFit over the
+    pinned rows, and in-eval utilization accumulates per task group so
+    multi-TG system jobs see their own earlier placements."""
+
+    def _compute_placements(self, place: List[AllocTuple]) -> None:
+        from ..models.matrix import ClusterMatrix
+
+        matrix = ClusterMatrix(self.state, self.job, self.plan,
+                               nodes=self.nodes)
+        matrix.nodes_by_dc = self.nodes_by_dc
+        node_index = {n.id: i for i, n in enumerate(matrix.nodes)}
+        tg_by_name = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
+
+        placements = [tg_by_name[m.task_group.name] for m in place]
+        resources, bw, ports, _tg_index, _active, _jdh, _tdh = \
+            matrix.build_asks(placements)
+
+        util = matrix.util.copy()
+        bw_used = matrix.bw_used.copy()
+        ports_free = matrix.ports_free.copy()
+
+        rows = np.empty(len(place), np.int64)
+        for j, missing in enumerate(place):
+            row = node_index.get(missing.alloc.node_id)
+            if row is None:
+                raise RuntimeError(
+                    f"could not find node {missing.alloc.node_id!r}")
+            rows[j] = row
+
+        gis = np.asarray(placements)
+        feasible = matrix.feasible[rows, gis]
+        # Vectorized AllocsFit per task group so same-node placements of
+        # different groups accumulate (G passes, each all-numpy). The
+        # ask arrays from build_asks are per-placement rows; every row
+        # of one group carries that group's ask.
+        fits = np.zeros(len(place), bool)
+        for gi in sorted(set(placements)):
+            sel = gis == gi
+            j0 = int(np.flatnonzero(sel)[0])
+            ask_res, ask_bw, ask_ports = resources[j0], bw[j0], ports[j0]
+            r = rows[sel]
+            ok = (
+                feasible[sel]
+                & np.all(util[r] + ask_res <= matrix.capacity[r], axis=1)
+                & (bw_used[r] + ask_bw <= matrix.bw_avail[r])
+                & (ports_free[r] >= ask_ports)
+            )
+            fits[sel] = ok
+            acc = r[ok]
+            np.add.at(util, acc, ask_res)
+            np.add.at(bw_used, acc, ask_bw)
+            np.add.at(ports_free, acc, -ask_ports)
+
+        net_indexes: Dict[str, NetworkIndex] = {}
+
+        for j, missing in enumerate(place):
+            name = missing.task_group.name
+            node = matrix.nodes[rows[j]]
+            # Per-placement metrics, like the host path where every
+            # stack.select starts fresh (stack.go Select → ctx reset);
+            # the pinned node is the one node evaluated.
+            metrics = AllocMetric()
+            metrics.nodes_available = self.nodes_by_dc
+            metrics.evaluate_node()
+
+            if not fits[j]:
+                if not feasible[j]:
+                    # Constraint mismatch: the alloc was never really
+                    # "queued" on this node (host path's nodes_filtered
+                    # branch, system_sched.go undo accounting).
+                    metrics.filter_node(node, "constraint")
+                    self.queued_allocs[name] -= 1
+                    if (
+                        self.eval.annotate_plan
+                        and self.plan.annotations is not None
+                        and name in self.plan.annotations.desired_tg_updates
+                    ):
+                        self.plan.annotations.desired_tg_updates[name].place -= 1
+                else:
+                    metrics.exhausted_node(node, "resources")
+                # Record the first failure per TG, coalesce the rest —
+                # for filtered AND exhausted alike (system_sched.go:261).
+                if self.failed_tg_allocs and name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[name].coalesced_failures += 1
+                else:
+                    if self.failed_tg_allocs is None:
+                        self.failed_tg_allocs = {}
+                    self.failed_tg_allocs[name] = metrics
+                continue
+
+            task_resources = self._offer_networks_on(
+                missing, node, net_indexes, matrix)
+            if task_resources is None:
+                # Dense port-count approximation missed a collision:
+                # fall back to the exact host path for this placement.
+                super()._compute_placements([missing])
+                continue
+
+            self.plan.append_alloc(_build_allocation(
+                self, missing, node, task_resources, metrics))
+
+    def _offer_networks_on(self, missing: AllocTuple, node, net_indexes,
+                           matrix):
+        """Exact per-task network offers on the pinned node (same logic
+        as the generic dense path)."""
+        has_networks = any(
+            t.resources is not None and t.resources.networks
+            for t in missing.task_group.tasks
+        )
+        if not has_networks:
+            return {
+                t.name: t.resources.copy()
+                for t in missing.task_group.tasks
+            }
+        return _offer_networks(self.rng, missing, node, net_indexes, matrix)

@@ -502,9 +502,6 @@ class StateStore:
                 alloc.alloc_modify_index = index
                 table[alloc.id] = alloc
                 self._update_summary_with_alloc(index, alloc, existing)
-                job = self._tables["jobs"].data.get(alloc.job_id)
-                if job is not None:
-                    items.extend(self._set_job_status(index, job))
                 items.extend(
                     [
                         watch.alloc(alloc.id),
@@ -514,6 +511,12 @@ class StateStore:
                         watch.job_summary(alloc.job_id),
                     ]
                 )
+            # Derived job status recomputes once per touched job, not
+            # once per alloc (a system job upserts one alloc per node).
+            for job_id in {a.job_id for a in allocs}:
+                job = self._tables["jobs"].data.get(job_id)
+                if job is not None:
+                    items.extend(self._set_job_status(index, job))
             self._bump(index, "allocs", "job_summary")
         self.notify.notify(items)
 

@@ -62,7 +62,17 @@ class AllocMetric:
     coalesced_failures: int = 0
 
     def copy(self) -> "AllocMetric":
-        return copy.deepcopy(self)
+        # Field-wise (values are scalars/flat dicts): metrics are copied
+        # once per upserted alloc, so the deepcopy machinery showed up
+        # in the plan-apply profile.
+        new = copy.copy(self)
+        new.nodes_available = dict(self.nodes_available)
+        new.class_filtered = dict(self.class_filtered)
+        new.constraint_filtered = dict(self.constraint_filtered)
+        new.class_exhausted = dict(self.class_exhausted)
+        new.dimension_exhausted = dict(self.dimension_exhausted)
+        new.scores = dict(self.scores)
+        return new
 
     def evaluate_node(self) -> None:
         self.nodes_evaluated += 1
@@ -111,7 +121,25 @@ class Allocation:
     create_time: float = 0.0
 
     def copy(self) -> "Allocation":
-        return copy.deepcopy(self)
+        # Field-wise copy instead of copy.deepcopy: this is the plan
+        # applier's hot path (a system job touching N nodes upserts N
+        # allocs) and the deepcopy machinery dominated its profile. The
+        # embedded job is immutable-by-convention (the store's MVCC
+        # semantics: every job write stores a fresh object, readers
+        # never mutate it in place) so the reference is shared.
+        new = copy.copy(self)
+        new.resources = self.resources.copy() if self.resources else None
+        new.shared_resources = (
+            self.shared_resources.copy() if self.shared_resources else None)
+        new.task_resources = {
+            k: r.copy() for k, r in self.task_resources.items()}
+        new.metrics = self.metrics.copy() if self.metrics else None
+        new.task_states = {
+            k: TaskState(state=ts.state, failed=ts.failed,
+                         events=[copy.copy(e) for e in ts.events])
+            for k, ts in self.task_states.items()
+        }
+        return new
 
     def index(self) -> int:
         """The per-group index parsed from the name suffix '[i]'."""

@@ -278,7 +278,11 @@ class JobSummary:
     modify_index: int = 0
 
     def copy(self) -> "JobSummary":
-        return copy.deepcopy(self)
+        # Flat dataclass of counters — field-wise copy keeps the
+        # per-alloc summary update out of the deepcopy machinery.
+        new = copy.copy(self)
+        new.summary = {k: copy.copy(v) for k, v in self.summary.items()}
+        return new
 
 
 @dataclass

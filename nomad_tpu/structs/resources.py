@@ -27,7 +27,16 @@ class NetworkResource:
     dynamic_ports: List[Port] = field(default_factory=list)
 
     def copy(self) -> "NetworkResource":
-        return copy.deepcopy(self)
+        # Field-wise: Ports are two-field value objects, and this copy
+        # runs once per task per upserted alloc (plan-apply hot path).
+        return NetworkResource(
+            device=self.device, cidr=self.cidr, ip=self.ip,
+            mbits=self.mbits,
+            reserved_ports=[Port(p.label, p.value)
+                            for p in self.reserved_ports],
+            dynamic_ports=[Port(p.label, p.value)
+                           for p in self.dynamic_ports],
+        )
 
     def add(self, delta: "NetworkResource") -> None:
         self.mbits += delta.mbits
@@ -54,7 +63,9 @@ class Resources:
     DEFAULT_IOPS = 0
 
     def copy(self) -> "Resources":
-        return copy.deepcopy(self)
+        new = copy.copy(self)
+        new.networks = [n.copy() for n in self.networks]
+        return new
 
     def canonicalize(self) -> None:
         if self.cpu == 0:

@@ -1,0 +1,157 @@
+"""DenseSystemScheduler ("system-tpu") parity tests: the vectorized
+pinned-placement path must produce the same plans as the host
+SystemScheduler across the system_sched_test.go scenarios."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import Constraint, NetworkResource, Port, consts, new_eval
+
+
+def seed_nodes(h, count):
+    nodes = []
+    for _ in range(count):
+        n = mock.node()
+        n.compute_class()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return nodes
+
+
+def strip_networks(job):
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+    return job
+
+
+def test_dense_system_register_runs_everywhere():
+    h = Harness(seed=20)
+    nodes = seed_nodes(h, 10)
+    job = strip_networks(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 10
+    assert {a.node_id for a in out} == {n.id for n in nodes}
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+
+
+def test_dense_system_constraint_filters_nodes():
+    h = Harness(seed=21)
+    nodes = seed_nodes(h, 4)
+    for n in nodes[:2]:
+        n2 = n.copy()
+        n2.attributes["kernel.name"] = "windows"
+        n2.compute_class()
+        h.state.upsert_node(h.next_index(), n2)
+    job = strip_networks(mock.system_job())
+    job.constraints.append(
+        Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
+                   operand="="))
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 2
+    placed = {a.node_id for a in out}
+    assert placed == {n.id for n in nodes[2:]}
+    # Constraint-filtered placements are not "queued" (host-path
+    # accounting parity) but ARE visible in failed_tg_allocs
+    # (system_sched.go records the failure either way).
+    ev = h.evals[0]
+    assert ev.queued_allocations.get("web", 0) == 0
+    assert "web" in ev.failed_tg_allocs
+    metric = ev.failed_tg_allocs["web"]
+    assert metric.nodes_filtered == 1
+    assert metric.coalesced_failures == 1  # the second filtered node
+    # Placed allocs carry per-placement metrics, not a shared aggregate.
+    assert all(a.metrics.nodes_filtered == 0 for a in out)
+    assert len({id(a.metrics) for a in out}) == len(out)
+
+
+def test_dense_system_resource_exhaustion_fails_tg():
+    h = Harness(seed=22)
+    nodes = seed_nodes(h, 3)
+    job = strip_networks(mock.system_job())
+    job.task_groups[0].tasks[0].resources.cpu = 10 ** 7  # can't fit
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    assert h.state.allocs_by_job(job.id) == []
+    ev = h.evals[0]
+    assert ev.status == consts.EVAL_STATUS_COMPLETE
+    assert "web" in ev.failed_tg_allocs
+    metric = ev.failed_tg_allocs["web"]
+    assert metric.nodes_exhausted == 3 or metric.coalesced_failures >= 1
+
+
+def test_dense_system_node_down_stops_alloc():
+    """Mirror of test_system_node_down_stops_alloc on the dense path."""
+    h = Harness(seed=23)
+    nodes = seed_nodes(h, 4)
+    job = strip_networks(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert len(h.state.allocs_by_job(job.id)) == 4
+
+    h.state.update_node_status(h.next_index(), nodes[0].id,
+                               consts.NODE_STATUS_DOWN)
+    h2 = Harness(state=h.state, seed=25)
+    h2._next_index = h._next_index
+    h2.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    plan = h2.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert len(stops) >= 1
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert all(a.node_id != nodes[0].id for a in placed)
+
+
+def test_dense_system_ports_assigned_exactly():
+    """A system job with a dynamic port gets a real per-node offer."""
+    h = Harness(seed=24)
+    seed_nodes(h, 5)
+    job = mock.system_job()
+    res = job.task_groups[0].tasks[0].resources
+    res.networks = [NetworkResource(mbits=10,
+                                    dynamic_ports=[Port(label="http")])]
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    allocs = h.state.allocs_by_job(job.id)
+    assert len(allocs) == 5
+    for a in allocs:
+        nets = a.task_resources["web"].networks
+        assert nets and nets[0].dynamic_ports[0].value >= 20000
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_dense_system_parity_with_host_path(seed):
+    """Same cluster, same job: host and dense paths place on the same
+    node set with the same queued accounting."""
+    results = {}
+    for name in ("system", "system-tpu"):
+        h = Harness(seed=seed)
+        for i in range(8):
+            n = mock.node()
+            n.id = f"node-{i}"  # stable ids so plans compare across runs
+            n.compute_class()
+            h.state.upsert_node(h.next_index(), n)
+        # one constrained group + one open group
+        job = strip_networks(mock.system_job())
+        tg2 = job.task_groups[0].copy()
+        tg2.name = "aux"
+        tg2.tasks[0].resources.cpu = 100
+        job.task_groups.append(tg2)
+        h.state.upsert_job(h.next_index(), job)
+        h.process(name, new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+        allocs = h.state.allocs_by_job(job.id)
+        results[name] = {
+            "placed": sorted((a.node_id, a.task_group) for a in allocs),
+            "queued": h.evals[0].queued_allocations,
+            "status": h.evals[0].status,
+        }
+    assert results["system"] == results["system-tpu"]
