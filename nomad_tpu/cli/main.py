@@ -729,6 +729,22 @@ def cmd_agent(args) -> int:
             services.append(ConsulService(
                 name=cfg.consul.server_service_name, tags=["http"],
                 port=http.port, address=_advertise_addr(cfg)))
+            # Advertise the gossip endpoint too, and bootstrap-join
+            # through the catalog when we know no peers
+            # (server.go:398 setupBootstrapHandler).
+            serf_port = int(serf_addr.rsplit(":", 1)[1])
+            services.append(ConsulService(
+                name=cfg.consul.server_service_name, tags=["serf"],
+                port=serf_port, address=_advertise_addr(cfg)))
+            from ..consul import serf_bootstrap
+            import threading as _threading
+
+            _threading.Thread(
+                target=serf_bootstrap,
+                args=(server, consul_api, cfg.consul.server_service_name),
+                kwargs={"interval": 3.0 if cfg.dev_mode else 15.0},
+                daemon=True, name="consul-serf-bootstrap",
+            ).start()
         if client_agent is not None:
             services.append(ConsulService(
                 name=cfg.consul.client_service_name, tags=["http"],

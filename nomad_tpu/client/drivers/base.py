@@ -75,8 +75,17 @@ class Driver:
         """Reattach to a live task after client restart; None if gone."""
         return None
 
+    #: Declared config schema (helper/fields analog); None disables the
+    #: generic check. Subclasses may extend validate_config with
+    #: driver-specific rules on top.
+    config_schema = None
+
     def validate_config(self, task: Task) -> None:
-        pass
+        if self.config_schema is not None:
+            errors = self.config_schema.validate(
+                task.config, where=f"{self.name} config")
+            if errors:
+                raise ValueError("; ".join(errors))
 
 
 DRIVER_REGISTRY: Dict[str, Type[Driver]] = {}

@@ -25,6 +25,7 @@ import time
 from typing import List, Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
 
 
@@ -137,9 +138,16 @@ class DockerDriver(Driver):
         node.attributes["driver.docker.version"] = proc.stdout.strip()
         return True
 
-    def validate_config(self, task: Task) -> None:
-        if not (task.config or {}).get("image"):
-            raise ValueError(f"docker task {task.name!r} missing 'image'")
+    config_schema = FieldSchema({
+        "image": Field("string", required=True),
+        "command": Field("string"),
+        "args": Field("list"),
+        "port_map": Field("list"),
+        "network_mode": Field("string"),
+        "work_dir": Field("string"),
+        "privileged": Field("bool"),
+    })
+
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
         docker = _docker_bin()

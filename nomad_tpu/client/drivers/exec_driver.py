@@ -12,12 +12,20 @@ import os
 from typing import Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, register_driver
 
 
 @register_driver
 class ExecDriver(Driver):
     name = "exec"
+
+    config_schema = FieldSchema({
+        "command": Field("string", required=True),
+        "args": Field("list"),
+        "chroot": Field("bool"),
+    })
+
 
     def fingerprint(self, node: Node) -> bool:
         if node.attributes.get("kernel.name", "linux") != "linux":

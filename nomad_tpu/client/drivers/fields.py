@@ -1,0 +1,53 @@
+"""Driver config schema validation.
+
+Reference: helper/fields (FieldData/FieldSchema) — every driver
+validates its opaque `task.config` map against a declared schema before
+start, so typos and type errors fail at validation time instead of
+surfacing as weird runtime behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Field:
+    type: str  # "string" | "int" | "bool" | "float" | "list" | "map"
+    required: bool = False
+
+
+class FieldSchema:
+    def __init__(self, fields: Dict[str, Field]):
+        self.fields = fields
+
+    def validate(self, config: Optional[Dict[str, Any]],
+                 where: str = "config") -> List[str]:
+        """Returns a list of error strings (empty when valid)."""
+        config = config or {}
+        errors = []
+        for key, f in self.fields.items():
+            if f.required and key not in config:
+                errors.append(f"{where}: missing required key {key!r}")
+        checkers = {
+            "any": lambda v: True,
+            "string": lambda v: isinstance(v, str),
+            "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+            "float": lambda v: isinstance(v, (int, float))
+            and not isinstance(v, bool),
+            "bool": lambda v: isinstance(v, bool),
+            "list": lambda v: isinstance(v, list),
+            "map": lambda v: isinstance(v, dict),
+        }
+        for key, value in config.items():
+            f = self.fields.get(key)
+            if f is None:
+                errors.append(f"{where}: unknown key {key!r}")
+                continue
+            ok = checkers[f.type](value)
+            if not ok:
+                errors.append(
+                    f"{where}: key {key!r} must be a {f.type}, "
+                    f"got {type(value).__name__}")
+        return errors

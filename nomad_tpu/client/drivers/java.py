@@ -17,6 +17,7 @@ from dataclasses import replace
 from typing import Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, register_driver
 
 
@@ -49,9 +50,12 @@ class JavaDriver(Driver):
         node.attributes["driver.java.version"] = version
         return True
 
-    def validate_config(self, task: Task) -> None:
-        if not (task.config or {}).get("jar_path"):
-            raise ValueError(f"java task {task.name!r} missing 'jar_path'")
+    config_schema = FieldSchema({
+        "jar_path": Field("string", required=True),
+        "jvm_options": Field("list"),
+        "args": Field("list"),
+    })
+
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
         from ..executor import launch_executor

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ...structs import Node, Task
 from ...utils.ids import generate_uuid
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
 
 
@@ -49,6 +50,13 @@ _live_handles = {}
 @register_driver
 class MockDriver(Driver):
     name = "mock_driver"
+
+    config_schema = FieldSchema({
+        "run_for": Field("float"),
+        "exit_code": Field("int"),
+        "start_error": Field("string"),
+    })
+
 
     def fingerprint(self, node: Node) -> bool:
         node.attributes["driver.mock_driver"] = "1"

@@ -18,6 +18,7 @@ from dataclasses import replace
 from typing import Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, register_driver
 
 QEMU_BIN = "qemu-system-x86_64"
@@ -50,9 +51,14 @@ class QemuDriver(Driver):
         node.attributes["driver.qemu.version"] = version
         return True
 
-    def validate_config(self, task: Task) -> None:
-        if not (task.config or {}).get("image_path"):
-            raise ValueError(f"qemu task {task.name!r} missing 'image_path'")
+    config_schema = FieldSchema({
+        "image_path": Field("string", required=True),
+        "accelerator": Field("string"),
+        "graceful_shutdown": Field("bool"),
+        "port_map": Field("map"),
+        "args": Field("list"),
+    })
+
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
         from ..executor import launch_executor

@@ -15,6 +15,7 @@ import threading
 from typing import Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, WaitResult, register_driver
 
 
@@ -96,6 +97,12 @@ def launch_command(ctx: TaskContext, task: Task, preexec=None) -> subprocess.Pop
 @register_driver
 class RawExecDriver(Driver):
     name = "raw_exec"
+
+    config_schema = FieldSchema({
+        "command": Field("string", required=True),
+        "args": Field("list"),
+    })
+
 
     def fingerprint(self, node: Node) -> bool:
         # Opt-in only: no isolation (raw_exec.go fingerprint gate).

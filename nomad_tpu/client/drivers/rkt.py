@@ -19,6 +19,7 @@ from dataclasses import replace
 from typing import Optional
 
 from ...structs import Node, Task
+from .fields import Field, FieldSchema
 from .base import Driver, DriverHandle, TaskContext, register_driver
 
 RKT_BIN = "rkt"
@@ -67,9 +68,20 @@ class RktDriver(Driver):
             node.attributes["driver.rkt.appc.version"] = info["appc.version"]
         return True
 
-    def validate_config(self, task: Task) -> None:
-        if not (task.config or {}).get("image"):
-            raise ValueError(f"rkt task {task.name!r} missing 'image'")
+    config_schema = FieldSchema({
+        "image": Field("string", required=True),
+        "command": Field("string"),
+        "args": Field("list"),
+        "trust_prefix": Field("string"),
+        "dns_servers": Field("list"),
+        "dns_search_domains": Field("list"),
+        "net": Field("any"),
+        "port_map": Field("map"),
+        "volumes": Field("list"),
+        "insecure_options": Field("list"),
+        "debug": Field("bool"),
+    })
+
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
         from ..executor import launch_executor

@@ -149,6 +149,19 @@ class TaskRunner:
             return
 
         reattached = self._try_reattach(driver, ctx)
+        # Driver config schema check (helper/fields analog): a config
+        # typo is a permanent validation failure, not a restartable
+        # driver error. Gates fresh starts only — a live task from a
+        # previous client must reattach regardless, or its process is
+        # orphaned.
+        if not reattached:
+            try:
+                driver.validate_config(self.task)
+            except ValueError as e:
+                ev = new_task_event(consts.TASK_EVENT_FAILED_VALIDATION)
+                ev.validation_error = str(e)
+                self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
+                return
         if self._kill.is_set():
             # kill() raced _try_reattach while handle was still None: the
             # while loop below won't run, so reap any adopted task here

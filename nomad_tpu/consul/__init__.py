@@ -13,6 +13,7 @@ from .syncer import (
     ConsulService,
     ConsulSyncer,
     discover_servers,
+    serf_bootstrap,
     task_services,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "ConsulService",
     "ConsulSyncer",
     "discover_servers",
+    "serf_bootstrap",
     "task_services",
 ]

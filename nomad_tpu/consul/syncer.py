@@ -298,6 +298,36 @@ def task_services(alloc, task) -> List[ConsulService]:
     return out
 
 
+def serf_bootstrap(server, api, service: str = "nomad", tag: str = "serf",
+                   interval: float = 15.0, stop=None) -> None:
+    """Keep joining gossip peers discovered in the consul catalog until
+    the server has peers (server.go:398 setupBootstrapHandler: a server
+    that knows nobody bootstraps through consul). Runs in the caller's
+    thread; pass a threading.Event as `stop` to end it."""
+    import time as _time
+
+    while stop is None or not stop.is_set():
+        try:
+            if len(server.serf_members()) > 1:
+                return  # we have peers; gossip takes it from here
+            addrs = discover_servers(api, service=service, tag=tag)
+            if addrs:
+                server.serf_join(addrs)
+                # Joining our OWN catalog entry also "succeeds", so the
+                # join count can't be trusted — only a real peer in the
+                # member list ends the bootstrap (the reference filters
+                # the local address before joining, server.go:398).
+                if len(server.serf_members()) > 1:
+                    return
+        except Exception:  # noqa: BLE001 - consul down is soft; retry
+            pass
+        if stop is not None:
+            if stop.wait(interval):
+                return
+        else:
+            _time.sleep(interval)
+
+
 def discover_servers(api, service: str = "nomad",
                      tag: str = "http") -> List[str]:
     """Find nomad servers through the consul catalog

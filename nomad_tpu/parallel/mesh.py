@@ -29,9 +29,22 @@ NODE_AXIS = "nodes"  # cluster node matrix (model parallel)
 
 def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
     """Build a dp x nodes mesh over the available devices. When dp is
-    not given, prefer sharding the node axis (the big dimension)."""
+    not given, prefer sharding the node axis (the big dimension).
+
+    When the default backend has fewer devices than requested (e.g. one
+    real TPU chip while a dryrun asks for an 8-way mesh), fall back to
+    the host CPU devices — `--xla_force_host_platform_device_count`
+    makes those plentiful regardless of the accelerator count."""
     devices = np.array(jax.devices())
+    if n_devices is not None and devices.size < n_devices:
+        cpus = np.array(jax.devices("cpu"))
+        if cpus.size >= n_devices:
+            devices = cpus
     if n_devices is not None:
+        if devices.size < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {devices.size} "
+                f"(and {len(jax.devices('cpu'))} cpu)")
         devices = devices[:n_devices]
     total = devices.size
     if dp is None:

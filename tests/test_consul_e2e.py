@@ -154,3 +154,38 @@ def test_client_fails_over_to_discovered_server(consul_cluster, tmp_path):
     finally:
         http2.stop()
         server2.shutdown()
+
+
+def test_serf_bootstrap_joins_discovered_peers():
+    """A server with no peers joins gossip through the consul catalog
+    (server.go:398 setupBootstrapHandler)."""
+    import threading
+
+    from nomad_tpu.consul import serf_bootstrap
+    from nomad_tpu.server import Server, ServerConfig
+
+    fake = FakeConsul()
+    s1 = Server(ServerConfig(num_schedulers=0, node_name="s1"))
+    s1.start()
+    a1 = s1.setup_serf(host="127.0.0.1")
+    s2 = Server(ServerConfig(num_schedulers=0, node_name="s2"))
+    s2.start()
+    s2.setup_serf(host="127.0.0.1")
+    try:
+        # s1 registers its serf endpoint in the catalog; s2 knows nobody.
+        host, port = a1.rsplit(":", 1)
+        fake.register_service({"ID": "_nomad-s1-serf", "Name": "nomad",
+                               "Tags": ["serf"], "Port": int(port),
+                               "Address": host})
+        stop = threading.Event()
+        t = threading.Thread(
+            target=serf_bootstrap, args=(s2, fake),
+            kwargs={"interval": 0.1, "stop": stop}, daemon=True)
+        t.start()
+        assert wait_until(lambda: len(s2.serf_members()) > 1, timeout=10.0)
+        stop.set()
+        t.join(timeout=3.0)
+        assert wait_until(lambda: len(s1.serf_members()) > 1, timeout=10.0)
+    finally:
+        s1.shutdown()
+        s2.shutdown()

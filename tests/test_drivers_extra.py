@@ -367,3 +367,73 @@ def test_rkt_missing_image_rejected():
     task = Task(name="pod", driver="rkt", config={})
     with pytest.raises(ValueError):
         RktDriver().validate_config(task)
+
+
+# --------------------------------------------------- config schemas
+
+
+def test_driver_config_schema_rejects_unknown_keys():
+    from nomad_tpu.client.drivers import QemuDriver
+
+    task = Task(name="vm", driver="qemu",
+                config={"image_path": "a.img", "imge_path_typo": "x"})
+    with pytest.raises(ValueError, match="unknown key 'imge_path_typo'"):
+        QemuDriver().validate_config(task)
+
+
+def test_driver_config_schema_type_errors():
+    from nomad_tpu.client.drivers import DockerDriver
+
+    task = Task(name="c", driver="docker",
+                config={"image": "redis", "args": "not-a-list"})
+    with pytest.raises(ValueError, match="'args' must be a list"):
+        DockerDriver().validate_config(task)
+
+
+def test_driver_config_schema_required():
+    from nomad_tpu.client.drivers import RawExecDriver
+
+    task = Task(name="t", driver="raw_exec", config={"args": ["x"]})
+    with pytest.raises(ValueError, match="missing required key 'command'"):
+        RawExecDriver().validate_config(task)
+
+
+def test_driver_config_schema_accepts_valid():
+    from nomad_tpu.client.drivers import MockDriver, RawExecDriver
+
+    RawExecDriver().validate_config(
+        Task(name="t", driver="raw_exec",
+             config={"command": "/bin/true", "args": ["a", "b"]}))
+    MockDriver().validate_config(
+        Task(name="t", driver="mock_driver",
+             config={"run_for": 0.5, "exit_code": 1}))
+
+
+def test_bad_driver_config_fails_task_validation(tmp_path):
+    """A config typo kills the task as a validation failure (no
+    restarts), via the task runner's schema check."""
+    from nomad_tpu.client.alloc_runner import AllocRunner
+    from nomad_tpu import mock
+    from nomad_tpu.structs import consts
+
+    alloc = mock.alloc()
+    tg = alloc.job.task_groups[0]
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": "not-a-number"}
+    alloc.task_resources = {task.name: task.resources}
+    states = []
+    runner = AllocRunner(alloc, str(tmp_path), lambda a: states.append(
+        {n: s.state for n, s in a.task_states.items()}), 5.0)
+    runner.run()
+    import time
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        ts = alloc.task_states.get(task.name)
+        if ts is not None and ts.state == "dead":
+            break
+        time.sleep(0.05)
+    ts = alloc.task_states[task.name]
+    assert ts.state == consts.TASK_STATE_DEAD
+    assert ts.failed
+    assert any(e.validation_error for e in ts.events)
