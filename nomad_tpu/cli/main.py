@@ -117,6 +117,13 @@ def _monitor_eval(client: Client, eval_id: str, timeout: float = 60.0) -> int:
 # ------------------------------------------------------------- commands
 
 
+def cmd_version(args) -> int:
+    from .. import API_MAJOR_VERSION, __version__
+
+    print(f"nomad-tpu v{__version__} (api {API_MAJOR_VERSION})")
+    return 0
+
+
 def cmd_init(args) -> int:
     path = "example.nomad"
     if os.path.exists(path):
@@ -742,7 +749,8 @@ def cmd_agent(args) -> int:
             _threading.Thread(
                 target=serf_bootstrap,
                 args=(server, consul_api, cfg.consul.server_service_name),
-                kwargs={"interval": 3.0 if cfg.dev_mode else 15.0},
+                kwargs={"interval": 3.0 if cfg.dev_mode else 15.0,
+                        "self_addr": f"{_advertise_addr(cfg)}:{serf_port}"},
                 daemon=True, name="consul-serf-bootstrap",
             ).start()
         if client_agent is not None:
@@ -802,6 +810,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="address advertised to consul (default: bind addr)")
     p.add_argument("-log-level", dest="log_level", default="")
     p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(fn=cmd_version)
 
     p = sub.add_parser("init", help="create an example job file")
     p.set_defaults(fn=cmd_init)

@@ -367,6 +367,7 @@ class ClientAgent:
                    if g.name == alloc.task_group), None)
         if tg is None:
             return
+        runner = self.alloc_runners.get(alloc.id)
         with self._consul_lock:
             if alloc.id in self._consul_removed:
                 return  # alloc was GC'd; never re-register
@@ -376,7 +377,8 @@ class ClientAgent:
                 domain = f"task-{alloc.id}-{task.name}"
                 if (state is not None
                         and state.state == consts.TASK_STATE_RUNNING):
-                    services = task_services(alloc, task)
+                    services = task_services(
+                        alloc, task, env=self._task_env(runner, alloc, task))
                     if services:
                         self.syncer.set_services(domain, services)
                         domains.add(domain)
@@ -385,6 +387,23 @@ class ClientAgent:
                     domains.discard(domain)
             if not domains:
                 self._consul_domains.pop(alloc.id, None)
+
+    def _task_env(self, runner, alloc: Allocation, task):
+        """The task's real env (actual dir paths) for service
+        interpolation; None falls back to identity-only vars."""
+        if runner is None:
+            return None
+        task_dir = runner.alloc_dir.task_dirs.get(task.name)
+        if task_dir is None:
+            return None
+        from .allocdir import TASK_LOCAL, TASK_SECRETS
+        from .env import build_task_env
+
+        return build_task_env(
+            alloc, task, runner.alloc_dir.shared_dir,
+            os.path.join(task_dir, TASK_LOCAL),
+            os.path.join(task_dir, TASK_SECRETS),
+        )
 
     def _remove_alloc_services(self, alloc_id: str) -> None:
         if self.syncer is None:

@@ -28,15 +28,49 @@ class FieldSchema:
         config = config or {}
         errors = []
         for key, f in self.fields.items():
-            if f.required and key not in config:
+            if not f.required:
+                continue
+            # An empty string is as useless as a missing key for the
+            # required (string) fields — reject both, like the old
+            # per-driver `if not config.get(...)` checks did.
+            if key not in config or config[key] in ("", None):
                 errors.append(f"{where}: missing required key {key!r}")
+
+        def _weak_int(v):
+            if isinstance(v, bool):
+                return False
+            if isinstance(v, int):
+                return True
+            if isinstance(v, str):
+                try:
+                    int(v)
+                    return True
+                except ValueError:
+                    return False
+            return False
+
+        def _weak_float(v):
+            if isinstance(v, bool):
+                return False
+            if isinstance(v, (int, float)):
+                return True
+            if isinstance(v, str):
+                try:
+                    float(v)
+                    return True
+                except ValueError:
+                    return False
+            return False
+
+        # WeakDecode semantics (helper/fields via mapstructure): HCL
+        # users write numbers/bools as strings freely.
         checkers = {
             "any": lambda v: True,
             "string": lambda v: isinstance(v, str),
-            "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
-            "float": lambda v: isinstance(v, (int, float))
-            and not isinstance(v, bool),
-            "bool": lambda v: isinstance(v, bool),
+            "int": _weak_int,
+            "float": _weak_float,
+            "bool": lambda v: isinstance(v, bool)
+            or (isinstance(v, str) and v.lower() in ("true", "false")),
             "list": lambda v: isinstance(v, list),
             "map": lambda v: isinstance(v, dict),
         }
@@ -45,8 +79,11 @@ class FieldSchema:
             if f is None:
                 errors.append(f"{where}: unknown key {key!r}")
                 continue
-            ok = checkers[f.type](value)
-            if not ok:
+            if isinstance(value, str) and "${" in value:
+                # Interpolated at start time (utils/interpolate.py);
+                # its post-substitution type can't be known yet.
+                continue
+            if not checkers[f.type](value):
                 errors.append(
                     f"{where}: key {key!r} must be a {f.type}, "
                     f"got {type(value).__name__}")

@@ -45,5 +45,10 @@ def build_task_env(alloc: Allocation, task: Task, alloc_dir: str,
     for meta in metas:
         for k, v in (meta or {}).items():
             env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = v
-    env.update(task.env or {})
+    # User env values may reference the NOMAD_* variables built above
+    # (env.go ParseAndReplace).
+    from ..utils.interpolate import replace_env
+
+    for k, v in (task.env or {}).items():
+        env[k] = replace_env(str(v), env)
     return env

@@ -211,7 +211,19 @@ class TaskRunner:
                     with self._lock:
                         killed_during_start = self._kill.is_set()
                 else:
-                    handle = driver.start(ctx, self.task)
+                    # Driver config strings may reference the task env
+                    # (env.go ParseAndReplace): interpolate a start-time
+                    # copy; the stored task keeps the raw spec.
+                    from dataclasses import replace as _dc_replace
+
+                    from ..utils.interpolate import interpolate_value
+
+                    start_task = _dc_replace(
+                        self.task,
+                        config=interpolate_value(self.task.config or {},
+                                                 ctx.env),
+                    )
+                    handle = driver.start(ctx, start_task)
                     with self._lock:
                         self.handle = handle
                         self.handle_id = handle.id()

@@ -268,16 +268,25 @@ class ConsulSyncer:
 # --------------------------------------------------------------- helpers
 
 
-def task_services(alloc, task) -> List[ConsulService]:
+def task_services(alloc, task, env: Optional[Dict[str, str]] = None
+                  ) -> List[ConsulService]:
     """Build the consul services a running task advertises, resolving
     port labels against the alloc's assigned networks (the reference
-    maps Service.PortLabel through the task's NetworkResource)."""
+    maps Service.PortLabel through the task's NetworkResource) and
+    interpolating ${NOMAD_*} in names/tags (syncer.go uses the task
+    env the same way). Pass the task's real env when available (the
+    client does); the fallback env has empty dir paths."""
+    from ..client.env import build_task_env
+    from ..utils.interpolate import replace_env
+
     res = (alloc.task_resources or {}).get(task.name)
     labels: Dict[str, int] = {}
     address = ""
     for net in (res.networks if res is not None else []) or []:
         labels.update(net.port_labels())
         address = address or net.ip
+    if env is None:
+        env = build_task_env(alloc, task, "", "", "")
     out = []
     for svc in task.services or []:
         port = labels.get(svc.port_label, 0)
@@ -292,31 +301,35 @@ def task_services(alloc, task) -> List[ConsulService]:
             for c in svc.checks or []
         ]
         out.append(ConsulService(
-            name=svc.name, tags=list(svc.tags), port=port,
-            address=address, checks=checks,
+            name=replace_env(svc.name, env),
+            tags=[replace_env(t, env) for t in svc.tags],
+            port=port, address=address, checks=checks,
         ))
     return out
 
 
 def serf_bootstrap(server, api, service: str = "nomad", tag: str = "serf",
-                   interval: float = 15.0, stop=None) -> None:
+                   interval: float = 15.0, stop=None,
+                   self_addr: str = "") -> None:
     """Keep joining gossip peers discovered in the consul catalog until
     the server has peers (server.go:398 setupBootstrapHandler: a server
-    that knows nobody bootstraps through consul). Runs in the caller's
-    thread; pass a threading.Event as `stop` to end it."""
+    that knows nobody bootstraps through consul). The server's own
+    catalog entry is filtered out (the reference does the same), so a
+    standalone server idles on catalog polls instead of self-joining.
+    Runs in the caller's thread; pass a threading.Event as `stop` to
+    end it."""
     import time as _time
 
     while stop is None or not stop.is_set():
         try:
             if len(server.serf_members()) > 1:
                 return  # we have peers; gossip takes it from here
-            addrs = discover_servers(api, service=service, tag=tag)
+            addrs = [a for a in discover_servers(api, service=service, tag=tag)
+                     if a != self_addr]
             if addrs:
                 server.serf_join(addrs)
-                # Joining our OWN catalog entry also "succeeds", so the
-                # join count can't be trusted — only a real peer in the
-                # member list ends the bootstrap (the reference filters
-                # the local address before joining, server.go:398).
+                # A join to a stale entry can still "succeed"; only a
+                # real peer in the member list ends the bootstrap.
                 if len(server.serf_members()) > 1:
                     return
         except Exception:  # noqa: BLE001 - consul down is soft; retry

@@ -264,3 +264,28 @@ def test_client_restart_reattaches_tasks(tmp_path):
     finally:
         http.stop()
         server.shutdown()
+
+
+def test_driver_config_interpolation(cluster, tmp_path):
+    """${NOMAD_*} vars in driver config are interpolated at start
+    (env.go ParseAndReplace through the task runner)."""
+    server, agent = cluster
+    out_file = tmp_path / "interp.out"
+    job = mock_driver_job(job_type="batch")
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", f"echo alloc=${{NOMAD_ALLOC_ID}} > {out_file}"],
+    }
+    server.job_register(job)
+    assert wait_until(
+        lambda: all(
+            a.client_status == consts.ALLOC_CLIENT_COMPLETE
+            for a in server.fsm.state.allocs_by_job(job.id)
+        )
+        and len(server.fsm.state.allocs_by_job(job.id)) == 1
+    )
+    alloc = server.fsm.state.allocs_by_job(job.id)[0]
+    content = out_file.read_text().strip()
+    assert content == f"alloc={alloc.id}"

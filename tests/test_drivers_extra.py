@@ -437,3 +437,20 @@ def test_bad_driver_config_fails_task_validation(tmp_path):
     assert ts.state == consts.TASK_STATE_DEAD
     assert ts.failed
     assert any(e.validation_error for e in ts.events)
+
+
+def test_schema_weak_decode_and_interpolation_deferral():
+    from nomad_tpu.client.drivers import MockDriver, QemuDriver
+
+    # stringified numbers pass (helper/fields WeakDecode)
+    MockDriver().validate_config(
+        Task(name="t", driver="mock_driver",
+             config={"run_for": "1.5", "exit_code": "2"}))
+    # interpolated values defer to start time
+    MockDriver().validate_config(
+        Task(name="t", driver="mock_driver",
+             config={"run_for": "${NOMAD_META_DURATION}"}))
+    # empty required string is rejected like a missing key
+    with pytest.raises(ValueError, match="missing required key 'image_path'"):
+        QemuDriver().validate_config(
+            Task(name="vm", driver="qemu", config={"image_path": ""}))

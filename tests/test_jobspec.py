@@ -247,3 +247,48 @@ def test_hcl_comments_and_lists():
 def test_hcl_errors_carry_line_numbers():
     with pytest.raises(HCLParseError, match="line 2"):
         parse_hcl('ok = 1\nbad = "unterminated')
+
+
+# --------------------------------------------------- interpolation
+
+
+def test_env_value_interpolation():
+    """Task env values reference NOMAD_* vars (env.go ParseAndReplace)."""
+    from nomad_tpu import mock
+    from nomad_tpu.client.env import build_task_env
+
+    alloc = mock.alloc()
+    task = alloc.job.task_groups[0].tasks[0]
+    task.env = {"ADDR": "http://${NOMAD_IP}:8080",
+                "WHO": "${NOMAD_TASK_NAME}@${NOMAD_JOB_NAME}",
+                "MISSING": "${NOT_A_VAR}"}
+    env = build_task_env(alloc, task, "/a", "/t", "/s")
+    assert env["WHO"] == f"{task.name}@{alloc.job.name}"
+    assert env["ADDR"].startswith("http://") and "${" not in env["ADDR"]
+    assert env["MISSING"] == "${NOT_A_VAR}"  # unknown vars stay verbatim
+
+
+def test_service_name_interpolation():
+    from nomad_tpu import mock
+    from nomad_tpu.consul import task_services
+    from nomad_tpu.structs.job import Service
+
+    alloc = mock.alloc()
+    task = alloc.job.task_groups[0].tasks[0]
+    task.services = [Service(name="${NOMAD_JOB_NAME}-web",
+                             tags=["g-${NOMAD_GROUP_NAME}"],
+                             port_label="http")]
+    services = task_services(alloc, task)
+    assert services[0].name == f"{alloc.job.name}-web"
+    assert services[0].tags == [f"g-{alloc.task_group}"]
+
+
+def test_interpolate_value_recursive():
+    from nomad_tpu.utils.interpolate import interpolate_value
+
+    env = {"X": "1", "Y": "2"}
+    cfg = {"command": "/bin/${X}", "args": ["${Y}", 3, {"k": "${X}${Y}"}],
+           "n": 42}
+    out = interpolate_value(cfg, env)
+    assert out == {"command": "/bin/1", "args": ["2", 3, {"k": "12"}],
+                   "n": 42}
