@@ -391,19 +391,11 @@ class ClientAgent:
     def _task_env(self, runner, alloc: Allocation, task):
         """The task's real env (actual dir paths) for service
         interpolation; None falls back to identity-only vars."""
-        if runner is None:
+        if runner is None or task.name not in runner.alloc_dir.task_dirs:
             return None
-        task_dir = runner.alloc_dir.task_dirs.get(task.name)
-        if task_dir is None:
-            return None
-        from .allocdir import TASK_LOCAL, TASK_SECRETS
-        from .env import build_task_env
+        from .env import task_env_from_alloc_dir
 
-        return build_task_env(
-            alloc, task, runner.alloc_dir.shared_dir,
-            os.path.join(task_dir, TASK_LOCAL),
-            os.path.join(task_dir, TASK_SECRETS),
-        )
+        return task_env_from_alloc_dir(alloc, task, runner.alloc_dir)
 
     def _remove_alloc_services(self, alloc_id: str) -> None:
         if self.syncer is None:

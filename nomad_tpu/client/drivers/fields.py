@@ -81,10 +81,32 @@ class FieldSchema:
                 continue
             if isinstance(value, str) and "${" in value:
                 # Interpolated at start time (utils/interpolate.py);
-                # its post-substitution type can't be known yet.
+                # its post-substitution type can't be known yet. The
+                # task runner re-validates the interpolated config
+                # before start, so deferral never skips the check.
                 continue
             if not checkers[f.type](value):
                 errors.append(
                     f"{where}: key {key!r} must be a {f.type}, "
                     f"got {type(value).__name__}")
         return errors
+
+    def coerce(self, config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """Convert weak-decoded string values to their declared types
+        (mapstructure WeakDecode does the same); call after validate —
+        non-coercible values pass through unchanged."""
+        out = dict(config or {})
+        for key, value in out.items():
+            f = self.fields.get(key)
+            if f is None or not isinstance(value, str):
+                continue
+            try:
+                if f.type == "int":
+                    out[key] = int(value)
+                elif f.type == "float":
+                    out[key] = float(value)
+                elif f.type == "bool":
+                    out[key] = value.lower() == "true"
+            except ValueError:
+                pass
+        return out

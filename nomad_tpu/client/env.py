@@ -11,6 +11,23 @@ from typing import Dict
 from ..structs import Allocation, Task
 
 
+def task_env_from_alloc_dir(alloc: Allocation, task: Task,
+                            alloc_dir) -> Dict[str, str]:
+    """Task env with the real paths from an AllocDir — the single place
+    the dir layout maps into NOMAD_* vars (used by the task runner's
+    start context and by consul service interpolation)."""
+    import os
+
+    from .allocdir import TASK_LOCAL, TASK_SECRETS
+
+    task_dir = alloc_dir.task_dirs[task.name]
+    return build_task_env(
+        alloc, task, alloc_dir.shared_dir,
+        os.path.join(task_dir, TASK_LOCAL),
+        os.path.join(task_dir, TASK_SECRETS),
+    )
+
+
 def build_task_env(alloc: Allocation, task: Task, alloc_dir: str,
                    task_dir: str, secrets_dir: str) -> Dict[str, str]:
     env: Dict[str, str] = {

@@ -125,6 +125,8 @@ class TaskRunner:
             self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
             return
 
+        from .env import task_env_from_alloc_dir
+
         task_dir = self.alloc_dir.task_dirs[self.task.name]
         ctx = TaskContext(
             alloc_id=self.alloc.id,
@@ -132,11 +134,8 @@ class TaskRunner:
             task_dir=os.path.join(task_dir, TASK_LOCAL),
             task_root=task_dir,
             log_dir=self.alloc_dir.log_dir(),
-            env=build_task_env(
-                self.alloc, self.task, self.alloc_dir.shared_dir,
-                os.path.join(task_dir, TASK_LOCAL),
-                os.path.join(task_dir, TASK_SECRETS),
-            ),
+            env=task_env_from_alloc_dir(self.alloc, self.task,
+                                        self.alloc_dir),
             max_kill_timeout=self.max_kill_timeout,
         )
 
@@ -213,16 +212,33 @@ class TaskRunner:
                 else:
                     # Driver config strings may reference the task env
                     # (env.go ParseAndReplace): interpolate a start-time
-                    # copy; the stored task keeps the raw spec.
+                    # copy; the stored task keeps the raw spec. With the
+                    # variables substituted the schema check runs in
+                    # full (values deferred at submit time included),
+                    # then weak string values coerce to declared types.
                     from dataclasses import replace as _dc_replace
 
                     from ..utils.interpolate import interpolate_value
 
-                    start_task = _dc_replace(
-                        self.task,
-                        config=interpolate_value(self.task.config or {},
-                                                 ctx.env),
-                    )
+                    config = interpolate_value(self.task.config or {},
+                                               ctx.env)
+                    start_task = _dc_replace(self.task, config=config)
+                    try:
+                        driver.validate_config(start_task)
+                    except ValueError as e:
+                        # Permanent: a bad interpolated value won't
+                        # improve on retry. Prestart already ran, so
+                        # tear its watchers down.
+                        ev = new_task_event(
+                            consts.TASK_EVENT_FAILED_VALIDATION)
+                        ev.validation_error = str(e)
+                        self._stop_template_manager()
+                        self._stop_vault_renewal()
+                        self._emit(consts.TASK_STATE_DEAD, ev, failed=True)
+                        return
+                    if driver.config_schema is not None:
+                        start_task.config = driver.config_schema.coerce(
+                            config)
                     handle = driver.start(ctx, start_task)
                     with self._lock:
                         self.handle = handle

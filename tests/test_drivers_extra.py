@@ -454,3 +454,14 @@ def test_schema_weak_decode_and_interpolation_deferral():
     with pytest.raises(ValueError, match="missing required key 'image_path'"):
         QemuDriver().validate_config(
             Task(name="vm", driver="qemu", config={"image_path": ""}))
+
+
+def test_schema_coerce():
+    from nomad_tpu.client.drivers.fields import Field, FieldSchema
+
+    schema = FieldSchema({"n": Field("int"), "f": Field("float"),
+                          "b": Field("bool"), "s": Field("string")})
+    out = schema.coerce({"n": "5", "f": "1.5", "b": "false", "s": "x"})
+    assert out == {"n": 5, "f": 1.5, "b": False, "s": "x"}
+    # already-typed values untouched
+    assert schema.coerce({"n": 7, "b": True}) == {"n": 7, "b": True}

@@ -344,3 +344,208 @@ def test_annotate_plan():
     assert plan.annotations is not None
     desired = plan.annotations.desired_tg_updates["web"]
     assert desired.place == 2
+
+
+# ----- additional scenarios mirroring generic_sched_test.go -----------
+
+
+def test_job_register_count_zero():
+    """TestServiceSched_JobRegister_CountZero: nothing placed, no
+    failures."""
+    h = Harness(seed=50)
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 0
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", make_eval(h, job))
+    assert len(h.plans) == 0
+    assert h.state.allocs_by_job(job.id) == []
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    assert h.evals[0].queued_allocations == {"web": 0}
+
+
+def test_job_register_feasible_and_infeasible_tg():
+    """TestServiceSched_JobRegister_FeasibleAndInfeasibleTG: one group
+    places, the other reports failure and blocks."""
+    h = Harness(seed=51)
+    seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    bad = job.task_groups[0].copy()
+    bad.name = "infeasible"
+    bad.count = 2
+    bad.tasks[0].driver = "missing_driver"
+    job.task_groups.append(bad)
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", make_eval(h, job))
+
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 2
+    assert all(a.task_group == "web" for a in out)
+    update = h.evals[0]
+    assert "infeasible" in update.failed_tg_allocs
+    assert update.failed_tg_allocs["infeasible"].coalesced_failures == 1
+    assert len(h.create_evals) == 1  # blocked eval for the missing TG
+
+
+def test_evaluate_blocked_eval_unblocks_with_capacity():
+    """TestServiceSched_EvaluateBlockedEval(+_Finished): a blocked eval
+    re-processed once nodes exist places everything and completes."""
+    h = Harness(seed=52)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(h, job)
+    h.process("service", ev)
+    assert len(h.create_evals) == 1  # blocked: no nodes
+
+    blocked = h.create_evals[0]
+    seed_nodes(h, 4)
+    h.process("service", blocked)
+    out = h.state.allocs_by_job(job.id)
+    assert len(out) == 4
+    final = h.evals[-1]
+    assert final.status == consts.EVAL_STATUS_COMPLETE
+    assert not final.failed_tg_allocs
+    # no second blocked eval
+    assert len(h.create_evals) == 1
+
+
+def test_job_modify_count_zero_stops_all():
+    """TestServiceSched_JobModify_CountZero."""
+    h = Harness(seed=53)
+    nodes = seed_nodes(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 5
+    h.state.upsert_job(h.next_index(), job)
+    allocs = [alloc_for(job, nodes[i], i) for i in range(5)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    job2 = job.copy()
+    job2.task_groups[0].count = 0
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("service", make_eval(h, job2))
+
+    plan = h.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert len(stops) == 5
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert placed == []
+
+
+def test_job_modify_incr_count_node_limit():
+    """TestServiceSched_JobModify_IncrCount_NodeLimit: count grows, the
+    single node still fits the extra allocs (in-place + new)."""
+    h = Harness(seed=54)
+    node = mock.node()
+    node.resources.cpu = 1000
+    h.state.upsert_node(h.next_index(), node)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 256
+    job.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(job, node, 0)
+    h.state.upsert_allocs(h.next_index(), a and [a])
+
+    job2 = job.copy()
+    job2.task_groups[0].count = 3
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("service", make_eval(h, job2))
+
+    out = [x for x in h.state.allocs_by_job(job.id)
+           if x.desired_status == consts.ALLOC_DESIRED_RUN]
+    assert len(out) == 3
+    assert all(x.node_id == node.id for x in out)
+    assert h.evals[0].queued_allocations == {"web": 0}
+
+
+def test_node_update_ready_noop():
+    """TestServiceSched_NodeUpdate: a node flapping back to ready does
+    not change placements."""
+    h = Harness(seed=55)
+    nodes = seed_nodes(h, 2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    # Use the stored job (its modify index advanced on upsert; the
+    # store is copy-on-write, so the local object is stale).
+    job = h.state.job_by_id(job.id)
+    allocs = [alloc_for(job, nodes[i], i) for i in range(2)]
+    for a in allocs:
+        a.client_status = consts.ALLOC_CLIENT_RUNNING
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.state.update_node_status(h.next_index(), nodes[0].id,
+                               consts.NODE_STATUS_READY)
+    h.process("service", make_eval(h, job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    assert len(h.plans) == 0  # no-op
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+
+
+def test_node_drain_queued_allocations():
+    """TestServiceSched_NodeDrain_Queued_Allocations: migrations that
+    cannot place are reported as queued."""
+    h = Harness(seed=56)
+    node = mock.node()
+    h.state.upsert_node(h.next_index(), node)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    allocs = [alloc_for(job, node, i) for i in range(2)]
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.state.update_node_drain(h.next_index(), node.id, True)
+    h.process("service", make_eval(h, job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    # nowhere to go: both migrations queue
+    assert h.evals[0].queued_allocations == {"web": 2}
+
+
+def test_chained_alloc_previous_allocation():
+    """TestGenericSched_ChainedAlloc: replacements carry the chain of
+    previous_allocation ids."""
+    h = Harness(seed=57)
+    nodes = seed_nodes(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", make_eval(h, job))
+    first = {a.name: a for a in h.state.allocs_by_job(job.id)}
+
+    # Kill one node; its alloc is replaced with previous_allocation set.
+    victim_node = next(iter(first.values())).node_id
+    h.state.update_node_status(h.next_index(), victim_node,
+                               consts.NODE_STATUS_DOWN)
+    h2 = Harness(state=h.state, seed=58)
+    h2._next_index = h._next_index
+    h2.process("service", make_eval(h2, job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    replacements = [
+        a for lst in h2.plans[0].node_allocation.values() for a in lst
+    ]
+    assert replacements
+    for rep in replacements:
+        assert rep.previous_allocation in {a.id for a in first.values()}
+
+
+def test_batch_drained_alloc_replaced():
+    """TestBatchSched_Run_DrainedAlloc: a batch alloc on a drained node
+    is migrated."""
+    h = Harness(seed=59)
+    node = mock.node()
+    h.state.upsert_node(h.next_index(), node)
+    node2 = mock.node()
+    h.state.upsert_node(h.next_index(), node2)
+    job = mock.job()
+    job.type = "batch"
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    a = alloc_for(job, node, 0)
+    a.client_status = consts.ALLOC_CLIENT_RUNNING
+    h.state.upsert_allocs(h.next_index(), [a])
+
+    h.state.update_node_drain(h.next_index(), node.id, True)
+    h.process("batch", make_eval(h, job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    placed = [x for lst in h.plans[0].node_allocation.values() for x in lst]
+    assert len(placed) == 1
+    assert placed[0].node_id == node2.id
