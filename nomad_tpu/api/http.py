@@ -19,7 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..state import watch
-from ..structs import Allocation, Evaluation, Job, Node
+from ..structs import Allocation, Evaluation, Job, Node, Plan
 from ..utils import metrics
 from ..utils.codec import from_dict, to_dict
 
@@ -176,6 +176,16 @@ class HTTPServer:
             (r"^/v1/client/fs/logs/(?P<alloc_id>[^/]+)$", self._fs_logs),
             (r"^/v1/client/stats$", self._client_stats),
             (r"^/v1/client/allocation/(?P<alloc_id>[^/]+)/stats$", self._client_alloc_stats),
+            # follower->leader forwarding targets (rpc.go:178 forward);
+            # served by the leader for remote followers' workers/timers
+            (r"^/v1/internal/eval/dequeue$", self._internal_eval_dequeue),
+            (r"^/v1/internal/eval/ack$", self._internal_eval_ack),
+            (r"^/v1/internal/eval/nack$", self._internal_eval_nack),
+            (r"^/v1/internal/eval/pause-nack$", self._internal_eval_pause),
+            (r"^/v1/internal/eval/resume-nack$", self._internal_eval_resume),
+            (r"^/v1/internal/eval/outstanding$", self._internal_eval_outstanding),
+            (r"^/v1/internal/plan/submit$", self._internal_plan_submit),
+            (r"^/v1/internal/heartbeat/reset$", self._internal_heartbeat_reset),
         ]
         client_only_ok = {
             self._fs_ls, self._fs_stat, self._fs_cat, self._fs_readat,
@@ -427,10 +437,85 @@ class HTTPServer:
 
     # ----------------------------------------------------------- system
 
+    # ---------------------------------------- internal leader routes
+
+    def _require_leader(self):
+        if not self.server.is_leader():
+            raise HTTPError(400, "not the leader")
+
+    def _internal_eval_dequeue(self, method, query, body):
+        self._require_leader()
+        timeout = min(float(body.get("timeout", 1.0)), MAX_BLOCKING_WAIT)
+        ev, token = self.server.broker.dequeue(
+            body.get("schedulers") or [], timeout)
+        return {"eval": to_dict(ev) if ev is not None else None,
+                "token": token}
+
+    def _internal_eval_ack(self, method, query, body):
+        self._require_leader()
+        self.server.broker.ack(body["eval_id"], body["token"])
+        return {}
+
+    def _internal_eval_nack(self, method, query, body):
+        self._require_leader()
+        self.server.broker.nack(body["eval_id"], body["token"])
+        return {}
+
+    def _internal_eval_pause(self, method, query, body):
+        self._require_leader()
+        self.server.broker.pause_nack_timeout(body["eval_id"], body["token"])
+        return {}
+
+    def _internal_eval_resume(self, method, query, body):
+        self._require_leader()
+        self.server.broker.resume_nack_timeout(body["eval_id"], body["token"])
+        return {}
+
+    def _internal_eval_outstanding(self, method, query, body):
+        self._require_leader()
+        return {"token": self.server.broker.outstanding(body["eval_id"])}
+
+    def _internal_plan_submit(self, method, query, body):
+        self._require_leader()
+        plan = from_dict(Plan, body["plan"])
+        result = self.server.plan_submit(plan)
+        return {"result": to_dict(result)}
+
+    def _internal_heartbeat_reset(self, method, query, body):
+        self._require_leader()
+        return {"ttl": self.server.heartbeats.reset_timer(body["node_id"])}
+
     def _status_leader(self, method, query, body):
-        return self.addr if self.server.is_leader() else ""
+        if self.server.is_leader():
+            # Prefer our ADVERTISED http addr from serf tags; self.addr
+            # is built from the bind host and may be 0.0.0.0.
+            serf = getattr(self.server, "serf", None)
+            if serf is not None:
+                advertised = serf._local.tags.get("http_addr")
+                if advertised:
+                    return advertised
+            return self.addr
+        # Raft follower: resolve the leader's raft address to its HTTP
+        # address through serf tags (status_endpoint.go Leader).
+        raft = getattr(self.server, "raft", None)
+        if raft is not None and raft.leader_id:
+            for m in self.server.serf_members():
+                if m.tags.get("rpc_addr") == raft.leader_id:
+                    return m.tags.get("http_addr") or ""
+        return ""
 
     def _status_peers(self, method, query, body):
+        raft = getattr(self.server, "raft", None)
+        if raft is not None:
+            # every same-region ALIVE server advertising a raft address
+            peers = sorted(
+                m.tags.get("rpc_addr") for m in self.server.serf_members()
+                if m.tags.get("rpc_addr")
+                and getattr(m, "region", None) == self.server.config.region
+                and getattr(m, "status", "alive") == "alive"
+            )
+            if peers:
+                return peers
         return [self.addr]
 
     def _agent_self(self, method, query, body):

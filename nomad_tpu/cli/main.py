@@ -600,7 +600,7 @@ def cmd_agent(args) -> int:
     # same member name would clobber each other in the serf pool.
     node_name = cfg.name or f"{_socket.gethostname()}-{cfg.ports.http}"
 
-    server = http = None
+    server = http = raft_transport = None
     server_addr = None
     if cfg.server.enabled:
         server_cfg = ServerConfig(
@@ -623,7 +623,29 @@ def cmd_agent(args) -> int:
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
         server = Server(server_cfg)
-        server.start()
+        # bootstrap_expect > 1: real raft consensus over TCP; the
+        # cluster forms once enough servers gossip a raft address
+        # (server.go bootstrap_expect). Otherwise single-server mode.
+        multi_server = cfg.server.bootstrap_expect > 1
+        raft_transport = None
+        adv_raft = ""
+        if multi_server:
+            from ..server.transport import TCPTransport, fsm_payload_decoder
+
+            raft_transport = TCPTransport(fsm_payload_decoder)
+            raft_bind = raft_transport.serve(cfg.bind_addr, cfg.ports.rpc)
+            raft_port = int(raft_bind.rsplit(":", 1)[1])
+            adv_raft = f"{_advertise_addr(cfg)}:{raft_port}"
+            # Enter cluster mode (writes fail with no-leader) BEFORE the
+            # HTTP API serves: an early write must never land in the
+            # pre-raft dev log and silently diverge from the cluster.
+            raft_dir = (os.path.join(cfg.data_dir, "raft")
+                        if cfg.data_dir else "")
+            server.setup_raft_cluster(
+                raft_transport, adv_raft, cfg.server.bootstrap_expect,
+                data_dir=raft_dir)
+        else:
+            server.start()
         http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http)
         http.start()
         server_addr = http.addr
@@ -631,7 +653,9 @@ def cmd_agent(args) -> int:
         # address, not a wildcard bind (server.go setupSerf tags).
         advertised_http = f"http://{_advertise_addr(cfg)}:{http.port}"
         serf_addr = server.setup_serf(host=cfg.bind_addr,
-                                      http_addr=advertised_http)
+                                      port=cfg.ports.serf,
+                                      http_addr=advertised_http,
+                                      rpc_addr=adv_raft)
         if cfg.server.start_join:
             joined = server.serf_join(cfg.server.start_join)
             print(f"==> Joined {joined} gossip peers")
@@ -773,6 +797,8 @@ def cmd_agent(args) -> int:
             http.stop()
         if server is not None:
             server.shutdown()
+        if raft_transport is not None:
+            raft_transport.close()
     return 0
 
 

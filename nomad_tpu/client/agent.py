@@ -129,14 +129,17 @@ class ClientAgent:
             self.heartbeat_ttl = self.api.nodes.register(self.node)
             self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
         except APIError as e:
-            if e.status != 0:
+            if 400 <= e.status < 500:
                 raise  # the server rejected us: a real config problem
-            # Server unreachable at boot: rotate endpoints and let the
-            # heartbeat loop's re-register path bring us online
-            # (client.go registerAndHeartbeat retries forever).
+            # Server unreachable (status 0) or transiently failing
+            # (5xx, e.g. "no leader" while a raft cluster is still
+            # forming): rotate endpoints and let the heartbeat loop's
+            # re-register path bring us online (client.go
+            # registerAndHeartbeat retries forever).
             self.logger.warning(
                 "initial registration failed (%s); will retry", e)
-            self._rpc_failed()
+            if e.status == 0:
+                self._rpc_failed()
         # Vault tokens are derived through the server once the node has
         # an identity (client/vaultclient wiring, client.go:166).
         from .vaultclient import VaultClient

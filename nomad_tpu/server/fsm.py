@@ -229,6 +229,7 @@ class FSM:
 
     def restore(self, data: dict) -> None:
         self.state = StateStore.restore(data)
+        self.last_applied_index = self.state.latest_index()
 
 
 class DevLog:

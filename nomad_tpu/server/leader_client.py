@@ -1,0 +1,96 @@
+"""Remote-leader client: follower→leader forwarding over HTTP.
+
+Reference: rpc.go:178 `forward` — workers and endpoints on a follower
+route leader-only operations (eval broker dequeue/ack/nack, plan
+submit, heartbeat timers) to the current leader. The reference pipes
+them over its msgpack RPC; here they ride the same HTTP substrate as
+everything else, on internal /v1/internal/* routes the leader serves.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+from ..structs import Evaluation, Plan, PlanResult
+from ..utils.codec import from_dict, to_dict
+
+
+class LeaderUnavailableError(Exception):
+    pass
+
+
+class RemoteLeader:
+    """Leader-only operations executed on a remote leader."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = addr.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, path: str, body: dict, timeout: Optional[float] = None):
+        req = urllib.request.Request(
+            self.addr + path, data=json.dumps(body).encode(), method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout
+            ) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                message = str(e)
+            raise LeaderUnavailableError(message) from None
+        except (urllib.error.URLError, OSError) as e:
+            raise LeaderUnavailableError(str(e)) from None
+
+    # ------------------------------------------------------------ evals
+
+    def eval_dequeue(self, schedulers: List[str],
+                     timeout: float) -> Tuple[Optional[Evaluation], str]:
+        out = self._call(
+            "/v1/internal/eval/dequeue",
+            {"schedulers": schedulers, "timeout": timeout},
+            timeout=timeout + 10.0,
+        )
+        ev = from_dict(Evaluation, out.get("eval")) if out.get("eval") else None
+        return ev, out.get("token", "")
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        self._call("/v1/internal/eval/ack",
+                   {"eval_id": eval_id, "token": token})
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        self._call("/v1/internal/eval/nack",
+                   {"eval_id": eval_id, "token": token})
+
+    def eval_pause_nack(self, eval_id: str, token: str) -> None:
+        self._call("/v1/internal/eval/pause-nack",
+                   {"eval_id": eval_id, "token": token})
+
+    def eval_resume_nack(self, eval_id: str, token: str) -> None:
+        self._call("/v1/internal/eval/resume-nack",
+                   {"eval_id": eval_id, "token": token})
+
+    def eval_outstanding(self, eval_id: str) -> Optional[str]:
+        out = self._call("/v1/internal/eval/outstanding",
+                         {"eval_id": eval_id})
+        return out.get("token") or None
+
+    # ------------------------------------------------------------ plans
+
+    def plan_submit(self, plan: Plan) -> PlanResult:
+        out = self._call("/v1/internal/plan/submit",
+                         {"plan": to_dict(plan)}, timeout=40.0)
+        return from_dict(PlanResult, out["result"])
+
+    # ------------------------------------------------------- heartbeats
+
+    def heartbeat_reset(self, node_id: str) -> float:
+        out = self._call("/v1/internal/heartbeat/reset",
+                         {"node_id": node_id})
+        return float(out.get("ttl", 0.0))

@@ -9,9 +9,16 @@ services, and write forwarding to the leader. Transports are
 pluggable: in-memory for in-process clusters/tests, TCP/JSON for
 multi-host.
 
-Not implemented (acceptable for the capability target): log
-compaction/snapshot install (the FSM has persist()/restore() ready) and
-dynamic membership change.
+Durability and compaction (reference: raft-boltdb log + FSM snapshot
+files, fsm.go:506, server.go:50): with a RaftStorage attached, the
+term/vote metadata is fsynced before votes, the log is persisted and
+replayed on restart, the FSM snapshots every `snapshot_threshold`
+applies (retained files, log truncated), and followers too far behind
+the compacted log receive an InstallSnapshot RPC.
+
+Not implemented (acceptable for the capability target): dynamic
+membership change — the peer set is fixed when the node starts
+(bootstrap_expect semantics; see server.setup_raft_cluster).
 """
 
 from __future__ import annotations
@@ -55,6 +62,9 @@ class NotLeaderError(Exception):
         self.leader_id = leader_id
 
 
+NOOP_TYPE = "_raft.noop"  # leadership barrier entry; never hits the FSM
+
+
 class Transport:
     """RPC transport between raft peers."""
 
@@ -62,6 +72,9 @@ class Transport:
         raise NotImplementedError
 
     def append_entries(self, peer: str, args: dict) -> Optional[dict]:
+        raise NotImplementedError
+
+    def install_snapshot(self, peer: str, args: dict) -> Optional[dict]:
         raise NotImplementedError
 
     def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
@@ -100,6 +113,12 @@ class InmemTransport(Transport):
             return None
         return node.handle_append_entries(args)
 
+    def install_snapshot(self, peer: str, args: dict) -> Optional[dict]:
+        node = self.nodes.get(peer)
+        if node is None or not self._reachable(args["leader_id"], peer):
+            return None
+        return node.handle_install_snapshot(args)
+
     def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
         node = self.nodes.get(peer)
         if node is None or peer in self.disconnected:
@@ -115,22 +134,37 @@ class RaftNode:
         transport: Transport,
         fsm_apply: Callable[[int, str, Any], Any],
         on_leadership: Callable[[bool], None],
+        fsm_snapshot: Optional[Callable[[], dict]] = None,
+        fsm_restore: Optional[Callable[[dict], None]] = None,
+        storage=None,
+        snapshot_threshold: int = 0,
     ):
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.transport = transport
         self.fsm_apply = fsm_apply
         self.on_leadership = on_leadership
+        self.fsm_snapshot = fsm_snapshot
+        self.fsm_restore = fsm_restore
+        self.storage = storage
+        self.snapshot_threshold = snapshot_threshold
         self.logger = logging.getLogger(f"nomad_tpu.raft.{node_id}")
 
         self._lock = threading.RLock()
         self.state = FOLLOWER
         self.current_term = 0
         self.voted_for: Optional[str] = None
-        self.log: List[LogEntry] = []  # 1-indexed via helpers
+        self.log: List[LogEntry] = []  # indexes log_offset+1 .. via helpers
+        # Compaction: everything at or below log_offset lives only in
+        # the latest snapshot (log_offset = snapshot's last index).
+        self.log_offset = 0
+        self.snapshot_term = 0
+        self._latest_snapshot: Optional[tuple] = None  # (index, term, data)
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: Optional[str] = None
+        if storage is not None:
+            self._restore_from_storage()
 
         # leader volatile state
         self.next_index: Dict[str, int] = {}
@@ -191,18 +225,53 @@ class RaftNode:
         if was_leader:
             self.on_leadership(False)  # dispatcher stopped; call direct
 
+    # ----------------------------------------------------- persistence
+
+    def _restore_from_storage(self) -> None:
+        """Snapshot install + log replay on restart (the reference's
+        raft does the same from raft.db + snapshot files)."""
+        self.current_term, self.voted_for = self.storage.load_meta()
+        snap = self.storage.load_latest_snapshot()
+        if snap is not None:
+            index, term, data = snap
+            if self.fsm_restore is not None:
+                self.fsm_restore(data)
+            self.log_offset = index
+            self.snapshot_term = term
+            self.commit_index = index
+            self.last_applied = index
+            self._latest_snapshot = snap
+        entries = [e for e in self.storage.load_log(LogEntry)
+                   if e.index > self.log_offset]
+        # Guard against a gap (snapshot newer than the log tail).
+        expect = self.log_offset + 1
+        for e in entries:
+            if e.index != expect:
+                break
+            self.log.append(e)
+            expect += 1
+        if self.log or snap is not None:
+            self.logger.info(
+                "restored raft state: snapshot@%d + %d log entries",
+                self.log_offset, len(self.log))
+
+    def _persist_meta(self) -> None:
+        if self.storage is not None:
+            self.storage.save_meta(self.current_term, self.voted_for)
+
     # ----------------------------------------------------- log helpers
 
     def _last_log_index(self) -> int:
-        return self.log[-1].index if self.log else 0
+        return self.log[-1].index if self.log else self.log_offset
 
     def _last_log_term(self) -> int:
-        return self.log[-1].term if self.log else 0
+        return self.log[-1].term if self.log else self.snapshot_term
 
     def _entry_at(self, index: int) -> Optional[LogEntry]:
-        if index <= 0 or index > len(self.log):
+        i = index - self.log_offset
+        if i <= 0 or i > len(self.log):
             return None
-        return self.log[index - 1]
+        return self.log[i - 1]
 
     @staticmethod
     def _next_election_deadline() -> float:
@@ -225,6 +294,7 @@ class RaftNode:
             )
             if self.voted_for in (None, args["candidate_id"]) and up_to_date:
                 self.voted_for = args["candidate_id"]
+                self._persist_meta()  # durable before the vote leaves
                 self._election_deadline = self._next_election_deadline()
                 return {"term": self.current_term, "vote_granted": True}
             return {"term": self.current_term, "vote_granted": False}
@@ -241,26 +311,71 @@ class RaftNode:
 
             prev_index = args["prev_log_index"]
             prev_term = args["prev_log_term"]
-            if prev_index > 0:
+            if prev_index > 0 and prev_index != self.log_offset:
                 entry = self._entry_at(prev_index)
                 if entry is None or entry.term != prev_term:
                     return {"term": self.current_term, "success": False}
+            if prev_index == self.log_offset and self.log_offset > 0:
+                if prev_term != self.snapshot_term:
+                    return {"term": self.current_term, "success": False}
 
             # Append, truncating conflicts.
+            truncated = False
+            appended = []
             for raw in args["entries"]:
                 entry = LogEntry(**raw) if isinstance(raw, dict) else raw
+                if entry.index <= self.log_offset:
+                    continue  # already compacted into the snapshot
                 existing = self._entry_at(entry.index)
                 if existing is not None and existing.term != entry.term:
-                    del self.log[entry.index - 1 :]
+                    del self.log[entry.index - 1 - self.log_offset:]
+                    truncated = True
                     existing = None
                 if existing is None:
                     self.log.append(entry)
+                    appended.append(entry)
+            if self.storage is not None:
+                if truncated:
+                    self.storage.rewrite_log(self.log)
+                else:
+                    for entry in appended:
+                        self.storage.append_entry(entry)
 
             if args["leader_commit"] > self.commit_index:
                 self.commit_index = min(
                     args["leader_commit"], self._last_log_index()
                 )
             return {"term": self.current_term, "success": True}
+
+    def handle_install_snapshot(self, args: dict) -> dict:
+        """A follower too far behind the leader's compacted log gets
+        the whole FSM snapshot (raft InstallSnapshot)."""
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = args["leader_id"]
+            self._election_deadline = self._next_election_deadline()
+            last_index = args["last_index"]
+            if last_index <= self.log_offset:
+                return {"term": self.current_term}  # already have it
+            if self.fsm_restore is not None:
+                self.fsm_restore(args["data"])
+            self.log = []
+            self.log_offset = last_index
+            self.snapshot_term = args["last_term"]
+            self.commit_index = max(self.commit_index, last_index)
+            self.last_applied = last_index
+            self._latest_snapshot = (last_index, args["last_term"],
+                                     args["data"])
+            if self.storage is not None:
+                self.storage.save_snapshot(last_index, args["last_term"],
+                                           args["data"])
+                self.storage.rewrite_log(self.log)
+            self.logger.info("installed snapshot @%d", last_index)
+            return {"term": self.current_term}
 
     # ------------------------------------------------------ elections
 
@@ -271,6 +386,7 @@ class RaftNode:
             # One vote per term: voted_for only resets on a NEW term.
             self.current_term = term
             self.voted_for = None
+            self._persist_meta()
         if was_leader:
             self._notify_leadership(False)
 
@@ -286,10 +402,14 @@ class RaftNode:
                 self.state = CANDIDATE
                 self.current_term += 1
                 self.voted_for = self.node_id
+                self._persist_meta()
                 term = self.current_term
                 self._election_deadline = self._next_election_deadline()
                 last_idx, last_term = self._last_log_index(), self._last_log_term()
-            self._campaign(term, last_idx, last_term)
+            try:
+                self._campaign(term, last_idx, last_term)
+            except Exception:  # noqa: BLE001 - the timer must survive
+                self.logger.exception("campaign failed")
 
     def _campaign(self, term: int, last_idx: int, last_term: int) -> None:
         votes = 1
@@ -301,8 +421,8 @@ class RaftNode:
         }
         for peer in self.peers:
             resp = self.transport.request_vote(peer, args)
-            if resp is None:
-                continue
+            if resp is None or "term" not in resp:
+                continue  # unreachable, or peer's raft not up yet
             with self._lock:
                 if resp["term"] > self.current_term:
                     self._become_follower(resp["term"])
@@ -317,6 +437,16 @@ class RaftNode:
                     return
                 self.state = LEADER
                 self.leader_id = self.node_id
+                # Barrier noop: raft never commits an older-term entry
+                # by counting replicas, so a fresh leader appends one
+                # entry of its own term to drive the commit index over
+                # everything inherited (also what makes restart-recovery
+                # of a single-node cluster re-apply its restored log).
+                noop = LogEntry(term, self._last_log_index() + 1,
+                                NOOP_TYPE, None)
+                self.log.append(noop)
+                if self.storage is not None:
+                    self.storage.append_entry(noop)
                 nxt = self._last_log_index() + 1
                 self.next_index = {p: nxt for p in self.peers}
                 self.match_index = {p: 0 for p in self.peers}
@@ -331,7 +461,10 @@ class RaftNode:
             with self._lock:
                 is_leader = self.state == LEADER
             if is_leader:
-                self._broadcast_heartbeat()
+                try:
+                    self._broadcast_heartbeat()
+                except Exception:  # noqa: BLE001 - must survive
+                    self.logger.exception("heartbeat broadcast failed")
             time.sleep(HEARTBEAT_INTERVAL)
 
     def _broadcast_heartbeat(self) -> None:
@@ -344,28 +477,59 @@ class RaftNode:
             if self.state != LEADER:
                 return
             next_idx = self.next_index.get(peer, self._last_log_index() + 1)
-            prev_idx = next_idx - 1
-            prev_entry = self._entry_at(prev_idx)
-            prev_term = prev_entry.term if prev_entry else 0
-            entries = [e for e in self.log[next_idx - 1 :]]
-            args = {
-                "term": self.current_term,
-                "leader_id": self.node_id,
-                "prev_log_index": prev_idx,
-                "prev_log_term": prev_term,
-                "entries": entries,
-                "leader_commit": self.commit_index,
-            }
-        resp = self.transport.append_entries(peer, args)
-        if resp is None:
+            if next_idx <= self.log_offset and self._latest_snapshot:
+                # The entries this peer needs are compacted away: ship
+                # the snapshot instead (InstallSnapshot RPC).
+                snap_index, snap_term, snap_data = self._latest_snapshot
+                install_args = {
+                    "term": self.current_term,
+                    "leader_id": self.node_id,
+                    "last_index": snap_index,
+                    "last_term": snap_term,
+                    "data": snap_data,
+                }
+            else:
+                install_args = None
+                next_idx = max(next_idx, self.log_offset + 1)
+                prev_idx = next_idx - 1
+                if prev_idx == self.log_offset:
+                    prev_term = self.snapshot_term
+                else:
+                    prev_entry = self._entry_at(prev_idx)
+                    prev_term = prev_entry.term if prev_entry else 0
+                entries = list(self.log[next_idx - 1 - self.log_offset:])
+                args = {
+                    "term": self.current_term,
+                    "leader_id": self.node_id,
+                    "prev_log_index": prev_idx,
+                    "prev_log_term": prev_term,
+                    "entries": entries,
+                    "leader_commit": self.commit_index,
+                }
+        if install_args is not None:
+            resp = self.transport.install_snapshot(peer, install_args)
+            if resp is None or "term" not in resp:
+                return
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._become_follower(resp["term"])
+                    return
+                if self.state != LEADER:
+                    return
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), install_args["last_index"])
+                self.next_index[peer] = install_args["last_index"] + 1
             return
+        resp = self.transport.append_entries(peer, args)
+        if resp is None or "term" not in resp:
+            return  # unreachable, or peer's raft not up yet
         with self._lock:
             if resp["term"] > self.current_term:
                 self._become_follower(resp["term"])
                 return
             if self.state != LEADER:
                 return
-            if resp["success"]:
+            if resp.get("success"):
                 if entries:
                     self.match_index[peer] = entries[-1].index
                     self.next_index[peer] = entries[-1].index + 1
@@ -405,6 +569,8 @@ class RaftNode:
                 term = self.current_term
                 entry = LogEntry(term, index, msg_type, payload)
                 self.log.append(entry)
+                if self.storage is not None:
+                    self.storage.append_entry(entry)
                 waiter = _ApplyWaiter()
                 self._apply_waiters[index] = (term, waiter)
         if forward:
@@ -433,7 +599,7 @@ class RaftNode:
                     self.last_applied += 1
                     entry = self._entry_at(self.last_applied)
                     waiting = self._apply_waiters.pop(self.last_applied, None)
-                    if entry is not None:
+                    if entry is not None and entry.msg_type != NOOP_TYPE:
                         try:
                             self.fsm_apply(entry.index, entry.msg_type, entry.payload)
                         except Exception:
@@ -449,8 +615,42 @@ class RaftNode:
                         )
                         waiter.event.set()
                     applied_any = True
-            if not applied_any:
+            if applied_any:
+                self._maybe_compact()
+            else:
                 time.sleep(0.005)
+
+    def _maybe_compact(self) -> None:
+        """Snapshot the FSM and truncate the applied log prefix once
+        enough entries accumulated (fsm.go:506 persist, retained files;
+        threshold 0 disables)."""
+        if (not self.snapshot_threshold or self.fsm_snapshot is None):
+            return
+        with self._lock:
+            due = (self.last_applied - self.log_offset
+                   >= self.snapshot_threshold)
+            snap_index = self.last_applied
+        if not due:
+            return
+        # Snapshotting outside the raft lock keeps elections unblocked;
+        # normally only this thread advances the FSM, but an
+        # InstallSnapshot can land concurrently — re-validate under the
+        # lock and abort if it did (the installed snapshot is newer).
+        data = self.fsm_snapshot()
+        with self._lock:
+            if self.log_offset >= snap_index or self.last_applied != snap_index:
+                return  # superseded by a concurrent snapshot install
+            entry = self._entry_at(snap_index)
+            snap_term = entry.term if entry else self.snapshot_term
+            self.log = self.log[snap_index - self.log_offset:]
+            self.log_offset = snap_index
+            self.snapshot_term = snap_term
+            self._latest_snapshot = (snap_index, snap_term, data)
+            if self.storage is not None:
+                self.storage.save_snapshot(snap_index, snap_term, data)
+                self.storage.rewrite_log(self.log)
+        self.logger.info("compacted log @%d (%d entries kept)",
+                         snap_index, len(self.log))
 
     # ------------------------------------------------------------------
 
@@ -475,6 +675,21 @@ class RaftNode:
                 "last_applied": self.last_applied,
                 "log_len": len(self.log),
             }
+
+
+class UnavailableLog:
+    """Log stand-in while a raft cluster is still forming: writes fail
+    with no-leader (the reference blocks RPC writes the same way until
+    raft elects), reads see index 0."""
+
+    def apply(self, msg_type: str, payload: Any) -> int:
+        raise NotLeaderError(None)
+
+    def last_index(self) -> int:
+        return 0
+
+    def barrier(self) -> int:
+        return 0
 
 
 class RaftLog:

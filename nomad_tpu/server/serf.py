@@ -151,7 +151,12 @@ class Serf:
                 except (OSError, ValueError):
                     pass
 
-        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        # Reuse-addr: a member restarting on its configured gossip port
+        # must not fail on TIME_WAIT sockets from its previous run.
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
         self._server.daemon_threads = True
         addr = "%s:%d" % self._server.server_address
         with self._lock:

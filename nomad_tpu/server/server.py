@@ -145,15 +145,34 @@ class Server:
         self._telemetry_thread = t
 
     def start_with_raft(self, node_id: str, peers: List[str], transport,
-                        cluster: Dict[str, "Server"]) -> None:
-        """Multi-server mode: leadership follows raft elections."""
+                        cluster: Dict[str, "Server"],
+                        data_dir: str = "",
+                        snapshot_threshold: int = 1024) -> None:
+        """Multi-server mode: leadership follows raft elections. With a
+        data_dir the raft log/meta persist and the FSM snapshots with
+        compaction (reference: raft-boltdb + fsm.go snapshots)."""
         from .raft import RaftLog, RaftNode
 
+        storage = None
+        if data_dir:
+            from .raft_storage import RaftStorage
+            from .transport import _encode_payload, fsm_payload_decoder
+
+            storage = RaftStorage(
+                data_dir,
+                encode=lambda mt, p: _encode_payload(p),
+                decode=fsm_payload_decoder,
+            )
         self.node_id = node_id
         self.cluster = cluster
         cluster[node_id] = self
         self.raft = RaftNode(
-            node_id, peers, transport, self.fsm.apply, self._leadership_changed
+            node_id, peers, transport, self.fsm.apply,
+            self._leadership_changed,
+            fsm_snapshot=self.fsm.snapshot_data,
+            fsm_restore=self.fsm.restore,
+            storage=storage,
+            snapshot_threshold=snapshot_threshold if storage else 0,
         )
         self.log = RaftLog(self.raft)
         self.plan_applier.log = self.log
@@ -164,6 +183,48 @@ class Server:
             worker.start()
         self.raft.start()
         self._start_telemetry()
+
+    def setup_raft_cluster(self, transport, raft_addr: str, expect: int,
+                           data_dir: str = "",
+                           snapshot_threshold: int = 1024) -> None:
+        """Form a raft cluster through gossip: wait until
+        `bootstrap_expect` same-region servers advertise a raft address
+        in their serf tags, then start raft over that fixed peer set
+        (server.go bootstrap_expect + leader.go peer wiring). Until
+        then, writes fail with no-leader.
+
+        The peer set is fixed at formation (RaftNode has no dynamic
+        membership): every server must use the same bootstrap_expect
+        and be present when the cluster forms."""
+        from .raft import UnavailableLog
+
+        self.log = UnavailableLog()
+        self.plan_applier.log = self.log
+
+        def wait_and_start():
+            while not self._shutdown:
+                members = [
+                    m for m in self.serf_members()
+                    if getattr(m, "region", None) == self.config.region
+                    and getattr(m, "status", "alive") == "alive"
+                ]
+                addrs = sorted(
+                    {m.tags.get("rpc_addr") for m in members
+                     if m.tags.get("rpc_addr")} | {raft_addr}
+                )
+                if len(addrs) >= expect:
+                    self.logger.info(
+                        "raft bootstrap reached %d servers: %s",
+                        len(addrs), addrs)
+                    self.start_with_raft(
+                        raft_addr, addrs, transport, {},
+                        data_dir=data_dir,
+                        snapshot_threshold=snapshot_threshold)
+                    return
+                time.sleep(0.5)
+
+        threading.Thread(target=wait_and_start, daemon=True,
+                         name="raft-bootstrap").start()
 
     def _leadership_changed(self, is_leader: bool) -> None:
         # Serialized: elections can flap faster than the services
@@ -184,9 +245,39 @@ class Server:
             return None
         return self.cluster.get(leader_id)
 
+    def leader_http_addr(self) -> Optional[str]:
+        """The leader's advertised HTTP address, resolved through serf
+        tags (how followers route to the leader in TCP mode)."""
+        leader_id = self.raft.leader_id if self.raft is not None else None
+        if leader_id is None:
+            return None
+        for m in self.serf_members():
+            if m.tags.get("rpc_addr") == leader_id:
+                return m.tags.get("http_addr") or None
+        return None
+
+    def _remote_leader(self):
+        """Remote-leader proxy for TCP multi-server mode (rpc.go:178
+        forward): used when the leader isn't an in-process Server."""
+        addr = self.leader_http_addr()
+        if addr is None:
+            return None
+        from .leader_client import RemoteLeader
+
+        cached = getattr(self, "_remote_leader_cache", None)
+        if cached is None or cached.addr != addr.rstrip("/"):
+            cached = RemoteLeader(addr)
+            self._remote_leader_cache = cached
+        return cached
+
     def _reset_heartbeat(self, node_id: str) -> float:
         leader = self._leader_server()
-        return leader.heartbeats.reset_timer(node_id) if leader is not None else 0.0
+        if leader is not None:
+            return leader.heartbeats.reset_timer(node_id)
+        remote = self._remote_leader()
+        if remote is not None:
+            return remote.heartbeat_reset(node_id)
+        return 0.0
 
     def _clear_heartbeat(self, node_id: str) -> None:
         leader = self._leader_server()
@@ -687,36 +778,66 @@ class Server:
         self, schedulers: List[str], timeout: float
     ) -> Tuple[Optional[Evaluation], str]:
         leader = self._leader_server()
-        if leader is None:
-            time.sleep(min(timeout, 0.2))
-            return None, ""
-        return leader.broker.dequeue(schedulers, timeout)
+        if leader is not None:
+            return leader.broker.dequeue(schedulers, timeout)
+        remote = self._remote_leader()
+        if remote is not None:
+            try:
+                return remote.eval_dequeue(schedulers, timeout)
+            except Exception:  # noqa: BLE001 - leader flap: retry later
+                pass
+        time.sleep(min(timeout, 0.2))
+        return None, ""
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
-        if leader is None:
+        if leader is not None:
+            leader.broker.ack(eval_id, token)
+            return
+        remote = self._remote_leader()
+        if remote is None:
             raise ValueError("no leader")
-        leader.broker.ack(eval_id, token)
+        remote.eval_ack(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
-        if leader is None:
+        if leader is not None:
+            leader.broker.nack(eval_id, token)
+            return
+        remote = self._remote_leader()
+        if remote is None:
             raise ValueError("no leader")
-        leader.broker.nack(eval_id, token)
+        remote.eval_nack(eval_id, token)
 
     def eval_pause_nack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
         if leader is not None:
             leader.broker.pause_nack_timeout(eval_id, token)
+            return
+        remote = self._remote_leader()
+        if remote is not None:
+            remote.eval_pause_nack(eval_id, token)
 
     def eval_resume_nack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
         if leader is not None:
             leader.broker.resume_nack_timeout(eval_id, token)
+            return
+        remote = self._remote_leader()
+        if remote is not None:
+            remote.eval_resume_nack(eval_id, token)
 
     def eval_outstanding(self, eval_id: str) -> Optional[str]:
         leader = self._leader_server()
-        return leader.broker.outstanding(eval_id) if leader is not None else None
+        if leader is not None:
+            return leader.broker.outstanding(eval_id)
+        remote = self._remote_leader()
+        if remote is not None:
+            try:
+                return remote.eval_outstanding(eval_id)
+            except Exception:  # noqa: BLE001
+                return None
+        return None
 
     def eval_reap(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
         # Reaped allocs take their derived vault tokens with them
@@ -738,7 +859,10 @@ class Server:
         split-brain guard: it must still be the outstanding token."""
         leader = self._leader_server()
         if leader is None:
-            raise ValueError("no leader to submit plan to")
+            remote = self._remote_leader()
+            if remote is None:
+                raise ValueError("no leader to submit plan to")
+            return remote.plan_submit(plan)
         token = leader.broker.outstanding(plan.eval_id)
         if token != plan.eval_token:
             raise ValueError("plan's eval token does not match outstanding eval")

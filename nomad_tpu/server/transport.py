@@ -95,7 +95,12 @@ class TCPTransport(Transport):
                 except (OSError, ValueError):
                     pass
 
-        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        # Reuse-addr: an agent restarting on its configured port must
+        # not fail on TIME_WAIT sockets from its previous run.
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
         self._server.daemon_threads = True
         self.addr = "%s:%d" % self._server.server_address
         t = threading.Thread(
@@ -127,6 +132,8 @@ class TCPTransport(Transport):
                 for e in args["entries"]
             ]
             return self.node.handle_append_entries(args)
+        if kind == "install_snapshot":
+            return self.node.handle_install_snapshot(msg["args"])
         if kind == "forward_apply":
             index = self.node.apply(
                 msg["msg_type"], self.decode_payload(msg["msg_type"], msg["payload"])
@@ -150,6 +157,12 @@ class TCPTransport(Transport):
 
     def request_vote(self, peer: str, args: dict) -> Optional[dict]:
         return self._call(peer, {"kind": "request_vote", "args": args})
+
+    def install_snapshot(self, peer: str, args: dict) -> Optional[dict]:
+        # FSM snapshot data is already wire-safe (state.persist() emits
+        # plain dicts), so it ships as-is.
+        return self._call(peer, {"kind": "install_snapshot", "args": args},
+                          timeout=30.0)
 
     def append_entries(self, peer: str, args: dict) -> Optional[dict]:
         wire_args = dict(args)
