@@ -43,8 +43,58 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> bool:
     return fit
 
 
+class OptimisticSnapshot:
+    """Base snapshot + accepted allocations of in-flight plans — the
+    read view for verifying plan N+1 while plan N's commit is still in
+    flight (plan_apply.go:155-161 optimistic snap.UpsertAllocs).
+    Exposes exactly what evaluate_node_plan reads."""
+
+    def __init__(self, base):
+        self.base = base
+        self._extra_by_node = {}  # node_id -> {alloc_id: alloc}
+        self._evicted = set()  # alloc ids stopped by in-flight plans
+        self._dirty = False
+
+    def add_result(self, result: PlanResult) -> None:
+        for node_id, allocs in result.node_allocation.items():
+            d = self._extra_by_node.setdefault(node_id, {})
+            for alloc in allocs:
+                d[alloc.id] = alloc
+        for allocs in result.node_update.values():
+            for alloc in allocs:
+                self._evicted.add(alloc.id)
+        self._dirty = True
+
+    def node_by_id(self, node_id):
+        return self.base.node_by_id(node_id)
+
+    def latest_index(self) -> int:
+        # With a commit in flight, a plan rejected off this view must
+        # refresh PAST the in-flight commit — otherwise the worker's
+        # "refresh" is a no-op against pre-commit state and it spins
+        # resubmitting the same plan (the reference advances its
+        # optimistic snapshot's index the same way).
+        return self.base.latest_index() + (1 if self._dirty else 0)
+
+    def allocs_by_node_terminal(self, node_id, terminal):
+        live = {
+            a.id: a
+            for a in self.base.allocs_by_node_terminal(node_id, terminal)
+            if a.id not in self._evicted
+        }
+        if not terminal:
+            live.update(self._extra_by_node.get(node_id, {}))
+        return list(live.values())
+
+
 class PlanApplier:
-    """Consumes the plan queue; runs as a leader-only thread."""
+    """Consumes the plan queue; runs as a leader-only thread.
+
+    Pipelined like the reference (plan_apply.go:41-118): one raft
+    commit is in flight at a time while the NEXT plan is verified
+    against an optimistic snapshot that includes the in-flight plan's
+    accepted allocations. A failed commit forces the following plan to
+    re-verify on a fresh snapshot."""
 
     def __init__(self, plan_queue: PlanQueue, fsm, log, pool_size: int = 2,
                  logger: Optional[logging.Logger] = None):
@@ -54,6 +104,10 @@ class PlanApplier:
         self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
         self.pool = ThreadPoolExecutor(
             max_workers=max(pool_size, 1), thread_name_prefix="plan-eval"
+        )
+        # Dedicated single-thread executor: commits stay ordered.
+        self._commit_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="plan-commit"
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -77,31 +131,71 @@ class PlanApplier:
                 self._thread = None
 
     def _run(self) -> None:
+        inflight = None  # (future, pending, result)
+        optimistic: Optional[OptimisticSnapshot] = None
         while not self._stop.is_set():
-            pending = self.plan_queue.dequeue(timeout=0.25)
+            pending = self.plan_queue.dequeue(
+                timeout=0.02 if inflight else 0.25)
             if pending is None:
+                if inflight is not None:
+                    self._finish_commit(inflight)
+                    inflight = None
+                optimistic = None  # queue drained: next gets fresh state
                 continue
+            if optimistic is None:
+                optimistic = OptimisticSnapshot(self.fsm.state.snapshot())
             try:
-                result = self._apply_one(pending.plan)
-                pending.respond(result, None)
+                start = time.monotonic()
+                # Verified against the optimistic view WHILE the
+                # previous plan's raft commit is still in flight — the
+                # reference's verify-(N+1)-during-commit-(N) overlap.
+                result = self._evaluate_plan(optimistic, pending.plan)
+                metrics.measure_since(("plan", "evaluate"), start)
             except Exception as e:  # noqa: BLE001 - fail the one plan
-                self.logger.exception("plan apply failed")
+                self.logger.exception("plan evaluate failed")
                 pending.respond(None, e)
+                continue
+            if inflight is not None:
+                ok = self._finish_commit(inflight)
+                inflight = None
+                # Rebase on committed state either way: staleness is
+                # bounded to one commit's duration (the old per-plan
+                # fresh snapshot invariant, now per-commit), and node
+                # drains/client updates applied meanwhile are seen.
+                optimistic = OptimisticSnapshot(self.fsm.state.snapshot())
+                if not ok:
+                    # The old view contained allocs that never landed:
+                    # this plan's verification must be redone.
+                    try:
+                        result = self._evaluate_plan(optimistic,
+                                                     pending.plan)
+                    except Exception as e:  # noqa: BLE001
+                        pending.respond(None, e)
+                        continue
+            if result.is_no_op():
+                pending.respond(result, None)
+                continue
+            fut = self._commit_pool.submit(self._commit, pending.plan, result)
+            optimistic.add_result(result)
+            inflight = (fut, pending, result)
+        if inflight is not None:
+            self._finish_commit(inflight)
 
-    # ------------------------------------------------------------------
-
-    def _apply_one(self, plan: Plan) -> PlanResult:
-        snapshot = self.fsm.state.snapshot()
-        start = time.monotonic()
-        result = self._evaluate_plan(snapshot, plan)
-        metrics.measure_since(("plan", "evaluate"), start)
-        if result.is_no_op():
-            return result
-        start = time.monotonic()
-        alloc_index = self._commit(plan, result)
-        metrics.measure_since(("plan", "submit"), start)
-        result.alloc_index = alloc_index
-        return result
+    def _finish_commit(self, inflight) -> bool:
+        """Wait out an in-flight raft commit and answer its waiter;
+        False when the commit failed (asyncPlanWait, plan_apply.go:166).
+        No extra timeout here: log.apply has its own bounded timeouts,
+        and abandoning a still-running commit would let it land after
+        the waiter was told it failed (double-commit on retry)."""
+        fut, pending, result = inflight
+        try:
+            result.alloc_index = fut.result()
+            pending.respond(result, None)
+            return True
+        except Exception as e:  # noqa: BLE001 - fail the one plan
+            self.logger.exception("plan commit failed")
+            pending.respond(None, e)
+            return False
 
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
         """Per-node verification with partial commit
@@ -132,6 +226,7 @@ class PlanApplier:
         return result
 
     def _commit(self, plan: Plan, result: PlanResult) -> int:
+        start = time.monotonic()
         allocs: List[Allocation] = []
         for update_list in result.node_update.values():
             allocs.extend(update_list)
@@ -149,4 +244,5 @@ class PlanApplier:
                 if stored is not None:
                     alloc.create_index = stored.create_index
                     alloc.modify_index = stored.modify_index
+        metrics.measure_since(("plan", "submit"), start)
         return index

@@ -104,3 +104,182 @@ def test_system_deregister_stops_all():
     h2.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_DEREGISTER))
     stops = [a for lst in h2.plans[0].node_update.values() for a in lst]
     assert len(stops) == 3
+
+
+# ----- additional scenarios mirroring system_sched_test.go ------------
+
+
+def strip_net(job):
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+    return job
+
+
+def test_system_exhaust_resources():
+    """TestSystemSched_ExhaustResources: a node with no headroom fails
+    the system placement with exhaustion metrics."""
+    h = Harness(seed=70)
+    node = mock.node()
+    node.resources.cpu = 100
+    node.resources.memory_mb = 64
+    h.state.upsert_node(h.next_index(), node)
+    job = strip_net(mock.system_job())
+    job.task_groups[0].tasks[0].resources.cpu = 5000
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    assert h.state.allocs_by_job(job.id) == []
+    ev = h.evals[0]
+    assert ev.status == consts.EVAL_STATUS_COMPLETE
+    assert "web" in ev.failed_tg_allocs
+    assert ev.failed_tg_allocs["web"].nodes_exhausted >= 1
+
+
+def test_system_add_node_gets_new_alloc_only():
+    """TestSystemSched_JobRegister_AddNode: a fresh node gets exactly
+    one new alloc; existing ones are untouched (no churn)."""
+    h = Harness(seed=71)
+    nodes = seed_nodes(h, 3)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    before = {a.id for a in h.state.allocs_by_job(job.id)}
+    assert len(before) == 3
+
+    n = mock.node()
+    h.state.upsert_node(h.next_index(), n)
+    h2 = Harness(state=h.state, seed=72)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    plan = h2.plans[0]
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert len(placed) == 1 and placed[0].node_id == n.id
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert stops == []
+
+
+def test_system_job_modify_destructive():
+    """TestSystemSched_JobModify: changed task config replaces every
+    alloc in place on its node."""
+    h = Harness(seed=73)
+    seed_nodes(h, 4)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = h.state.allocs_by_job(job.id)
+    assert len(first) == 4
+
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    h.state.upsert_job(h.next_index(), job2)
+    h2 = Harness(state=h.state, seed=74)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job2, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    plan = h2.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    assert len(stops) == 4 and len(placed) == 4
+    # replacements stay pinned to the same nodes
+    assert {a.node_id for a in placed} == {a.node_id for a in first}
+
+
+def test_system_job_modify_in_place():
+    """TestSystemSched_JobModify_InPlace: a priority-only change keeps
+    allocs on their nodes without destructive replacement."""
+    h = Harness(seed=75)
+    seed_nodes(h, 3)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    job2 = job.copy()
+    job2.priority += 10
+    h.state.upsert_job(h.next_index(), job2)
+    h2 = Harness(state=h.state, seed=76)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job2, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    plan = h2.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert stops == []  # in-place, not destructive
+
+
+def test_system_node_drain_stops_without_migration():
+    """TestSystemSched_NodeDrain: a drained node's system alloc stops
+    and is NOT migrated elsewhere (system allocs are per-node)."""
+    h = Harness(seed=77)
+    nodes = seed_nodes(h, 3)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+    h2 = Harness(state=h.state, seed=78)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    plan = h2.plans[0]
+    stops = [a for lst in plan.node_update.values() for a in lst]
+    assert len(stops) == 1
+    placed = [a for lst in plan.node_allocation.values() for a in lst]
+    # nothing moves TO the drained node and nothing new appears
+    assert all(a.node_id != nodes[0].id for a in placed)
+
+
+def test_system_queued_with_constraints():
+    """TestSystemSched_Queued_With_Constraints: a constrained-away node
+    produces no queued allocations."""
+    h = Harness(seed=79)
+    node = mock.node()
+    node.attributes["kernel.name"] = "darwin"
+    node.compute_class()
+    h.state.upsert_node(h.next_index(), node)
+    job = strip_net(mock.system_job())
+    job.constraints.append(
+        Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
+                   operand="="))
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    ev = h.evals[0]
+    assert ev.status == consts.EVAL_STATUS_COMPLETE
+    assert ev.queued_allocations.get("web", 0) == 0
+
+
+def test_system_chained_alloc_ids():
+    """TestSystemSched_ChainedAlloc: destructive updates carry
+    previous_allocation."""
+    h = Harness(seed=80)
+    seed_nodes(h, 2)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = {a.node_id: a.id for a in h.state.allocs_by_job(job.id)}
+
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].config = {"x": "y"}
+    h.state.upsert_job(h.next_index(), job2)
+    h2 = Harness(state=h.state, seed=81)
+    h2._next_index = h._next_index
+    h2.process("system", new_eval(job2, consts.EVAL_TRIGGER_JOB_REGISTER))
+    placed = [a for lst in h2.plans[0].node_allocation.values() for a in lst]
+    for a in placed:
+        assert a.previous_allocation == first[a.node_id]
+
+
+def test_system_annotate_plan():
+    """TestSystemSched_JobRegister_Annotate."""
+    h = Harness(seed=82)
+    seed_nodes(h, 5)
+    job = strip_net(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    ev = new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER)
+    ev.annotate_plan = True
+    h.process("system", ev)
+    plan = h.plans[0]
+    assert plan.annotations is not None
+    desired = plan.annotations.desired_tg_updates["web"]
+    assert desired.place == 5
