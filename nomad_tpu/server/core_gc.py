@@ -98,7 +98,11 @@ class CoreScheduler:
         for node in self.state.nodes():
             if node.status != consts.NODE_STATUS_DOWN or node.modify_index > oldest:
                 continue
-            if self.state.allocs_by_node(node.id):
+            # Only NON-terminal allocations pin a node; completed ones
+            # are the eval GC's business (core_sched.go:361-378
+            # TestCoreScheduler_NodeGC_TerminalAllocs).
+            if any(not a.terminal_status()
+                   for a in self.state.allocs_by_node(node.id)):
                 continue
             self.logger.debug("node GC reaping %s", node.id)
             self.server.node_deregister(node.id)

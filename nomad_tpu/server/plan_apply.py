@@ -142,7 +142,12 @@ class PlanApplier:
                     inflight = None
                 optimistic = None  # queue drained: next gets fresh state
                 continue
-            if optimistic is None:
+            if inflight is None:
+                # Nothing outstanding: every plan verifies against
+                # fresh state (the pre-pipelining invariant). The
+                # optimistic overlay only ever spans ONE in-flight
+                # commit — a rejected or no-op plan must not pin the
+                # next one to a stale base.
                 optimistic = OptimisticSnapshot(self.fsm.state.snapshot())
             try:
                 start = time.monotonic()

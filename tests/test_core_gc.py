@@ -1,0 +1,204 @@
+"""CoreScheduler GC unit tests (mirror nomad/core_sched_test.go):
+eval/alloc GC with partial blocking, node GC gated on live allocs,
+job GC gated on outstanding evals/allocs, and force-GC bypassing
+thresholds."""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import consts
+
+
+def gc_eval(kind, force=False):
+    ev = mock.eval()
+    ev.type = consts.JOB_TYPE_CORE
+    ev.job_id = f"{kind}{'-force' if force else ''}"
+    return ev
+
+
+class GCHarness(Harness):
+    """Harness whose planner surface supports the core scheduler's
+    direct raft writes (eval reap / node dereg / job dereg)."""
+
+
+def seed_terminal_eval_with_alloc(h, age_index=1):
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = mock.eval()
+    ev.job_id = job.id
+    ev.status = consts.EVAL_STATUS_COMPLETE
+    h.state.upsert_evals(h.next_index(), [ev])
+    alloc = mock.alloc()
+    alloc.job_id = job.id
+    alloc.job = job
+    alloc.eval_id = ev.id
+    alloc.desired_status = consts.ALLOC_DESIRED_STOP
+    alloc.client_status = consts.ALLOC_CLIENT_COMPLETE
+    h.state.upsert_allocs(h.next_index(), [alloc])
+    return job, ev, alloc
+
+
+def run_core(server, kind, force=True):
+    """Drive the server's core scheduler once (force bypasses the
+    TimeTable threshold, core_sched.go:54 forceGC)."""
+    server.force_gc() if force else None
+
+
+def test_eval_gc_reaps_terminal_eval_and_allocs():
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.config import ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    try:
+        h = type("H", (), {})()  # direct state access through the fsm
+        state = server.fsm.state
+        job = mock.job()
+        server.log.apply("job_register", {"job": job})
+        ev = mock.eval()
+        ev.job_id = job.id
+        ev.status = consts.EVAL_STATUS_COMPLETE
+        server.log.apply("eval_update", {"evals": [ev]})
+        alloc = mock.alloc()
+        alloc.job_id = job.id
+        alloc.job = job
+        alloc.eval_id = ev.id
+        alloc.desired_status = consts.ALLOC_DESIRED_STOP
+        alloc.client_status = consts.ALLOC_CLIENT_COMPLETE
+        server.log.apply("alloc_update", {"allocs": [alloc], "job": job})
+        server.log.apply("job_deregister", {"job_id": job.id})
+
+        server.force_gc()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if (state.eval_by_id(ev.id) is None
+                    and state.alloc_by_id(alloc.id) is None):
+                break
+            time.sleep(0.1)
+        assert state.eval_by_id(ev.id) is None
+        assert state.alloc_by_id(alloc.id) is None
+    finally:
+        server.shutdown()
+
+
+def test_eval_gc_partial_blocked_by_running_alloc():
+    """TestCoreScheduler_EvalGC_Partial: an eval with a NON-terminal
+    alloc is not reaped."""
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.config import ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    try:
+        state = server.fsm.state
+        job = mock.job()
+        server.log.apply("job_register", {"job": job})
+        ev = mock.eval()
+        ev.job_id = job.id
+        ev.status = consts.EVAL_STATUS_COMPLETE
+        server.log.apply("eval_update", {"evals": [ev]})
+        alloc = mock.alloc()
+        alloc.job_id = job.id
+        alloc.job = job
+        alloc.eval_id = ev.id
+        alloc.desired_status = consts.ALLOC_DESIRED_RUN
+        alloc.client_status = consts.ALLOC_CLIENT_RUNNING
+        server.log.apply("alloc_update", {"allocs": [alloc], "job": job})
+
+        server.force_gc()
+        time.sleep(1.0)
+        assert state.eval_by_id(ev.id) is not None  # still referenced
+        assert state.alloc_by_id(alloc.id) is not None
+    finally:
+        server.shutdown()
+
+
+def test_node_gc_reaps_down_node_without_allocs():
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.config import ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    try:
+        state = server.fsm.state
+        node = mock.node()
+        server.log.apply("node_register", {"node": node})
+        server.log.apply("node_update_status",
+                         {"node_id": node.id,
+                          "status": consts.NODE_STATUS_DOWN})
+        server.force_gc()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if state.node_by_id(node.id) is None:
+                break
+            time.sleep(0.1)
+        assert state.node_by_id(node.id) is None
+    finally:
+        server.shutdown()
+
+
+def test_node_gc_blocked_by_running_alloc():
+    """TestCoreScheduler_NodeGC_RunningAllocs: a down node with a
+    non-terminal alloc is kept."""
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.config import ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    try:
+        state = server.fsm.state
+        node = mock.node()
+        server.log.apply("node_register", {"node": node})
+        job = mock.job()
+        server.log.apply("job_register", {"job": job})
+        alloc = mock.alloc()
+        alloc.node_id = node.id
+        alloc.job_id = job.id
+        alloc.job = job
+        alloc.desired_status = consts.ALLOC_DESIRED_RUN
+        alloc.client_status = consts.ALLOC_CLIENT_RUNNING
+        server.log.apply("alloc_update", {"allocs": [alloc], "job": job})
+        server.log.apply("node_update_status",
+                         {"node_id": node.id,
+                          "status": consts.NODE_STATUS_DOWN})
+        server.force_gc()
+        time.sleep(1.0)
+        assert state.node_by_id(node.id) is not None
+    finally:
+        server.shutdown()
+
+
+def test_node_gc_allows_terminal_allocs():
+    """TestCoreScheduler_NodeGC_TerminalAllocs: terminal allocs don't
+    pin a down node."""
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.config import ServerConfig
+
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    try:
+        state = server.fsm.state
+        node = mock.node()
+        server.log.apply("node_register", {"node": node})
+        job = mock.job()
+        server.log.apply("job_register", {"job": job})
+        alloc = mock.alloc()
+        alloc.node_id = node.id
+        alloc.job_id = job.id
+        alloc.job = job
+        alloc.desired_status = consts.ALLOC_DESIRED_STOP
+        alloc.client_status = consts.ALLOC_CLIENT_COMPLETE
+        server.log.apply("alloc_update", {"allocs": [alloc], "job": job})
+        server.log.apply("node_update_status",
+                         {"node_id": node.id,
+                          "status": consts.NODE_STATUS_DOWN})
+        server.force_gc()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if state.node_by_id(node.id) is None:
+                break
+            time.sleep(0.1)
+        assert state.node_by_id(node.id) is None
+    finally:
+        server.shutdown()

@@ -229,3 +229,36 @@ def test_rejected_plan_refresh_index_covers_inflight_commit():
     assert r1.alloc_index > 0
     assert not r2.node_allocation
     assert r2.refresh_index > pre_index
+
+
+def test_rejected_plan_does_not_pin_stale_base():
+    """A rejection with no commit in flight must not stick the NEXT
+    plan to the same stale snapshot: capacity freed between plans is
+    seen (the pre-pipelining fresh-snapshot-per-plan invariant)."""
+    fsm, devlog, nodes = build_world(n_nodes=1, cpu=500)
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    applier = PlanApplier(queue, fsm, devlog)
+    applier.start()
+    try:
+        # Fill the node.
+        p1 = queue.enqueue(make_plan(nodes[0], 250))
+        r1 = p1.wait(timeout=10.0)
+        assert r1.alloc_index > 0
+        big_alloc_id = next(iter(r1.node_allocation.values()))[0].id
+        # Second plan rejected: node is full.
+        p2 = queue.enqueue(make_plan(nodes[0], 250))
+        r2 = p2.wait(timeout=10.0)
+        assert not r2.node_allocation
+        # Free the capacity OUTSIDE the plan pipeline (client update).
+        stored = fsm.state.alloc_by_id(big_alloc_id)
+        freed = stored.copy()
+        freed.desired_status = consts.ALLOC_DESIRED_STOP
+        freed.client_status = consts.ALLOC_CLIENT_COMPLETE
+        devlog.apply("alloc_update", {"allocs": [freed], "job": stored.job})
+        # The next plan must see the freed capacity and commit.
+        p3 = queue.enqueue(make_plan(nodes[0], 250))
+        r3 = p3.wait(timeout=10.0)
+        assert r3.alloc_index > 0, "stale base pinned after rejection"
+    finally:
+        applier.stop()
