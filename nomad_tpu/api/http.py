@@ -525,6 +525,13 @@ class HTTPServer:
             out["config"] = to_dict(self.server.config)
         if self.client is not None:
             out["client"] = self.client.stats()
+        # TPU placement batcher observability (only once the lazy
+        # factories have loaded it).
+        import sys
+
+        batcher_mod = sys.modules.get("nomad_tpu.scheduler.batcher")
+        if batcher_mod is not None and batcher_mod._global is not None:
+            out["placement_batcher"] = batcher_mod._global.stats()
         return out
 
     def _system_gc(self, method, query, body):

@@ -282,7 +282,10 @@ def launch_executor(ctx: TaskContext, task: Task, *, rlimit_as: Optional[int] = 
         close_fds=True,
     )
     # The executor daemonizes itself (setsid); wait for its socket.
-    deadline = time.monotonic() + 15.0
+    # Generous deadline: a burst of concurrent task starts forks many
+    # executors from a large parent (the agent may hold a TPU runtime),
+    # and under that load 15s was observed to miss on real hardware.
+    deadline = time.monotonic() + 60.0
     last_err: Optional[Exception] = None
     while time.monotonic() < deadline:
         if os.path.exists(sock_path):

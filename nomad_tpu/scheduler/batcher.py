@@ -1,0 +1,159 @@
+"""Placement batcher: coalesce concurrent evaluations into one TPU
+dispatch.
+
+The north star (BASELINE.json, SURVEY.md §5): evals drained from the
+broker batch into a single device program — N workers' placement
+requests with the same bucketed shapes ride one
+`batched_placement_program` call instead of N serial dispatches. This
+is the live-pipeline analog of bench.py's drain-to-batch measurement:
+per-dispatch overhead (Python→XLA call, PRNG split, transfer) is paid
+once per batch, and the vmapped program keeps the VPU busy.
+
+Requests are grouped by compatibility key (node bucket, ask bucket,
+group count, penalty): only same-shaped programs can share a dispatch
+(no recompiles). A short accumulation window lets concurrent workers
+pile on; a lone request ships immediately after it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAX_BATCH = 64
+WINDOW_S = 0.003  # accumulation window once a first request arrives
+
+
+class _Request:
+    __slots__ = ("state", "asks", "key", "event", "choices", "scores",
+                 "error")
+
+    def __init__(self, state, asks, key):
+        self.state = state
+        self.asks = asks
+        self.key = key
+        self.event = threading.Event()
+        self.choices = None
+        self.scores = None
+        self.error: Optional[BaseException] = None
+
+
+class PlacementBatcher:
+    """Coalesces placement_program calls across scheduler threads."""
+
+    def __init__(self, max_batch: int = MAX_BATCH, window: float = WINDOW_S):
+        self.max_batch = max_batch
+        self.window = window
+        self.logger = logging.getLogger("nomad_tpu.batcher")
+        self._lock = threading.Lock()
+        self._queues: Dict[Tuple, List[_Request]] = {}
+        self._dispatcher_live: Dict[Tuple, bool] = {}
+        self.dispatches = 0  # observability: device calls issued
+        self.batched_requests = 0  # requests served
+
+    def place(self, state, asks, rng_key, config):
+        """Submit one eval's placement; blocks until its batch's device
+        dispatch returns. Returns (choices, scores) for THIS request."""
+        shape_key = (
+            state.util.shape, asks.resources.shape,
+            state.feasible.shape[1], config,
+        )
+        req = _Request(state, asks, rng_key)
+        run_dispatch = False
+        with self._lock:
+            self._queues.setdefault(shape_key, []).append(req)
+            if not self._dispatcher_live.get(shape_key):
+                # First in: this thread becomes the batch's dispatcher.
+                self._dispatcher_live[shape_key] = True
+                run_dispatch = True
+        if run_dispatch:
+            self._dispatch(shape_key, config)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.choices, req.scores
+
+    def _dispatch(self, shape_key, config) -> None:
+        import time as _time
+
+        import jax
+
+        from ..ops.binpack import batched_placement_program
+
+        # Accumulation window: let concurrently-running workers join.
+        _time.sleep(self.window)
+        with self._lock:
+            waiting = self._queues.pop(shape_key, [])
+            batch = waiting[: self.max_batch]
+            leftover = waiting[self.max_batch:]
+            if leftover:
+                # Overflow rides the next dispatch; dropping it would
+                # wedge those workers forever in event.wait().
+                self._queues[shape_key] = leftover
+            self._dispatcher_live[shape_key] = False
+        if not batch:
+            return
+        try:
+            if len(batch) == 1:
+                from ..ops.binpack import placement_program_jit
+
+                req = batch[0]
+                choices, scores, _ = placement_program_jit(
+                    req.state, req.asks, req.key, config)
+                req.choices = np.asarray(choices)
+                req.scores = np.asarray(scores)
+            else:
+                states = jax.tree.map(
+                    lambda *xs: np.stack(xs), *[r.state for r in batch])
+                asks = jax.tree.map(
+                    lambda *xs: np.stack(xs), *[r.asks for r in batch])
+                keys = np.stack([r.key for r in batch])
+                choices, scores, _ = batched_placement_program(
+                    states, asks, keys, config)
+                choices = np.asarray(choices)
+                scores = np.asarray(scores)
+                for i, req in enumerate(batch):
+                    req.choices = choices[i]
+                    req.scores = scores[i]
+            self.dispatches += 1
+            self.batched_requests += len(batch)
+        except BaseException as e:  # noqa: BLE001 - propagate per request
+            for req in batch:
+                req.error = e
+        finally:
+            for req in batch:
+                req.event.set()
+            # Anything that arrived during our device call gets its own
+            # dispatcher (first of the leftovers may already have
+            # claimed it via place()).
+            with self._lock:
+                if self._queues.get(shape_key) and not self._dispatcher_live.get(shape_key):
+                    self._dispatcher_live[shape_key] = True
+                    spawn = True
+                else:
+                    spawn = False
+            if spawn:
+                threading.Thread(
+                    target=self._dispatch, args=(shape_key, config),
+                    daemon=True, name="placement-batch").start()
+
+    def stats(self) -> dict:
+        return {
+            "dispatches": self.dispatches,
+            "batched_requests": self.batched_requests,
+        }
+
+
+_global: Optional[PlacementBatcher] = None
+_global_lock = threading.Lock()
+
+
+def get_batcher() -> PlacementBatcher:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = PlacementBatcher()
+        return _global

@@ -101,8 +101,8 @@ class BatchedTPUScheduler(GenericScheduler):
             PlacementConfig,
             make_asks,
             make_node_state,
-            placement_program_jit,
         )
+        from .batcher import get_batcher
         from .stack import (
             BATCH_JOB_ANTI_AFFINITY_PENALTY,
             SERVICE_JOB_ANTI_AFFINITY_PENALTY,
@@ -149,7 +149,10 @@ class BatchedTPUScheduler(GenericScheduler):
         config = PlacementConfig(anti_affinity_penalty=penalty)
         key = jax.random.PRNGKey(self.rng.getrandbits(31))
 
-        choices, scores, _ = placement_program_jit(state, asks, key, config)
+        # The drain-to-batch shim (BASELINE north star): concurrent
+        # workers' same-shaped placement programs coalesce into one
+        # vmapped device dispatch instead of N serial calls.
+        choices, scores = get_batcher().place(state, asks, key, config)
         choices = np.asarray(choices)
         scores = np.asarray(scores)
 

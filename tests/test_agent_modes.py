@@ -33,7 +33,8 @@ def spawn_agent(config_path, *extra):
         [sys.executable, "-m", "nomad_tpu.cli", "agent",
          "-config", str(config_path), *extra],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env={**os.environ, "PYTHONPATH": REPO},
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            p for p in [REPO, os.environ.get("PYTHONPATH", "")] if p)},
     )
     return proc
 
@@ -116,7 +117,8 @@ def test_server_only_and_client_only_agents(server_client_cluster, tmp_path):
          "--address", "http://127.0.0.1:14846", "run", "-detach",
          str(jobfile)],
         capture_output=True, text=True,
-        env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            p for p in [REPO, os.environ.get("PYTHONPATH", "")] if p)}, timeout=60)
     assert out.returncode == 0, out.stdout + out.stderr
 
     deadline = time.monotonic() + 30

@@ -107,19 +107,15 @@ def test_one_blocked_eval_per_job_collects_duplicates():
     assert len(released) == 1
 
 
-def test_unblock_failed_requeues_failed_quota_evals():
-    """periodicUnblockFailedEvals (leader.go:441): evals blocked after
-    hitting the delivery limit get periodically retried."""
+def test_unblock_failed_leaves_capacity_blocked_evals():
+    """periodicUnblockFailedEvals (leader.go:441) retries only
+    delivery-failure evals; a capacity-blocked eval stays put."""
     blocked, released = build()
     ev = make_blocked(classes={"c1": False})
-    ev.triggered_by = consts.EVAL_TRIGGER_MAX_PLANS \
-        if hasattr(consts, "EVAL_TRIGGER_MAX_PLANS") else ev.triggered_by
-    ev.status = consts.EVAL_STATUS_BLOCKED
     blocked.block(ev)
     blocked.unblock_failed()
-    # unblock_failed only releases evals marked as delivery-failures;
-    # a capacity-blocked eval stays put
-    assert ev not in released or released == [ev]
+    assert released == []
+    assert blocked.stats()["total_blocked"] == 1
 
 
 def test_untrack_on_job_update():

@@ -6,53 +6,21 @@ thresholds."""
 import time
 
 from nomad_tpu import mock
-from nomad_tpu.scheduler.testing import Harness
 from nomad_tpu.structs import consts
 
 
-def gc_eval(kind, force=False):
-    ev = mock.eval()
-    ev.type = consts.JOB_TYPE_CORE
-    ev.job_id = f"{kind}{'-force' if force else ''}"
-    return ev
-
-
-class GCHarness(Harness):
-    """Harness whose planner surface supports the core scheduler's
-    direct raft writes (eval reap / node dereg / job dereg)."""
-
-
-def seed_terminal_eval_with_alloc(h, age_index=1):
-    job = mock.job()
-    h.state.upsert_job(h.next_index(), job)
-    ev = mock.eval()
-    ev.job_id = job.id
-    ev.status = consts.EVAL_STATUS_COMPLETE
-    h.state.upsert_evals(h.next_index(), [ev])
-    alloc = mock.alloc()
-    alloc.job_id = job.id
-    alloc.job = job
-    alloc.eval_id = ev.id
-    alloc.desired_status = consts.ALLOC_DESIRED_STOP
-    alloc.client_status = consts.ALLOC_CLIENT_COMPLETE
-    h.state.upsert_allocs(h.next_index(), [alloc])
-    return job, ev, alloc
-
-
-def run_core(server, kind, force=True):
-    """Drive the server's core scheduler once (force bypasses the
-    TimeTable threshold, core_sched.go:54 forceGC)."""
-    server.force_gc() if force else None
-
-
-def test_eval_gc_reaps_terminal_eval_and_allocs():
-    from nomad_tpu.server.server import Server
+def gc_server():
     from nomad_tpu.server.config import ServerConfig
+    from nomad_tpu.server.server import Server
 
     server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
     server.start()
+    return server
+
+
+def test_eval_gc_reaps_terminal_eval_and_allocs():
+    server = gc_server()
     try:
-        h = type("H", (), {})()  # direct state access through the fsm
         state = server.fsm.state
         job = mock.job()
         server.log.apply("job_register", {"job": job})
@@ -85,11 +53,7 @@ def test_eval_gc_reaps_terminal_eval_and_allocs():
 def test_eval_gc_partial_blocked_by_running_alloc():
     """TestCoreScheduler_EvalGC_Partial: an eval with a NON-terminal
     alloc is not reaped."""
-    from nomad_tpu.server.server import Server
-    from nomad_tpu.server.config import ServerConfig
-
-    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
-    server.start()
+    server = gc_server()
     try:
         state = server.fsm.state
         job = mock.job()
@@ -115,11 +79,7 @@ def test_eval_gc_partial_blocked_by_running_alloc():
 
 
 def test_node_gc_reaps_down_node_without_allocs():
-    from nomad_tpu.server.server import Server
-    from nomad_tpu.server.config import ServerConfig
-
-    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
-    server.start()
+    server = gc_server()
     try:
         state = server.fsm.state
         node = mock.node()
@@ -141,11 +101,7 @@ def test_node_gc_reaps_down_node_without_allocs():
 def test_node_gc_blocked_by_running_alloc():
     """TestCoreScheduler_NodeGC_RunningAllocs: a down node with a
     non-terminal alloc is kept."""
-    from nomad_tpu.server.server import Server
-    from nomad_tpu.server.config import ServerConfig
-
-    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
-    server.start()
+    server = gc_server()
     try:
         state = server.fsm.state
         node = mock.node()
@@ -172,11 +128,7 @@ def test_node_gc_blocked_by_running_alloc():
 def test_node_gc_allows_terminal_allocs():
     """TestCoreScheduler_NodeGC_TerminalAllocs: terminal allocs don't
     pin a down node."""
-    from nomad_tpu.server.server import Server
-    from nomad_tpu.server.config import ServerConfig
-
-    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
-    server.start()
+    server = gc_server()
     try:
         state = server.fsm.state
         node = mock.node()
