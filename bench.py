@@ -345,7 +345,69 @@ def config_5():
         dense_rate, dense_p99
 
 
-CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+def config_6():
+    """End-to-end control plane: the REAL server pipeline (broker ->
+    workers -> scheduler -> plan queue -> pipelined applier -> FSM)
+    with CPU vs TPU factories on identical clusters. This measures the
+    BASELINE.json acceptance criterion directly: evals/sec at identical
+    plan-apply success rate."""
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs import consts
+
+    n_nodes, n_jobs, allocs_per_job = 200, 60, 4
+
+    def run(factories):
+        server = Server(ServerConfig(
+            num_schedulers=4, scheduler_factories=factories,
+            eval_nack_timeout=30.0))
+        server.start()
+        try:
+            for _ in range(n_nodes):
+                node = mock.node()
+                node.compute_class()
+                server.log.apply("node_register", {"node": node})
+            jobs = []
+            for j in range(n_jobs):
+                job = mock.job()
+                job.id = f"e2e-{j}"
+                job.type = "service"
+                job.task_groups[0].count = allocs_per_job
+                job.task_groups[0].tasks[0].resources.networks = []
+                job.task_groups[0].tasks[0].resources.cpu = 20
+                job.task_groups[0].tasks[0].resources.memory_mb = 16
+                jobs.append(job)
+            start = time.perf_counter()
+            evals = [server.job_register(job)[0] for job in jobs]
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                st = [server.fsm.state.eval_by_id(e) for e in evals]
+                if all(s is not None and s.status in
+                       (consts.EVAL_STATUS_COMPLETE,
+                        consts.EVAL_STATUS_FAILED) for s in st):
+                    break
+                time.sleep(0.02)
+            elapsed = time.perf_counter() - start
+            placed = sum(len(server.fsm.state.allocs_by_job(j.id))
+                         for j in jobs)
+            success = placed / (n_jobs * allocs_per_job)
+            return n_jobs / elapsed, success
+        finally:
+            server.shutdown()
+
+    cpu_rate, cpu_success = run({})
+    tpu_rate, tpu_success = run({"service": "service-tpu",
+                                 "batch": "batch-tpu"})
+    assert abs(cpu_success - tpu_success) < 1e-9, (
+        f"success-rate mismatch: cpu={cpu_success} tpu={tpu_success}")
+    return (f"end-to-end pipeline, {n_nodes} nodes x {n_jobs} jobs x "
+            f"{allocs_per_job} allocs, 4 workers; plan-apply success "
+            f"cpu={cpu_success:.3f} tpu={tpu_success:.3f}"), \
+        cpu_rate, 0.0, tpu_rate, 0.0
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
+           6: config_6}
 
 
 def run_config(n):

@@ -37,6 +37,69 @@ from ..structs.resources import Resources
 BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768]
 ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
 
+# Job-independent cluster base, cached across evaluations: rebuilding
+# the [N,4] utilization matrices is O(N x allocs) host work per eval,
+# and the base only changes when the nodes or allocs tables do (the
+# incremental-update-keyed-on-raft-index plan from SURVEY.md §7).
+_BASE_CACHE: Dict[Tuple, "_ClusterBase"] = {}
+_BASE_CACHE_MAX = 8
+_BASE_CACHE_LOCK = __import__("threading").Lock()
+
+
+class _ClusterBase:
+    __slots__ = ("n_real", "n", "capacity", "sched_capacity",
+                 "util", "bw_avail", "bw_used", "ports_free", "node_ok",
+                 "alloc_groups")
+
+    def __init__(self, nodes, proposed_fn):
+        self.n_real = len(nodes)
+        self.n = bucket_size(self.n_real)
+        n = self.n
+        self.capacity = np.zeros((n, 4), np.float32)
+        self.sched_capacity = np.zeros((n, 4), np.float32)
+        self.util = np.zeros((n, 4), np.float32)
+        self.bw_avail = np.zeros(n, np.float32)
+        self.bw_used = np.zeros(n, np.float32)
+        self.ports_free = np.zeros(n, np.float32)
+        self.node_ok = np.zeros(n, bool)
+        # per node: [(job_id, task_group), ...] of live allocs, for the
+        # cheap per-job overlay counts
+        self.alloc_groups: List[List[Tuple[str, str]]] = []
+
+        dyn_range = consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
+        for i, node in enumerate(nodes):
+            r = node.resources
+            self.capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+            res = node.reserved
+            res_cpu = res.cpu if res else 0
+            res_mem = res.memory_mb if res else 0
+            res_disk = res.disk_mb if res else 0
+            res_iops = res.iops if res else 0
+            self.sched_capacity[i] = (
+                r.cpu - res_cpu, r.memory_mb - res_mem,
+                r.disk_mb - res_disk, r.iops - res_iops,
+            )
+            self.util[i] = (res_cpu, res_mem, res_disk, res_iops)
+            if r.networks:
+                self.bw_avail[i] = r.networks[0].mbits
+            ports_used = 0
+            if res:
+                for net in res.networks:
+                    self.bw_used[i] += net.mbits
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                        if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
+                            ports_used += 1
+            groups: List[Tuple[str, str]] = []
+            for alloc in proposed_fn(node.id):
+                cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
+                self.util[i] += (cpu, mem, disk, iops)
+                self.bw_used[i] += mbits
+                ports_used += aports
+                groups.append((alloc.job_id, alloc.task_group))
+            self.alloc_groups.append(groups)
+            self.ports_free[i] = dyn_range - ports_used
+            self.node_ok[i] = True
+
 
 def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
     i = bisect.bisect_left(buckets, max(n, 1))
@@ -106,67 +169,57 @@ class ClusterMatrix:
 
         return proposed_allocs_for_node(self.state, self.plan, node_id)
 
+    def _cached_base(self) -> "_ClusterBase":
+        """The job-independent base, cached by (nodes index, allocs
+        index, datacenters): snapshots sharing those see identical
+        clusters. A non-empty plan changes proposed allocs, so it
+        bypasses the cache."""
+        cacheable = self.plan is None or self.plan.is_no_op()
+        key = None
+        if (cacheable and hasattr(self.state, "index")
+                and getattr(self.state, "store_id", "")):
+            key = (self.state.store_id,
+                   self.state.index("nodes"), self.state.index("allocs"),
+                   tuple(sorted(self.job.datacenters or [])),
+                   len(self.nodes))
+            with _BASE_CACHE_LOCK:
+                cached = _BASE_CACHE.get(key)
+            if cached is not None:
+                return cached
+        base = _ClusterBase(self.nodes, self._proposed_allocs)
+        if key is not None:
+            with _BASE_CACHE_LOCK:
+                while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
+                    _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
+                _BASE_CACHE[key] = base
+        return base
+
     def _build(self) -> None:
         n, g = self.n, self.g
-        capacity = np.zeros((n, 4), np.float32)
-        sched_capacity = np.zeros((n, 4), np.float32)
-        util = np.zeros((n, 4), np.float32)
-        bw_avail = np.zeros(n, np.float32)
-        bw_used = np.zeros(n, np.float32)
-        ports_free = np.zeros(n, np.float32)
+        base = self._cached_base()
+        # Share the immutable base arrays; the kernel never mutates its
+        # inputs (functional scan carries copies).
+        self.capacity = base.capacity
+        self.sched_capacity = base.sched_capacity
+        self.util = base.util
+        self.bw_avail = base.bw_avail
+        self.bw_used = base.bw_used
+        self.ports_free = base.ports_free
+        self.node_ok = base.node_ok
+
+        # Job-specific overlay: this job's per-node alloc counts.
         job_count = np.zeros(n, np.int32)
         tg_count = np.zeros((n, g), np.int32)
-        node_ok = np.zeros(n, bool)
-
-        dyn_range = consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
-
-        for i, node in enumerate(self.nodes):
-            r = node.resources
-            capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
-            res = node.reserved
-            res_cpu = res.cpu if res else 0
-            res_mem = res.memory_mb if res else 0
-            res_disk = res.disk_mb if res else 0
-            res_iops = res.iops if res else 0
-            sched_capacity[i] = (
-                r.cpu - res_cpu,
-                r.memory_mb - res_mem,
-                r.disk_mb - res_disk,
-                r.iops - res_iops,
-            )
-            util[i] = (res_cpu, res_mem, res_disk, res_iops)
-            if r.networks:
-                bw_avail[i] = r.networks[0].mbits
-            reserved_dyn_ports = 0
-            if res:
-                for net in res.networks:
-                    bw_used[i] += net.mbits
-                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                        if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
-                            reserved_dyn_ports += 1
-            ports_used = reserved_dyn_ports
-            for alloc in self._proposed_allocs(node.id):
-                cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
-                util[i] += (cpu, mem, disk, iops)
-                bw_used[i] += mbits
-                ports_used += aports
-                if alloc.job_id == self.job.id:
+        gi_by_name = {tg.name: gi for gi, tg in enumerate(self.groups)}
+        for i, groups in enumerate(base.alloc_groups):
+            for job_id, task_group in groups:
+                if job_id == self.job.id:
                     job_count[i] += 1
-                    for gi, tg in enumerate(self.groups):
-                        if alloc.task_group == tg.name:
-                            tg_count[i, gi] += 1
-            ports_free[i] = dyn_range - ports_used
-            node_ok[i] = True
-
-        self.capacity = capacity
-        self.sched_capacity = sched_capacity
-        self.util = util
-        self.bw_avail = bw_avail
-        self.bw_used = bw_used
-        self.ports_free = ports_free
+                    gi = gi_by_name.get(task_group)
+                    if gi is not None:
+                        tg_count[i, gi] += 1
         self.job_count = job_count
         self.tg_count = tg_count
-        self.node_ok = node_ok
         self.feasible = self._build_feasibility()
 
     def _build_feasibility(self) -> np.ndarray:

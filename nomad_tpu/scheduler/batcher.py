@@ -77,26 +77,31 @@ class PlacementBatcher:
         return req.choices, req.scores
 
     def _dispatch(self, shape_key, config) -> None:
-        import time as _time
-
-        import jax
-
-        from ..ops.binpack import batched_placement_program
-
-        # Accumulation window: let concurrently-running workers join.
-        _time.sleep(self.window)
-        with self._lock:
-            waiting = self._queues.pop(shape_key, [])
-            batch = waiting[: self.max_batch]
-            leftover = waiting[self.max_batch:]
-            if leftover:
-                # Overflow rides the next dispatch; dropping it would
-                # wedge those workers forever in event.wait().
-                self._queues[shape_key] = leftover
-            self._dispatcher_live[shape_key] = False
-        if not batch:
-            return
+        """Everything — including imports and the queue pop — runs
+        under the error handler: a dispatcher that dies without setting
+        its requests' events (e.g. a TPU runtime init failure) would
+        wedge every worker on that shape forever."""
+        batch: List[_Request] = []
         try:
+            import time as _time
+
+            import jax
+
+            from ..ops.binpack import batched_placement_program
+
+            # Accumulation window: let concurrent workers join.
+            _time.sleep(self.window)
+            with self._lock:
+                waiting = self._queues.pop(shape_key, [])
+                batch = waiting[: self.max_batch]
+                leftover = waiting[self.max_batch:]
+                if leftover:
+                    # Overflow rides the next dispatch; dropping it
+                    # would wedge those workers in event.wait().
+                    self._queues[shape_key] = leftover
+                self._dispatcher_live[shape_key] = False
+            if not batch:
+                return
             if len(batch) == 1:
                 from ..ops.binpack import placement_program_jit
 
@@ -121,6 +126,12 @@ class PlacementBatcher:
             self.dispatches += 1
             self.batched_requests += len(batch)
         except BaseException as e:  # noqa: BLE001 - propagate per request
+            with self._lock:
+                # Died before the pop: the queued requests are this
+                # dispatcher's responsibility — fail them too.
+                if not batch:
+                    batch = self._queues.pop(shape_key, [])
+                self._dispatcher_live[shape_key] = False
             for req in batch:
                 req.error = e
         finally:

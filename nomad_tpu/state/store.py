@@ -111,11 +111,16 @@ class StateSnapshot:
     """Immutable point-in-time view with the scheduler's read interface
     (scheduler.State, reference scheduler/scheduler.go:55)."""
 
-    def __init__(self, tables, indexes, table_indexes, latest):
+    def __init__(self, tables, indexes, table_indexes, latest,
+                 store_id: str = ""):
         self._t = tables
         self._i = indexes
         self._table_indexes = table_indexes
         self._latest = latest
+        # Identity of the owning store: table indexes alone are not
+        # unique across stores in one process (tests, multi-server),
+        # so caches keyed on indexes must include this.
+        self.store_id = store_id
 
     # -- index queries --
     def latest_index(self) -> int:
@@ -216,6 +221,9 @@ class StateStore:
         self._table_indexes: Dict[str, int] = {}
         self._latest_index = 0
         self.notify = watch.NotifyGroup()
+        from ..utils.ids import generate_uuid
+
+        self.store_id = generate_uuid()
 
     # ------------------------------------------------------------------
     # snapshots & watches
@@ -226,7 +234,8 @@ class StateStore:
             tables = {name: t.share() for name, t in self._tables.items()}
             indexes = {name: i.share() for name, i in self._indexes.items()}
             return StateSnapshot(
-                tables, indexes, dict(self._table_indexes), self._latest_index
+                tables, indexes, dict(self._table_indexes),
+                self._latest_index, store_id=self.store_id,
             )
 
     def latest_index(self) -> int:
