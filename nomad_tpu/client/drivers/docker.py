@@ -42,10 +42,12 @@ def _run(args: List[str], timeout: float = 60.0) -> subprocess.CompletedProcess:
 class DockerHandle(DriverHandle):
     """Handle keyed by container id — reattachable across restarts."""
 
-    def __init__(self, docker: str, container_id: str, task_name: str):
+    def __init__(self, docker: str, container_id: str, task_name: str,
+                 syslog=None):
         self.docker = docker
         self.container_id = container_id
         self.task_name = task_name
+        self.syslog = syslog  # log collector; dies with this client
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
         self._waiter = threading.Thread(target=self._wait_container, daemon=True)
@@ -74,6 +76,10 @@ class DockerHandle(DriverHandle):
             _run([self.docker, "rm", self.container_id], timeout=30.0)
         except (OSError, subprocess.TimeoutExpired):
             pass
+        # The container is gone: release its log collector (a normally
+        # exiting task never goes through kill()).
+        if self.syslog is not None:
+            self.syslog.stop()
         self._done.set()
 
     def id(self) -> str:
@@ -111,6 +117,8 @@ class DockerHandle(DriverHandle):
         except (OSError, subprocess.TimeoutExpired):
             pass
         self._done.wait(5.0)
+        if self.syslog is not None:
+            self.syslog.stop()
         try:
             _run([self.docker, "rm", "-f", self.container_id], timeout=30.0)
         except (OSError, subprocess.TimeoutExpired):
@@ -160,6 +168,22 @@ class DockerDriver(Driver):
 
         args = [docker, "run", "-d",
                 "--name", f"nomad-{ctx.alloc_id[:8]}-{task.name}-{int(time.time())}"]
+        # Container logs route through a local syslog collector into the
+        # task's rotated log files (logging/universal_collector.go:207 —
+        # docker gives the client no stdout/stderr pipes).
+        syslog = None
+        if ctx.log_dir:
+            from ..syslog import SyslogCollector
+
+            lc = task.log_config
+            syslog = SyslogCollector(
+                ctx.log_dir, task.name,
+                max_files=lc.max_files if lc else 10,
+                max_bytes=(lc.max_file_size_mb if lc else 10) * 1024 * 1024,
+            )
+            args += ["--log-driver", "syslog",
+                     "--log-opt", f"syslog-address={syslog.addr}",
+                     "--log-opt", f"tag={task.name}"]
         # Resource limits (docker.go createContainer): MHz→shares, MB→bytes.
         if task.resources is not None:
             if task.resources.cpu:
@@ -194,13 +218,20 @@ class DockerDriver(Driver):
             args.append(str(cfg["command"]))
         args += [str(a) for a in cfg.get("args", [])]
 
-        proc = _run(args, timeout=300.0)
+        try:
+            proc = _run(args, timeout=300.0)
+        except BaseException:
+            if syslog is not None:
+                syslog.stop()
+            raise
         if proc.returncode != 0:
+            if syslog is not None:
+                syslog.stop()
             raise RuntimeError(
                 f"docker run failed: {proc.stderr.strip() or proc.stdout.strip()}"
             )
         container_id = proc.stdout.strip().splitlines()[-1]
-        return DockerHandle(docker, container_id, task.name)
+        return DockerHandle(docker, container_id, task.name, syslog=syslog)
 
     def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
         if not handle_id.startswith("docker:"):

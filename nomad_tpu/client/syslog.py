@@ -1,0 +1,84 @@
+"""Syslog collector: container logs land in the task's rotated files.
+
+Reference: client/driver/logging/universal_collector.go:207 — docker
+has no stdout/stderr pipes to the client, so the driver points the
+container's syslog log-driver at a local collector, which parses the
+RFC3164/5424-ish frames docker emits and writes them into the task's
+`<task>.stdout.N` / `<task>.stderr.N` rotated logs by severity (the
+reference maps severity the same way, syslog_parser.go).
+"""
+
+from __future__ import annotations
+
+import re
+import socketserver
+import threading
+from typing import Optional
+
+from .executor.executor_main import FileRotator
+
+# <PRI>rest — PRI = facility*8 + severity; severity <= 4 (err/warn and
+# worse) routes to stderr, the rest to stdout.
+_PRI_RE = re.compile(rb"^<(\d{1,3})>")
+# docker's RFC3164 header is "MMM dd hh:mm:ss host tag[pid]: " — strip
+# everything through the EARLIEST "tag[pid]: " (non-greedy, bounded so
+# a message that merely contains "[n]: " deep inside stays intact)
+_HEADER_RE = re.compile(rb"^.{0,200}?\[\d+\]:\s?")
+
+STDERR_MAX_SEVERITY = 4
+
+
+class SyslogCollector:
+    """One TCP syslog listener per docker task."""
+
+    def __init__(self, log_dir: str, task_name: str, max_files: int,
+                 max_bytes: int):
+        self.stdout = FileRotator(log_dir, f"{task_name}.stdout",
+                                  max_files, max_bytes)
+        self.stderr = FileRotator(log_dir, f"{task_name}.stderr",
+                                  max_files, max_bytes)
+        collector = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                # docker's tcp syslog framing is newline-delimited
+                for line in self.rfile:
+                    collector._ingest(line.rstrip(b"\r\n"))
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("127.0.0.1", 0), Handler)
+        self.addr = "tcp://127.0.0.1:%d" % self._server.server_address[1]
+        self._stopped = False
+        self._stop_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"syslog-{task_name}")
+        self._thread.start()
+
+    def _ingest(self, line: bytes) -> None:
+        severity = 6  # info
+        m = _PRI_RE.match(line)
+        if m:
+            severity = int(m.group(1)) % 8
+            line = line[m.end():]
+        line = _HEADER_RE.sub(b"", line, count=1)
+        out = (self.stderr if severity <= STDERR_MAX_SEVERITY
+               else self.stdout)
+        out.write(line + b"\n")
+
+    def stop(self) -> None:
+        # Idempotent: both the container-exit waiter and kill() stop it.
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            self.stdout.close()
+            self.stderr.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -128,10 +128,13 @@ class PlacementBatcher:
         except BaseException as e:  # noqa: BLE001 - propagate per request
             with self._lock:
                 # Died before the pop: the queued requests are this
-                # dispatcher's responsibility — fail them too.
+                # dispatcher's responsibility — fail them too, and
+                # clear the live flag WE still hold. After the pop the
+                # flag was already released (a newer dispatcher may own
+                # it) — touching it then would let two run at once.
                 if not batch:
                     batch = self._queues.pop(shape_key, [])
-                self._dispatcher_live[shape_key] = False
+                    self._dispatcher_live[shape_key] = False
             for req in batch:
                 req.error = e
         finally:

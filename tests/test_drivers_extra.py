@@ -9,6 +9,7 @@ tests on environment the same way).
 
 import os
 import stat
+import time
 import textwrap
 
 import pytest
@@ -465,3 +466,61 @@ def test_schema_coerce():
     assert out == {"n": 5, "f": 1.5, "b": False, "s": "x"}
     # already-typed values untouched
     assert schema.coerce({"n": 7, "b": True}) == {"n": 7, "b": True}
+
+
+# ---------------------------------------------------------- syslog
+
+
+def test_syslog_collector_routes_by_severity(tmp_path):
+    """Reference logging/universal_collector.go: syslog frames from the
+    container land in the task's rotated stdout/stderr by severity."""
+    import socket
+    import time as _time
+
+    from nomad_tpu.client.syslog import SyslogCollector
+
+    collector = SyslogCollector(str(tmp_path), "web", max_files=2,
+                                max_bytes=1 << 20)
+    try:
+        host, port = collector.addr.removeprefix("tcp://").rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=5) as s:
+            # severity 6 (info) -> stdout; severity 3 (err) -> stderr
+            s.sendall(b"<30>Jul 30 01:02:03 host web[77]: hello out\n")
+            s.sendall(b"<27>Jul 30 01:02:03 host web[77]: oh no\n")
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            out = (tmp_path / "web.stdout.0")
+            err = (tmp_path / "web.stderr.0")
+            if (out.exists() and b"hello out" in out.read_bytes()
+                    and err.exists() and b"oh no" in err.read_bytes()):
+                break
+            _time.sleep(0.05)
+        assert b"hello out" in (tmp_path / "web.stdout.0").read_bytes()
+        assert b"oh no" in (tmp_path / "web.stderr.0").read_bytes()
+        # docker's tag header is stripped
+        assert b"web[77]" not in (tmp_path / "web.stdout.0").read_bytes()
+    finally:
+        collector.stop()
+
+
+def test_docker_run_points_logs_at_syslog_collector(docker_stub, tmp_path):
+    ctx = make_ctx(tmp_path)
+    task = Task(
+        name="c", driver="docker",
+        config={"image": "redis"},
+        resources=Resources(cpu=100, memory_mb=64),
+    )
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    handle = DockerDriver().start(ctx, task)
+    try:
+        line = docker_stub.read_text().splitlines()[0]
+        assert "--log-driver syslog" in line
+        assert "syslog-address=tcp://127.0.0.1:" in line
+        assert handle.syslog is not None
+    finally:
+        handle.kill(1.0)
+    # collector stops with the handle
+    deadline = time.time() + 5
+    while time.time() < deadline and handle.syslog._thread.is_alive():
+        time.sleep(0.05)
+    assert not handle.syslog._thread.is_alive()
