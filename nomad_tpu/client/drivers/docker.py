@@ -83,7 +83,10 @@ class DockerHandle(DriverHandle):
         self._done.set()
 
     def id(self) -> str:
-        return f"docker:{self.container_id}:{self.task_name}"
+        # The collector's port rides in the id so a restarted client
+        # can rebind it (the container keeps logging to that port).
+        port = self.syslog.port if self.syslog is not None else 0
+        return f"docker:{self.container_id}:{port}:{self.task_name}"
 
     def pid(self) -> Optional[int]:
         try:
@@ -236,7 +239,13 @@ class DockerDriver(Driver):
     def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:
         if not handle_id.startswith("docker:"):
             return None
-        _, container_id, task_name = handle_id.split(":", 2)
+        parts = handle_id.split(":", 3)
+        if len(parts) == 4 and parts[2].isdigit():
+            _, container_id, port_s, task_name = parts
+            syslog_port = int(port_s)
+        else:  # pre-port handle format
+            _, container_id, task_name = handle_id.split(":", 2)
+            syslog_port = 0
         docker = _docker_bin()
         if not docker:
             return None
@@ -253,4 +262,18 @@ class DockerDriver(Driver):
             return None
         if not running:
             return None
-        return DockerHandle(docker, container_id, task_name)
+        # Rebind the log collector on the same port the container's
+        # syslog driver targets (the old collector died with the old
+        # client); without this every post-restart log line is lost.
+        syslog = None
+        if syslog_port and ctx.log_dir:
+            from ..syslog import SyslogCollector
+
+            try:
+                syslog = SyslogCollector(ctx.log_dir, task_name,
+                                         max_files=10,
+                                         max_bytes=10 * 1024 * 1024,
+                                         port=syslog_port)
+            except OSError:
+                syslog = None  # port taken: logs stay dropped, task lives
+        return DockerHandle(docker, container_id, task_name, syslog=syslog)

@@ -1,6 +1,8 @@
 """Standalone task executor process.
 
-Run directly (never imported by the client):
+Run directly by path (import-safe: module level is only defs — the
+client imports FileRotator from here; nothing executes outside the
+__main__ guard):
 
     python executor_main.py <spec.json>
 

@@ -13,7 +13,6 @@ from __future__ import annotations
 import re
 import socketserver
 import threading
-from typing import Optional
 
 from .executor.executor_main import FileRotator
 
@@ -32,7 +31,7 @@ class SyslogCollector:
     """One TCP syslog listener per docker task."""
 
     def __init__(self, log_dir: str, task_name: str, max_files: int,
-                 max_bytes: int):
+                 max_bytes: int, port: int = 0):
         self.stdout = FileRotator(log_dir, f"{task_name}.stdout",
                                   max_files, max_bytes)
         self.stderr = FileRotator(log_dir, f"{task_name}.stderr",
@@ -43,13 +42,15 @@ class SyslogCollector:
             def handle(self):
                 # docker's tcp syslog framing is newline-delimited
                 for line in self.rfile:
+                    if collector._stopped:
+                        return
                     collector._ingest(line.rstrip(b"\r\n"))
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server(("127.0.0.1", 0), Handler)
+        self._server = Server(("127.0.0.1", port), Handler)
         self.addr = "tcp://127.0.0.1:%d" % self._server.server_address[1]
         self._stopped = False
         self._stop_lock = threading.Lock()
@@ -57,6 +58,10 @@ class SyslogCollector:
             target=self._server.serve_forever, daemon=True,
             name=f"syslog-{task_name}")
         self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
 
     def _ingest(self, line: bytes) -> None:
         severity = 6  # info
@@ -67,7 +72,10 @@ class SyslogCollector:
         line = _HEADER_RE.sub(b"", line, count=1)
         out = (self.stderr if severity <= STDERR_MAX_SEVERITY
                else self.stdout)
-        out.write(line + b"\n")
+        try:
+            out.write(line + b"\n")
+        except ValueError:
+            pass  # stop() closed the rotator under a draining handler
 
     def stop(self) -> None:
         # Idempotent: both the container-exit waiter and kill() stop it.

@@ -524,3 +524,27 @@ def test_docker_run_points_logs_at_syslog_collector(docker_stub, tmp_path):
     while time.time() < deadline and handle.syslog._thread.is_alive():
         time.sleep(0.05)
     assert not handle.syslog._thread.is_alive()
+
+
+def test_docker_reattach_rebinds_syslog_collector(docker_stub, tmp_path):
+    """A restarted client rebinds the collector on the port the
+    container's log driver still targets (handle id carries it)."""
+    ctx = make_ctx(tmp_path)
+    task = Task(name="c", driver="docker", config={"image": "redis"},
+                resources=Resources(cpu=100, memory_mb=64))
+    task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    driver = DockerDriver()
+    handle = driver.start(ctx, task)
+    try:
+        port = handle.syslog.port
+        handle_id = handle.id()
+        assert f":{port}:" in handle_id
+        # simulate the old client dying: release the port
+        handle.syslog.stop()
+        reattached = driver.open(ctx, handle_id)
+        assert reattached is not None
+        assert reattached.syslog is not None
+        assert reattached.syslog.port == port
+        reattached.syslog.stop()
+    finally:
+        handle.kill(1.0)

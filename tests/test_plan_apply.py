@@ -262,3 +262,115 @@ def test_rejected_plan_does_not_pin_stale_base():
         assert r3.alloc_index > 0, "stale base pinned after rejection"
     finally:
         applier.stop()
+
+
+# --------- evaluate_node_plan edges (plan_apply.go:318 test family) ---
+
+
+def _plan_for(node, cpu=100):
+    return make_plan(node, cpu)
+
+
+def test_eval_node_plan_not_ready():
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+
+    fsm, log, nodes = build_world(n_nodes=1)
+    log.apply("node_update_status",
+              {"node_id": nodes[0].id, "status": consts.NODE_STATUS_DOWN})
+    snap = fsm.state.snapshot()
+    assert evaluate_node_plan(snap, _plan_for(nodes[0]), nodes[0].id) is False
+
+
+def test_eval_node_plan_draining():
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+
+    fsm, log, nodes = build_world(n_nodes=1)
+    log.apply("node_update_drain", {"node_id": nodes[0].id, "drain": True})
+    snap = fsm.state.snapshot()
+    assert evaluate_node_plan(snap, _plan_for(nodes[0]), nodes[0].id) is False
+
+
+def test_eval_node_plan_missing_node():
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+
+    fsm, log, nodes = build_world(n_nodes=1)
+    plan = _plan_for(nodes[0])
+    # rewrite the plan to target a node that does not exist
+    plan.node_allocation = {"ghost": plan.node_allocation[nodes[0].id]}
+    for a in plan.node_allocation["ghost"]:
+        a.node_id = "ghost"
+    snap = fsm.state.snapshot()
+    assert evaluate_node_plan(snap, plan, "ghost") is False
+
+
+def test_eval_node_plan_evictions_only_always_safe():
+    """A plan that only stops allocs passes even on a down node
+    (plan_apply.go:318 early return)."""
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+    from nomad_tpu.structs import Plan
+
+    fsm, log, nodes = build_world(n_nodes=1)
+    job = mock.job()
+    alloc = mock.alloc()
+    alloc.node_id = nodes[0].id
+    log.apply("node_update_status",
+              {"node_id": nodes[0].id, "status": consts.NODE_STATUS_DOWN})
+    plan = Plan(job=job)
+    plan.node_update = {nodes[0].id: [alloc]}
+    snap = fsm.state.snapshot()
+    assert evaluate_node_plan(snap, plan, nodes[0].id) is True
+
+
+def test_eval_node_plan_update_existing_in_place():
+    """Evicting an alloc and re-placing its replacement on the same
+    node in one plan fits (the in-place update shape,
+    TestPlanApply_EvalNodePlan_UpdateExisting)."""
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+    from nomad_tpu.structs import Plan
+
+    fsm, log, nodes = build_world(n_nodes=1, cpu=500)
+    job = mock.job()
+    old = make_plan(nodes[0], 300, job=job).node_allocation[nodes[0].id][0]
+    log.apply("alloc_update", {"allocs": [old], "job": job})
+
+    replacement = make_plan(nodes[0], 300, job=job)
+    replacement.node_update = {nodes[0].id: [old]}
+    snap = fsm.state.snapshot()
+    # without the eviction the node would be full; with it, it fits
+    assert evaluate_node_plan(snap, replacement, nodes[0].id) is True
+
+
+def test_eval_node_plan_node_full():
+    from nomad_tpu.server.plan_apply import evaluate_node_plan
+
+    fsm, log, nodes = build_world(n_nodes=1, cpu=500)
+    job = mock.job()
+    old = make_plan(nodes[0], 300, job=job).node_allocation[nodes[0].id][0]
+    log.apply("alloc_update", {"allocs": [old], "job": job})
+    snap = fsm.state.snapshot()
+    assert evaluate_node_plan(
+        snap, make_plan(nodes[0], 300), nodes[0].id) is False
+
+
+def test_gang_commit_all_at_once_rejects_whole_plan():
+    """TestPlanApply_EvalPlan_Partial_AllAtOnce: with all_at_once, one
+    failing node rejects the entire plan."""
+    fsm, log, nodes = build_world(n_nodes=2, cpu=300)
+    job = mock.job()
+    from nomad_tpu.structs import Allocation, Plan
+    from nomad_tpu.utils.ids import generate_uuid
+
+    plan = Plan(job=job, all_at_once=True)
+    for node, cpu in ((nodes[0], 100), (nodes[1], 10_000)):
+        alloc = Allocation(
+            id=generate_uuid(), job_id=job.id, job=job, node_id=node.id,
+            task_group="web", desired_status=consts.ALLOC_DESIRED_RUN,
+        )
+        alloc.task_resources = {
+            "web": mock.job().task_groups[0].tasks[0].resources.copy()}
+        alloc.task_resources["web"].cpu = cpu
+        alloc.task_resources["web"].networks = []
+        plan.append_alloc(alloc)
+    (result,) = run_applier(fsm, log, [plan])
+    assert result.node_allocation == {} and result.node_update == {}
+    assert result.refresh_index > 0
