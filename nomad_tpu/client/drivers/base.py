@@ -22,6 +22,10 @@ class TaskContext:
     log_dir: str = ""
     env: Dict[str, str] = field(default_factory=dict)
     max_kill_timeout: float = 30.0
+    # task log rotation budget (structs LogConfig), so drivers that
+    # rebuild log plumbing on reattach honor the configured limits
+    log_max_files: int = 10
+    log_max_file_size_mb: int = 10
 
 
 @dataclass

@@ -43,11 +43,14 @@ class DockerHandle(DriverHandle):
     """Handle keyed by container id — reattachable across restarts."""
 
     def __init__(self, docker: str, container_id: str, task_name: str,
-                 syslog=None):
+                 syslog=None, syslog_port: int = 0):
         self.docker = docker
         self.container_id = container_id
         self.task_name = task_name
         self.syslog = syslog  # log collector; dies with this client
+        # Persisted even when a rebind failed, so a LATER restart can
+        # still recover log collection on the port the container uses.
+        self.syslog_port = syslog.port if syslog is not None else syslog_port
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
         self._waiter = threading.Thread(target=self._wait_container, daemon=True)
@@ -85,8 +88,8 @@ class DockerHandle(DriverHandle):
     def id(self) -> str:
         # The collector's port rides in the id so a restarted client
         # can rebind it (the container keeps logging to that port).
-        port = self.syslog.port if self.syslog is not None else 0
-        return f"docker:{self.container_id}:{port}:{self.task_name}"
+        return (f"docker:{self.container_id}:{self.syslog_port}:"
+                f"{self.task_name}")
 
     def pid(self) -> Optional[int]:
         try:
@@ -270,10 +273,13 @@ class DockerDriver(Driver):
             from ..syslog import SyslogCollector
 
             try:
-                syslog = SyslogCollector(ctx.log_dir, task_name,
-                                         max_files=10,
-                                         max_bytes=10 * 1024 * 1024,
-                                         port=syslog_port)
+                syslog = SyslogCollector(
+                    ctx.log_dir, task_name,
+                    max_files=ctx.log_max_files,
+                    max_bytes=ctx.log_max_file_size_mb * 1024 * 1024,
+                    port=syslog_port)
             except OSError:
-                syslog = None  # port taken: logs stay dropped, task lives
-        return DockerHandle(docker, container_id, task_name, syslog=syslog)
+                syslog = None  # port taken: logs dropped THIS session;
+                # the port persists in the id for the next restart
+        return DockerHandle(docker, container_id, task_name, syslog=syslog,
+                            syslog_port=syslog_port)

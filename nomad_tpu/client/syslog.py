@@ -50,7 +50,14 @@ class SyslogCollector:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server(("127.0.0.1", port), Handler)
+        try:
+            self._server = Server(("127.0.0.1", port), Handler)
+        except OSError:
+            # Bind failed (fixed-port rebind race): release the rotator
+            # fds opened above before surfacing the error.
+            self.stdout.close()
+            self.stderr.close()
+            raise
         self.addr = "tcp://127.0.0.1:%d" % self._server.server_address[1]
         self._stopped = False
         self._stop_lock = threading.Lock()

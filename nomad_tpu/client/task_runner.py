@@ -137,6 +137,10 @@ class TaskRunner:
             env=task_env_from_alloc_dir(self.alloc, self.task,
                                         self.alloc_dir),
             max_kill_timeout=self.max_kill_timeout,
+            log_max_files=(self.task.log_config.max_files
+                           if self.task.log_config else 10),
+            log_max_file_size_mb=(self.task.log_config.max_file_size_mb
+                                  if self.task.log_config else 10),
         )
 
         try:

@@ -267,10 +267,6 @@ def test_rejected_plan_does_not_pin_stale_base():
 # --------- evaluate_node_plan edges (plan_apply.go:318 test family) ---
 
 
-def _plan_for(node, cpu=100):
-    return make_plan(node, cpu)
-
-
 def test_eval_node_plan_not_ready():
     from nomad_tpu.server.plan_apply import evaluate_node_plan
 
@@ -278,7 +274,7 @@ def test_eval_node_plan_not_ready():
     log.apply("node_update_status",
               {"node_id": nodes[0].id, "status": consts.NODE_STATUS_DOWN})
     snap = fsm.state.snapshot()
-    assert evaluate_node_plan(snap, _plan_for(nodes[0]), nodes[0].id) is False
+    assert evaluate_node_plan(snap, make_plan(nodes[0], 100), nodes[0].id) is False
 
 
 def test_eval_node_plan_draining():
@@ -287,14 +283,14 @@ def test_eval_node_plan_draining():
     fsm, log, nodes = build_world(n_nodes=1)
     log.apply("node_update_drain", {"node_id": nodes[0].id, "drain": True})
     snap = fsm.state.snapshot()
-    assert evaluate_node_plan(snap, _plan_for(nodes[0]), nodes[0].id) is False
+    assert evaluate_node_plan(snap, make_plan(nodes[0], 100), nodes[0].id) is False
 
 
 def test_eval_node_plan_missing_node():
     from nomad_tpu.server.plan_apply import evaluate_node_plan
 
     fsm, log, nodes = build_world(n_nodes=1)
-    plan = _plan_for(nodes[0])
+    plan = make_plan(nodes[0], 100)
     # rewrite the plan to target a node that does not exist
     plan.node_allocation = {"ghost": plan.node_allocation[nodes[0].id]}
     for a in plan.node_allocation["ghost"]:

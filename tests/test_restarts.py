@@ -1,0 +1,71 @@
+"""RestartTracker unit tests (mirror client/restarts_test.go): budget
+per interval, fail vs delay exhaustion, batch success no-restart,
+zero-attempt policies."""
+
+from nomad_tpu.client.restarts import NO_RESTART, RESTART, RestartTracker
+from nomad_tpu.structs import RestartPolicy, consts
+
+
+def policy(attempts=2, interval=10.0, delay=0.25, mode="fail"):
+    return RestartPolicy(attempts=attempts, interval=interval,
+                         delay=delay, mode=mode)
+
+
+def test_mode_fail_exhausts_budget():
+    t = RestartTracker(policy(attempts=2, mode="fail"),
+                       consts.JOB_TYPE_SERVICE)
+    for _ in range(2):
+        decision, wait = t.next_restart(exit_successful=False)
+        assert decision == RESTART
+        assert wait >= 0.25  # at least the base delay (plus jitter)
+    decision, _ = t.next_restart(exit_successful=False)
+    assert decision == NO_RESTART
+
+
+def test_mode_delay_waits_out_interval_and_resets():
+    t = RestartTracker(policy(attempts=1, interval=5.0, delay=0.25,
+                              mode="delay"), consts.JOB_TYPE_SERVICE)
+    assert t.next_restart(False)[0] == RESTART
+    decision, wait = t.next_restart(False)  # budget exhausted
+    assert decision == RESTART  # delay mode never gives up
+    # waits out (the rest of) the interval, not just the delay
+    assert wait >= 0.25
+    # fresh budget afterwards
+    assert t.next_restart(False)[0] == RESTART
+
+
+def test_no_restart_on_batch_success():
+    t = RestartTracker(policy(attempts=5), consts.JOB_TYPE_BATCH)
+    assert t.next_restart(exit_successful=True) == (NO_RESTART, 0.0)
+
+
+def test_service_restarts_even_on_success():
+    """A service task exiting zero still restarts (restarts_test.go
+    NoRestartOnSuccess is batch-only)."""
+    t = RestartTracker(policy(attempts=1), consts.JOB_TYPE_SERVICE)
+    assert t.next_restart(exit_successful=True)[0] == RESTART
+
+
+def test_zero_attempts_never_restarts():
+    t = RestartTracker(policy(attempts=0, mode="fail"),
+                       consts.JOB_TYPE_SERVICE)
+    assert t.next_restart(False)[0] == NO_RESTART
+
+
+def test_budget_resets_after_interval():
+    t = RestartTracker(policy(attempts=1, interval=0.2, delay=0.0,
+                              mode="fail"), consts.JOB_TYPE_SERVICE)
+    assert t.next_restart(False)[0] == RESTART
+    # exhaust
+    assert t.next_restart(False)[0] == NO_RESTART
+    # age the window out
+    t.start_time -= 1.0
+    assert t.next_restart(False)[0] == RESTART
+
+
+def test_jitter_bounds():
+    t = RestartTracker(policy(attempts=10, delay=1.0),
+                       consts.JOB_TYPE_SERVICE)
+    for _ in range(10):
+        _, wait = t.next_restart(False)
+        assert 1.0 <= wait <= 1.25
