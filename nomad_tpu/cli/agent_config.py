@@ -50,6 +50,7 @@ class TelemetryBlock:
     statsd_address: str = ""
     disable_hostname: bool = False
     collection_interval: str = "1s"
+    circonus_submission_url: str = ""
 
 
 @dataclass
@@ -164,6 +165,7 @@ _SCHEMA: Dict[str, Any] = {
     "client.servers": _str_list, "client.network_speed": int,
     "telemetry.statsite_address": str, "telemetry.statsd_address": str,
     "telemetry.collection_interval": str, "telemetry.disable_hostname": bool,
+    "telemetry.circonus_submission_url": str,
     "consul.address": str, "consul.server_service_name": str,
     "consul.client_service_name": str, "consul.auto_advertise": bool,
     "vault.enabled": bool, "vault.address": str, "vault.token": str,

@@ -583,6 +583,7 @@ def cmd_agent(args) -> int:
         statsite_addr=cfg.telemetry.statsite_address,
         disable_hostname=cfg.telemetry.disable_hostname,
         interval=collection_interval,
+        circonus_url=cfg.telemetry.circonus_submission_url,
     )
     # SIGUSR1 dumps recent telemetry to stderr (in-memory sink).
     try:

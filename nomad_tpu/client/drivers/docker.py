@@ -181,11 +181,10 @@ class DockerDriver(Driver):
         if ctx.log_dir:
             from ..syslog import SyslogCollector
 
-            lc = task.log_config
             syslog = SyslogCollector(
                 ctx.log_dir, task.name,
-                max_files=lc.max_files if lc else 10,
-                max_bytes=(lc.max_file_size_mb if lc else 10) * 1024 * 1024,
+                max_files=ctx.log_max_files,
+                max_bytes=ctx.log_max_file_size_mb * 1024 * 1024,
             )
             args += ["--log-driver", "syslog",
                      "--log-opt", f"syslog-address={syslog.addr}",

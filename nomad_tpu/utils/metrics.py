@@ -160,6 +160,63 @@ class StatsiteSink:
                 self._sock = None
 
 
+class CirconusSink:
+    """Circonus httptrap submission (command.go:628-651 wires
+    circonus-gometrics): metrics buffer locally and flush as one JSON
+    document to the check's submission URL on an interval. Numeric
+    gauges/counters/samples submit as numeric values;
+    a failed flush drops the batch (telemetry must never block)."""
+
+    def __init__(self, submission_url: str, flush_interval: float = 10.0):
+        self.url = submission_url
+        self.flush_interval = flush_interval
+        self._lock = threading.Lock()
+        self._pending: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="circonus-flush")
+        self._thread.start()
+
+    def _record(self, name: str, value: float) -> None:
+        with self._lock:
+            self._pending[name] = value
+
+    def incr_counter(self, name: str, value: float) -> None:
+        # Counters ACCUMULATE within a flush window (circonus-gometrics
+        # does the same); only gauges/samples are last-write-wins.
+        with self._lock:
+            self._pending[name] = self._pending.get(name, 0.0) + value
+
+    set_gauge = _record
+    add_sample = _record
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush()
+
+    def flush(self) -> None:
+        import json as _json
+        import urllib.request
+
+        with self._lock:
+            if not self._pending:
+                return
+            batch, self._pending = self._pending, {}
+        body = _json.dumps({k: {"_type": "n", "_value": v}
+                            for k, v in batch.items()}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:  # noqa: BLE001 - telemetry drops, never blocks
+            pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush()  # don't drop the final interval's metrics
+
+
 class Metrics:
     """Fanout front-end; the module-global instance is what call sites
     use (go-metrics global metrics object)."""
@@ -226,14 +283,24 @@ def get_metrics() -> Metrics:
 def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None,
               statsite_addr: Optional[str] = None,
               disable_hostname: bool = True,
-              interval: Optional[float] = None) -> Metrics:
+              interval: Optional[float] = None,
+              circonus_url: Optional[str] = None) -> Metrics:
     """Re-init the global registry from agent telemetry config
-    (command.go:570 setupTelemetry): inmem sink always, statsd (UDP)
-    and statsite (TCP) fanout when configured, hostname tagging unless
-    disabled."""
+    (command.go:570 setupTelemetry): inmem sink always; statsd (UDP),
+    statsite (TCP), and circonus (httptrap) fanout when configured;
+    hostname tagging unless disabled."""
     import socket as _socket
 
     global _global
+    # Reconfiguration must release the old sinks (e.g. the circonus
+    # flush thread would otherwise PUT to a stale URL forever).
+    for sink in getattr(_global, "_sinks", []):
+        closer = getattr(sink, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:  # noqa: BLE001
+                pass
     hostname = "" if disable_hostname else _socket.gethostname()
     m = Metrics(prefix or "nomad_tpu", hostname=hostname)
     if interval:
@@ -242,6 +309,8 @@ def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None,
         m.add_statsd(statsd_addr)
     if statsite_addr:
         m.add_sink(StatsiteSink(statsite_addr))
+    if circonus_url:
+        m.add_sink(CirconusSink(circonus_url))
     _global = m
     return m
 

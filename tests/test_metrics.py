@@ -206,3 +206,80 @@ def test_configure_full():
         m.incr_counter("x", 1)  # statsd UDP send must not raise
     finally:
         gm.configure()
+
+
+def test_circonus_sink_flushes_httptrap():
+    """CirconusSink batches metrics and PUTs one JSON document to the
+    submission URL (httptrap shape)."""
+    import http.server
+    import json
+    import socketserver
+    import threading
+
+    from nomad_tpu.utils.metrics import CirconusSink
+
+    received = []
+    done = threading.Event()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+            done.set()
+
+    class Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/module/httptrap/x/y"
+    sink = CirconusSink(url, flush_interval=3600)  # manual flush only
+    try:
+        sink.set_gauge("nomad_tpu.broker.total_ready", 4)
+        sink.incr_counter("nomad_tpu.worker.dequeue", 1)
+        sink.flush()
+        assert done.wait(5.0)
+        doc = received[0]
+        assert doc["nomad_tpu.broker.total_ready"] == {"_type": "n",
+                                                       "_value": 4}
+        assert "nomad_tpu.worker.dequeue" in doc
+        # a second flush with nothing pending sends nothing
+        count = len(received)
+        sink.flush()
+        assert len(received) == count
+    finally:
+        sink.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_circonus_sink_survives_down_endpoint():
+    from nomad_tpu.utils.metrics import CirconusSink
+
+    sink = CirconusSink("http://127.0.0.1:1/x", flush_interval=3600)
+    sink.set_gauge("g", 1)
+    sink.flush()  # must not raise
+    sink.close()
+
+
+def test_circonus_counters_accumulate():
+    from nomad_tpu.utils.metrics import CirconusSink
+
+    sink = CirconusSink("http://127.0.0.1:1/x", flush_interval=3600)
+    try:
+        for _ in range(5):
+            sink.incr_counter("c", 1)
+        sink.set_gauge("g", 1)
+        sink.set_gauge("g", 9)
+        with sink._lock:
+            assert sink._pending["c"] == 5  # counters sum
+            assert sink._pending["g"] == 9  # gauges last-write-wins
+    finally:
+        sink.close()
