@@ -292,15 +292,6 @@ def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None,
     import socket as _socket
 
     global _global
-    # Reconfiguration must release the old sinks (e.g. the circonus
-    # flush thread would otherwise PUT to a stale URL forever).
-    for sink in getattr(_global, "_sinks", []):
-        closer = getattr(sink, "close", None)
-        if closer is not None:
-            try:
-                closer()
-            except Exception:  # noqa: BLE001
-                pass
     hostname = "" if disable_hostname else _socket.gethostname()
     m = Metrics(prefix or "nomad_tpu", hostname=hostname)
     if interval:
@@ -311,7 +302,25 @@ def configure(prefix: Optional[str] = None, statsd_addr: Optional[str] = None,
         m.add_sink(StatsiteSink(statsite_addr))
     if circonus_url:
         m.add_sink(CirconusSink(circonus_url))
+    # Swap FIRST, then release the old sinks off-thread: emitters racing
+    # the swap can't resurrect a closed statsite socket, and a final
+    # circonus flush to a blackholed URL (5s timeout) can't stall the
+    # reconfigure caller.
+    old = _global
     _global = m
+    old_sinks = getattr(old, "_sinks", [])
+    if any(getattr(s, "close", None) for s in old_sinks):
+        def _release(sinks=old_sinks):
+            for sink in sinks:
+                closer = getattr(sink, "close", None)
+                if closer is not None:
+                    try:
+                        closer()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        threading.Thread(target=_release, daemon=True,
+                         name="metrics-release").start()
     return m
 
 

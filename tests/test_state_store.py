@@ -267,3 +267,51 @@ def test_concurrent_snapshot_consistency():
     for t in threads:
         t.join()
     assert errors == []
+
+
+def test_persist_restore_every_table_via_json():
+    """Full per-table round-trip THROUGH JSON — exactly what the raft
+    snapshot files store (fsm_test.go round-trips per SnapshotType)."""
+    import json as _json
+
+    from nomad_tpu.structs.alloc import VaultAccessor
+    from nomad_tpu.state.store import PeriodicLaunch
+
+    s = StateStore()
+    j = mock.job()
+    n = mock.node()
+    e = mock.eval()
+    a = mock.alloc()
+    a.job_id = j.id
+    a.node_id = n.id
+    a.client_status = "running"
+    s.upsert_job(1, j)
+    s.upsert_node(2, n)
+    s.upsert_evals(3, [e])
+    s.upsert_allocs(4, [a])
+    s.upsert_periodic_launch(5, PeriodicLaunch(id=j.id, launch=123.0))
+    s.upsert_vault_accessors(6, [VaultAccessor(
+        accessor="acc1", alloc_id=a.id, task="web", node_id=n.id,
+        policies=["p1"])])
+
+    data = _json.loads(_json.dumps(s.persist()))  # the raft wire format
+    s2 = StateStore.restore(data)
+
+    assert s2.latest_index() == 6
+    assert s2.job_by_id(j.id).name == j.name
+    assert s2.node_by_id(n.id).datacenter == n.datacenter
+    assert s2.eval_by_id(e.id).priority == e.priority
+    # secondary indexes rebuilt, not just primary rows
+    assert [x.id for x in s2.allocs_by_job(j.id)] == [a.id]
+    assert [x.id for x in s2.allocs_by_node(n.id)] == [a.id]
+    assert [x.id for x in s2.allocs_by_eval(a.eval_id)] == [a.id]
+    launch = s2.periodic_launch_by_id(j.id)
+    assert launch is not None and launch.launch == 123.0
+    accs = s2.vault_accessors_by_alloc(a.id)
+    assert [v.accessor for v in accs] == ["acc1"]
+    # derived job summary survives
+    summary = s2.job_summary_by_id(j.id)
+    assert summary is not None
+    assert summary.summary["web"].running == 1
+    # client-side fields preserved
+    assert s2.alloc_by_id(a.id).client_status == "running"
