@@ -144,3 +144,35 @@ def test_unknown_route_404(api):
     with pytest.raises(APIError) as excinfo:
         client.get("/v1/bogus")
     assert excinfo.value.status == 404
+
+
+def test_blocking_query_times_out_with_current_state(api):
+    """An unchanged watch returns at the wait deadline with the current
+    index (rpc.go:334 blockingRPC timeout path), not an error."""
+    client, server = api
+    job = mock.job()
+    job.task_groups[0].count = 1
+    client.jobs.register(job)
+    assert wait_until(lambda: len(client.jobs.allocations(job.id)[0]) == 1)
+    _, idx = client.jobs.allocations(job.id)
+
+    t0 = time.monotonic()
+    out, new_idx = client.jobs.allocations(job.id, index=idx, wait=0.5)
+    elapsed = time.monotonic() - t0
+    assert 0.4 <= elapsed < 3.0  # waited the window, then answered
+    assert len(out) == 1
+    assert new_idx >= idx
+
+
+def test_blocking_query_stale_index_returns_immediately(api):
+    """index below the current state answers without waiting."""
+    client, server = api
+    job = mock.job()
+    job.task_groups[0].count = 1
+    client.jobs.register(job)
+    assert wait_until(lambda: len(client.jobs.allocations(job.id)[0]) == 1)
+
+    t0 = time.monotonic()
+    out, new_idx = client.jobs.allocations(job.id, index=0, wait=5.0)
+    assert time.monotonic() - t0 < 1.0
+    assert len(out) == 1 and new_idx > 0
