@@ -153,7 +153,16 @@ def test_blocking_query_times_out_with_current_state(api):
     job = mock.job()
     job.task_groups[0].count = 1
     client.jobs.register(job)
+    # Settle fully (alloc placed, eval complete) so no async write
+    # fires the watch after we capture the index. (This fixture runs no
+    # client agent, so alloc status never changes after placement.)
     assert wait_until(lambda: len(client.jobs.allocations(job.id)[0]) == 1)
+    assert wait_until(
+        lambda: all(a.get("client_status") == "running"
+                    for a in client.jobs.allocations(job.id)[0]))
+    assert wait_until(
+        lambda: (evs := client.jobs.evaluations(job.id)[0])
+        and all(e.status == "complete" for e in evs))
     _, idx = client.jobs.allocations(job.id)
 
     t0 = time.monotonic()
@@ -172,7 +181,11 @@ def test_blocking_query_stale_index_returns_immediately(api):
     client.jobs.register(job)
     assert wait_until(lambda: len(client.jobs.allocations(job.id)[0]) == 1)
 
+    _, cur = client.jobs.allocations(job.id)
     t0 = time.monotonic()
-    out, new_idx = client.jobs.allocations(job.id, index=0, wait=5.0)
+    # a POSITIVE index below current drives the stale-index comparison
+    # (index=0 would take the non-blocking fast path instead)
+    out, new_idx = client.jobs.allocations(job.id, index=max(cur - 1, 1),
+                                           wait=5.0)
     assert time.monotonic() - t0 < 1.0
-    assert len(out) == 1 and new_idx > 0
+    assert len(out) == 1 and new_idx >= cur
