@@ -35,7 +35,14 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh
     real TPU chip while a dryrun asks for an 8-way mesh), fall back to
     the host CPU devices — `--xla_force_host_platform_device_count`
     makes those plentiful regardless of the accelerator count."""
-    devices = np.array(jax.devices())
+    try:
+        devices = np.array(jax.devices())
+    except RuntimeError:
+        # Default backend failed to initialize (e.g. no usable
+        # accelerator in the driver environment) — the cpu backend is
+        # always available and plentiful under
+        # --xla_force_host_platform_device_count.
+        devices = np.array(jax.devices("cpu"))
     if n_devices is not None and devices.size < n_devices:
         cpus = np.array(jax.devices("cpu"))
         if cpus.size >= n_devices:
