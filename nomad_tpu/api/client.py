@@ -221,8 +221,10 @@ class Allocations:
     def list(self, index: Optional[int] = None, wait: Optional[float] = None):
         return self.c.get("/v1/allocations", _query_params(index, wait))
 
-    def info(self, alloc_id: str) -> Tuple[Allocation, int]:
-        out, idx = self.c.get(f"/v1/allocation/{alloc_id}")
+    def info(self, alloc_id: str, index: Optional[int] = None,
+             wait: Optional[float] = None) -> Tuple[Allocation, int]:
+        out, idx = self.c.get(
+            f"/v1/allocation/{alloc_id}", _query_params(index, wait))
         return from_dict(Allocation, out), idx
 
 

@@ -176,6 +176,7 @@ class HTTPServer:
             (r"^/v1/client/fs/logs/(?P<alloc_id>[^/]+)$", self._fs_logs),
             (r"^/v1/client/stats$", self._client_stats),
             (r"^/v1/client/allocation/(?P<alloc_id>[^/]+)/stats$", self._client_alloc_stats),
+            (r"^/v1/client/allocation/(?P<alloc_id>[^/]+)/snapshot$", self._client_alloc_snapshot),
             # follower->leader forwarding targets (rpc.go:178 forward);
             # served by the leader for remote followers' workers/timers
             (r"^/v1/internal/eval/dequeue$", self._internal_eval_dequeue),
@@ -190,6 +191,7 @@ class HTTPServer:
         client_only_ok = {
             self._fs_ls, self._fs_stat, self._fs_cat, self._fs_readat,
             self._fs_logs, self._client_stats, self._client_alloc_stats,
+            self._client_alloc_snapshot,
             self._agent_self, self._agent_servers,
         }
         for pattern, handler in route_handlers:
@@ -674,6 +676,13 @@ class HTTPServer:
 
     def _client_alloc_stats(self, method, query, body, alloc_id):
         return self._require_client().alloc_stats(alloc_id)
+
+    def _client_alloc_snapshot(self, method, query, body, alloc_id):
+        """Tar archive of the alloc's migratable dirs: the source side
+        of sticky-disk migration (client.go:1481 GETs this from the old
+        node; served off the local alloc dir, alloc_dir.go:134)."""
+        data = self._require_client().snapshot_alloc(alloc_id)
+        return RawResponse(data, content_type="application/x-tar")
 
 
 def _job_stub(job: Job) -> dict:

@@ -80,6 +80,14 @@ class ClientAgent:
         self._runners_lock = threading.Lock()
         self._dirty_allocs: Dict[str, Allocation] = {}
         self._dirty_lock = threading.Lock()
+        # Replacement allocs waiting on a LOCAL previous alloc to go
+        # terminal (client.go:1330 blockedAllocations), keyed by the
+        # previous alloc id; and ids of allocs whose REMOTE previous
+        # alloc is being waited on / migrated (client.go:153
+        # migratingAllocs).
+        self._blocked_allocs: Dict[str, Allocation] = {}
+        self._migrating_allocs: Dict[str, None] = {}
+        self._migrate_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.heartbeat_ttl = 1.0
@@ -94,6 +102,7 @@ class ClientAgent:
             node.secret_id = generate_uuid()
         node.datacenter = self.config.datacenter
         node.node_class = self.config.node_class
+        node.http_addr = self.config.http_addr
         node.meta.update(self.config.meta)
         if node.resources is None:
             node.resources = Resources()
@@ -299,16 +308,31 @@ class ClientAgent:
                 if alloc.terminal_status():
                     self._kill_restored_handles(alloc.id)
                     continue
-                runner = AllocRunner(
-                    alloc, self.config.alloc_dir, self._mark_dirty,
-                    self.config.max_kill_timeout,
-                    restored_handles=self._restored_handles.pop(alloc.id, None),
-                    persist_cb=self._save_state,
-                    template_kv=self._template_kv,
-                    vault_client=self.vault_client,
+                with self._migrate_lock:
+                    if alloc.id in self._migrating_allocs:
+                        continue  # remote-previous wait already running
+                prev_id = alloc.previous_allocation
+                prev_runner = (
+                    self.alloc_runners.get(prev_id) if prev_id else None
                 )
-                self.alloc_runners[alloc.id] = runner
-                runner.run()
+                if prev_runner is not None and not prev_runner.alloc.terminal_status():
+                    # Chained to a live local alloc: start when it
+                    # terminates (client.go:1330 blocked queue).
+                    self._blocked_allocs[prev_id] = alloc
+                    continue
+                if prev_id and prev_runner is None:
+                    # Previous alloc lives on another node: wait for it
+                    # and migrate its sticky disk off-thread
+                    # (client.go:1371 blockForRemoteAlloc).
+                    with self._migrate_lock:
+                        self._migrating_allocs[alloc.id] = None
+                    threading.Thread(
+                        target=self._block_for_remote_alloc, args=(alloc,),
+                        daemon=True, name=f"migrate-{alloc.id[:8]}",
+                    ).start()
+                    continue
+                self._add_alloc_locked(
+                    alloc, self._sticky_prev_dir(alloc, prev_runner))
             # Allocs that disappeared (or went terminal) while the
             # client was down never re-arrive, but their executors are
             # still running the task: reap them (the reference restores
@@ -316,6 +340,117 @@ class ClientAgent:
             for alloc_id in list(self._restored_handles):
                 if alloc_id not in pulled_ids:
                     self._kill_restored_handles(alloc_id)
+
+    def _add_alloc_locked(self, alloc: Allocation, prev_dir=None) -> None:
+        """Create and start the runner (caller holds _runners_lock).
+        prev_dir is a previous allocation's AllocDir whose sticky
+        ephemeral disk the new alloc adopts (client.go:1585 addAlloc)."""
+        if alloc.id in self.alloc_runners:
+            return
+        runner = AllocRunner(
+            alloc, self.config.alloc_dir, self._mark_dirty,
+            self.config.max_kill_timeout,
+            restored_handles=self._restored_handles.pop(alloc.id, None),
+            persist_cb=self._save_state,
+            template_kv=self._template_kv,
+            vault_client=self.vault_client,
+            previous_alloc_dir=prev_dir,
+        )
+        self.alloc_runners[alloc.id] = runner
+        runner.run()
+
+    def _add_alloc(self, alloc: Allocation, prev_dir=None) -> None:
+        with self._runners_lock:
+            self._add_alloc_locked(alloc, prev_dir)
+
+    def _sticky_prev_dir(self, alloc: Allocation, prev_runner):
+        """The local previous alloc's dir, when the task group asks for
+        a sticky ephemeral disk (client.go:1349-1355)."""
+        if prev_runner is None or alloc.job is None:
+            return None
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        if tg is None or tg.ephemeral_disk is None or not tg.ephemeral_disk.sticky:
+            return None
+        return prev_runner.alloc_dir
+
+    # ------------------------------------------- sticky-disk migration
+
+    def snapshot_alloc(self, alloc_id: str) -> bytes:
+        """Tar of a local alloc's migratable dirs — the payload served
+        at /v1/client/allocation/<id>/snapshot (alloc_dir.go:134)."""
+        return self.fs(alloc_id).snapshot_bytes()
+
+    def _block_for_remote_alloc(self, alloc: Allocation) -> None:
+        """Wait out a remote previous allocation, pull its sticky disk,
+        then start the replacement (client.go:1371 blockForRemoteAlloc +
+        :1441 migrateRemoteAllocDir)."""
+        prev_dir = None
+        try:
+            prev = self._wait_for_alloc_terminal(alloc.previous_allocation)
+            if prev is not None:
+                prev_dir = self._migrate_remote_alloc_dir(prev, alloc)
+        except Exception:
+            self.logger.exception(
+                "migration from remote alloc %s failed",
+                alloc.previous_allocation)
+        if self._stop.is_set():
+            return
+        try:
+            self._add_alloc(alloc, prev_dir)
+        finally:
+            with self._migrate_lock:
+                self._migrating_allocs.pop(alloc.id, None)
+
+    def _wait_for_alloc_terminal(self, alloc_id: str):
+        """Blocking-query loop until the alloc is terminal
+        (client.go:1405 waitForAllocTerminal)."""
+        index = 0
+        while not self._stop.is_set():
+            try:
+                prev, new_index = self.api.allocations.info(
+                    alloc_id, index=index, wait=2.0)
+            except APIError as e:
+                if e.status == 404:
+                    return None
+                if self._stop.wait(1.0):
+                    return None
+                continue
+            except Exception:
+                if self._stop.wait(1.0):
+                    return None
+                continue
+            if prev is None or prev.terminal_status():
+                return prev
+            index = max(new_index, index)
+        return None
+
+    def _migrate_remote_alloc_dir(self, prev: Allocation, alloc: Allocation):
+        """Fetch the previous alloc's snapshot tar from its node's HTTP
+        API and unpack it into a previous-alloc dir for move()
+        (client.go:1441 migrateRemoteAllocDir)."""
+        tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+        if (tg is None or tg.ephemeral_disk is None
+                or not tg.ephemeral_disk.sticky or not tg.ephemeral_disk.migrate):
+            return None
+        node, _ = self.api.nodes.info(prev.node_id)
+        if node is None or node.status == consts.NODE_STATUS_DOWN:
+            self.logger.info(
+                "not migrating alloc %s: node %s down", prev.id, prev.node_id)
+            return None
+        if not node.http_addr:
+            self.logger.warning(
+                "not migrating alloc %s: node %s has no http addr",
+                prev.id, prev.node_id)
+            return None
+        url = f"{node.http_addr}/v1/client/allocation/{prev.id}/snapshot"
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=60.0) as resp:
+            data = resp.read()
+        dest = os.path.join(self.config.alloc_dir, f"{alloc.id}.prev")
+        from .allocdir import AllocDir
+
+        return AllocDir.restore_snapshot(data, dest)
 
     def _kill_restored_handles(self, alloc_id: str) -> None:
         handles = self._restored_handles.pop(alloc_id, None) or {}
@@ -356,6 +491,28 @@ class ClientAgent:
         with self._dirty_lock:
             self._dirty_allocs[alloc.id] = alloc
         self._sync_task_services(alloc)
+        if alloc.terminal_status():
+            self._release_blocked(alloc.id)
+
+    def _release_blocked(self, prev_id: str) -> None:
+        """A local alloc went terminal: start any replacement that was
+        queued behind it, handing over its sticky disk
+        (client.go:1067-1079 blocked-allocation handoff)."""
+        blocked = self._blocked_allocs.pop(prev_id, None)
+        if blocked is None:
+            return
+
+        def _start():
+            with self._runners_lock:
+                prev_runner = self.alloc_runners.get(prev_id)
+                self._add_alloc_locked(
+                    blocked, self._sticky_prev_dir(blocked, prev_runner))
+
+        # Off the state-change callback thread: runner start touches
+        # _runners_lock and may do filesystem renames.
+        threading.Thread(
+            target=_start, daemon=True, name=f"unblock-{blocked.id[:8]}"
+        ).start()
 
     # ------------------------------------------------ consul services
 

@@ -29,6 +29,7 @@ class AllocRunner:
         persist_cb: Optional[Callable[[], None]] = None,
         template_kv=None,
         vault_client=None,
+        previous_alloc_dir=None,
     ):
         self.alloc = alloc
         self.sync_cb = sync_cb
@@ -45,6 +46,10 @@ class AllocRunner:
         self.persist_cb = persist_cb
         self.template_kv = template_kv
         self.vault_client = vault_client
+        # Sticky-disk handoff: a previous allocation's AllocDir whose
+        # data dirs this alloc adopts before tasks start
+        # (client.go:1585 addAlloc prevAllocDir).
+        self.previous_alloc_dir = previous_alloc_dir
         self._lock = threading.Lock()
         self._destroyed = False
 
@@ -61,6 +66,16 @@ class AllocRunner:
             return
 
         self.alloc_dir.build([t.name for t in tg.tasks])
+        if self.previous_alloc_dir is not None:
+            # Adopt the sticky ephemeral disk before any task starts
+            # (alloc_runner.go Run -> Move semantics).
+            try:
+                self.alloc_dir.move(
+                    self.previous_alloc_dir, [t.name for t in tg.tasks]
+                )
+            except OSError:
+                self.logger.exception("sticky-disk move failed")
+            self.previous_alloc_dir = None
         for task in tg.tasks:
             runner = TaskRunner(
                 self.alloc, task, self.alloc_dir, self._on_task_state,

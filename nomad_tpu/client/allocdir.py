@@ -2,14 +2,17 @@
 
 Reference: client/allocdir/alloc_dir.go:58 — shared `alloc/` (logs,
 tmp, data) and per-task dirs with `local/` and `secrets/`, plus the
-file APIs backing the HTTP fs endpoints (List/Stat/ReadAt:461-551).
+file APIs backing the HTTP fs endpoints (List/Stat/ReadAt:461-551) and
+the sticky-disk migration pair Snapshot:134 / Move:194.
 """
 
 from __future__ import annotations
 
+import io
 import os
 import shutil
 import stat
+import tarfile
 from typing import Dict, List, Optional
 
 SHARED_ALLOC_NAME = "alloc"
@@ -41,6 +44,88 @@ class AllocDir:
 
     def destroy(self) -> None:
         shutil.rmtree(self.root, ignore_errors=True)
+
+    # ------------------------------- sticky-disk migration ------------
+
+    def _migratable_roots(self) -> List[str]:
+        """The dirs that travel with a sticky ephemeral disk: the shared
+        `alloc/data` dir and every task's `local/` dir
+        (alloc_dir.go:134-141)."""
+        roots = [os.path.join(self.shared_dir, "data")]
+        for path in self.task_dirs.values():
+            roots.append(os.path.join(path, TASK_LOCAL))
+        return roots
+
+    def snapshot(self, fileobj) -> None:
+        """Write a tar archive of the migratable dirs to `fileobj`,
+        member names relative to the alloc root so the receiver can
+        restore them into its own layout (alloc_dir.go:134 Snapshot).
+        Symlinks are skipped, like the reference, so a task can't smuggle
+        host paths to the destination node."""
+        with tarfile.open(fileobj=fileobj, mode="w|") as tw:
+            for root in self._migratable_roots():
+                if not os.path.isdir(root):
+                    continue
+                for dirpath, dirnames, filenames in os.walk(root):
+                    for name in dirnames + filenames:
+                        full = os.path.join(dirpath, name)
+                        if os.path.islink(full):
+                            continue
+                        rel = os.path.relpath(full, self.root)
+                        tw.add(full, arcname=rel, recursive=False)
+
+    def snapshot_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        self.snapshot(buf)
+        return buf.getvalue()
+
+    @staticmethod
+    def restore_snapshot(data: bytes, dest_root: str) -> "AllocDir":
+        """Unpack a snapshot() archive into `dest_root`, producing a
+        previous-alloc dir that move() can consume (the untar loop of
+        client.go:1489-1529). Member paths are validated against the
+        destination root (the reference trusts its peer; we don't)."""
+        os.makedirs(dest_root, exist_ok=True)
+        dest = os.path.normpath(dest_root)
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tr:
+            for member in tr:
+                if not (member.isreg() or member.isdir()):
+                    continue
+                full = os.path.normpath(os.path.join(dest, member.name))
+                if full != dest and not full.startswith(dest + os.sep):
+                    raise PermissionError(
+                        f"snapshot member escapes dest: {member.name!r}")
+                if member.isdir():
+                    os.makedirs(full, exist_ok=True)
+                else:
+                    os.makedirs(os.path.dirname(full), exist_ok=True)
+                    src = tr.extractfile(member)
+                    with open(full, "wb") as out:
+                        shutil.copyfileobj(src, out)
+        prev = AllocDir(dest_root)
+        for name in os.listdir(dest_root):
+            if name != SHARED_ALLOC_NAME and os.path.isdir(
+                os.path.join(dest_root, name)
+            ):
+                prev.task_dirs[name] = os.path.join(dest_root, name)
+        return prev
+
+    def move(self, other: "AllocDir", task_names: List[str]) -> None:
+        """Adopt `other`'s migratable data by rename: the shared data
+        dir and each task's local dir (alloc_dir.go:194 Move). Call
+        after build() so the destinations exist."""
+        other_data = os.path.join(other.shared_dir, "data")
+        data_dir = os.path.join(self.shared_dir, "data")
+        if os.path.isdir(other_data):
+            shutil.rmtree(data_dir, ignore_errors=True)
+            os.rename(other_data, data_dir)
+        for name in task_names:
+            other_local = os.path.join(other.root, name, TASK_LOCAL)
+            mine = self.task_dirs.get(name)
+            if mine and os.path.isdir(other_local):
+                local = os.path.join(mine, TASK_LOCAL)
+                shutil.rmtree(local, ignore_errors=True)
+                os.rename(other_local, local)
 
     # ------------------------------ file APIs (HTTP fs endpoints) -----
 

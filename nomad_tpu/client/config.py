@@ -41,3 +41,7 @@ class ClientConfig:
     # Override the fingerprinted network link speed in mbits
     # (client config network_speed).
     network_speed: int = 0
+    # This agent's advertised HTTP endpoint ("http://host:port"),
+    # published on the node so peers can pull sticky-disk snapshots
+    # from it (client.go:1481 migrates via the old node's HTTPAddr).
+    http_addr: str = ""

@@ -44,14 +44,18 @@ ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
 _BASE_CACHE: Dict[Tuple, "_ClusterBase"] = {}
 _BASE_CACHE_MAX = 8
 _BASE_CACHE_LOCK = __import__("threading").Lock()
+_BASE_TOKENS = __import__("itertools").count(1)
 
 
 class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
-                 "alloc_groups")
+                 "alloc_groups", "token")
 
     def __init__(self, nodes, proposed_fn):
+        # Identity token: evals whose matrices share one base can share
+        # a single device upload (scheduler/batcher.py groups by it).
+        self.token = next(_BASE_TOKENS)
         self.n_real = len(nodes)
         self.n = bucket_size(self.n_real)
         n = self.n
@@ -199,6 +203,7 @@ class ClusterMatrix:
         base = self._cached_base()
         # Share the immutable base arrays; the kernel never mutates its
         # inputs (functional scan carries copies).
+        self.base_token = base.token
         self.capacity = base.capacity
         self.sched_capacity = base.sched_capacity
         self.util = base.util

@@ -32,6 +32,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.jaxcache import enable_compilation_cache
+
+enable_compilation_cache()
+
 # Resource dims in the dense matrices.
 R_CPU, R_MEM, R_DISK, R_IOPS = 0, 1, 2, 3
 NUM_RESOURCES = 4
@@ -248,3 +252,35 @@ def batched_placement_program_shared(
     return jax.vmap(
         lambda k: placement_program(state, asks, k, config)
     )(keys)
+
+
+# vmap axes for the overlay path: the job-independent cluster base
+# (capacity/util/bandwidth/ports/node_ok) is SHARED across the eval
+# batch (in_axes=None — one device copy, no per-eval transfer), while
+# the per-job overlay (this job's alloc counts + constraint mask) and
+# the asks carry the batch axis.
+_OVERLAY_STATE_AXES = NodeState(
+    capacity=None, sched_capacity=None, util=None, bw_avail=None,
+    bw_used=None, ports_free=None, job_count=0, tg_count=0,
+    feasible=0, node_ok=None,
+)
+_OVERLAY_ASKS_AXES = Asks(
+    resources=0, bw=0, ports=0, tg_index=0, active=0,
+    job_distinct_hosts=0, tg_distinct_hosts=0,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def batched_placement_program_overlay(
+    state: NodeState, asks: Asks, keys, config: PlacementConfig
+):
+    """Batched evals of DIFFERENT jobs against one shared snapshot: the
+    heavy [N,4] base matrices are unbatched (uploaded once per
+    snapshot, cached on device by the batcher), while job_count [B,N],
+    tg_count/feasible [B,N,G], asks, and keys carry the batch axis.
+    This is what makes live broker-drain batches cheap: per dispatch
+    only the small per-job overlays move host->device."""
+    return jax.vmap(
+        lambda s, a, k: placement_program(s, a, k, config),
+        in_axes=(_OVERLAY_STATE_AXES, _OVERLAY_ASKS_AXES, 0),
+    )(state, asks, keys)

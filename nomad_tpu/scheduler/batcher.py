@@ -3,16 +3,29 @@ dispatch.
 
 The north star (BASELINE.json, SURVEY.md §5): evals drained from the
 broker batch into a single device program — N workers' placement
-requests with the same bucketed shapes ride one
-`batched_placement_program` call instead of N serial dispatches. This
-is the live-pipeline analog of bench.py's drain-to-batch measurement:
-per-dispatch overhead (Python→XLA call, PRNG split, transfer) is paid
-once per batch, and the vmapped program keeps the VPU busy.
+requests with the same bucketed shapes ride one vmapped dispatch
+instead of N serial dispatches. Per-dispatch overhead (Python→XLA
+call, transfer, device RTT) is paid once per batch.
 
-Requests are grouped by compatibility key (node bucket, ask bucket,
-group count, penalty): only same-shaped programs can share a dispatch
-(no recompiles). A short accumulation window lets concurrent workers
-pile on; a lone request ships immediately after it.
+Requests are grouped by shape key (node bucket, ask bucket, group
+count, penalty): only same-shaped programs can share a dispatch (no
+recompiles). Within a batch there are two device paths:
+
+- every request shares one *cluster base* (the job-independent [N,4]
+  matrices, models/matrix.py _ClusterBase, identified by its token):
+  the base is uploaded once and LRU-cached on device; the dispatch
+  moves only the small per-job overlays (alloc counts + feasibility),
+  asks, and PRNG keys (ops/binpack.py
+  batched_placement_program_overlay). This is the live broker-drain
+  fast path — many evals of different jobs against one snapshot.
+- mixed bases: the full states stack along the batch axis
+  (batched_placement_program).
+
+The window is adaptive: while a device dispatch is in flight, new
+requests simply accumulate and the follow-up dispatch takes everything
+queued (up to MAX_BATCH) with no added wait; only a first request on an
+idle batcher waits a short fixed window for concurrent workers to pile
+on.
 """
 
 from __future__ import annotations
@@ -24,21 +37,33 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 MAX_BATCH = 64
-WINDOW_S = 0.003  # accumulation window once a first request arrives
+WINDOW_S = 0.003  # idle-batcher accumulation window
+DEVICE_BASE_CACHE = 4  # cluster bases kept on device
 
 
 class _Request:
-    __slots__ = ("state", "asks", "key", "event", "choices", "scores",
-                 "error")
+    __slots__ = ("token", "base", "overlay", "asks", "key", "event",
+                 "choices", "scores", "error")
 
-    def __init__(self, state, asks, key):
-        self.state = state
+    def __init__(self, token, base, overlay, asks, key):
+        self.token = token  # cluster-base identity, None = unshared
+        self.base = base  # (capacity, sched_capacity, util, bw_avail,
+        #                    bw_used, ports_free, node_ok)
+        self.overlay = overlay  # (job_count, tg_count, feasible)
         self.asks = asks
         self.key = key
         self.event = threading.Event()
         self.choices = None
         self.scores = None
         self.error: Optional[BaseException] = None
+
+    def full_state(self):
+        from ..ops.binpack import make_node_state
+
+        b, o = self.base, self.overlay
+        return make_node_state(
+            b[0], b[1], b[2], b[3], b[4], b[5], o[0], o[1], o[2], b[6]
+        )
 
 
 class PlacementBatcher:
@@ -51,17 +76,30 @@ class PlacementBatcher:
         self._lock = threading.Lock()
         self._queues: Dict[Tuple, List[_Request]] = {}
         self._dispatcher_live: Dict[Tuple, bool] = {}
+        self._device_bases: "Dict[object, tuple]" = {}  # token -> device arrays
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
+        self.base_uploads = 0  # cluster-base host->device transfers
+        self.overlay_dispatches = 0  # dispatches via the shared-base path
 
     def place(self, state, asks, rng_key, config):
         """Submit one eval's placement; blocks until its batch's device
-        dispatch returns. Returns (choices, scores) for THIS request."""
+        dispatch returns. Returns (choices, scores) for THIS request.
+
+        `state` is anything exposing the NodeState field names
+        (ops/binpack.NodeState itself, or models/matrix.ClusterMatrix —
+        the latter also carries base_token, enabling the shared-base
+        device cache)."""
+        base = (state.capacity, state.sched_capacity, state.util,
+                state.bw_avail, state.bw_used, state.ports_free,
+                state.node_ok)
+        overlay = (state.job_count, state.tg_count, state.feasible)
         shape_key = (
-            state.util.shape, asks.resources.shape,
-            state.feasible.shape[1], config,
+            np.shape(state.capacity), np.shape(asks.resources),
+            np.shape(state.feasible)[-1], config,
         )
-        req = _Request(state, asks, rng_key)
+        token = getattr(state, "base_token", None)
+        req = _Request(token, base, overlay, asks, rng_key)
         run_dispatch = False
         with self._lock:
             self._queues.setdefault(shape_key, []).append(req)
@@ -70,13 +108,86 @@ class PlacementBatcher:
                 self._dispatcher_live[shape_key] = True
                 run_dispatch = True
         if run_dispatch:
-            self._dispatch(shape_key, config)
+            self._dispatch(shape_key, config, wait_window=True)
         req.event.wait()
         if req.error is not None:
             raise req.error
         return req.choices, req.scores
 
-    def _dispatch(self, shape_key, config) -> None:
+    # ------------------------------------------------------------------
+
+    def _device_base(self, token, base):
+        """One host->device upload per cluster base, LRU-cached."""
+        import jax
+
+        with self._lock:
+            cached = self._device_bases.get(token)
+        if cached is not None:
+            return cached
+        dev = tuple(jax.device_put(np.asarray(x)) for x in base)
+        with self._lock:
+            while len(self._device_bases) >= DEVICE_BASE_CACHE:
+                self._device_bases.pop(next(iter(self._device_bases)))
+            self._device_bases[token] = dev
+        self.base_uploads += 1
+        return dev
+
+    def _run_batch(self, batch: List[_Request], config) -> None:
+        import jax
+
+        from ..ops.binpack import (
+            NodeState,
+            batched_placement_program,
+            batched_placement_program_overlay,
+            placement_program_jit,
+        )
+
+        if len(batch) == 1:
+            req = batch[0]
+            choices, scores, _ = placement_program_jit(
+                req.full_state(), req.asks, req.key, config)
+            req.choices = np.asarray(choices)
+            req.scores = np.asarray(scores)
+            return
+
+        # Pad the batch axis to a power of two: every distinct B is a
+        # distinct XLA program, and live drains produce ragged sizes —
+        # unbucketed, each one would pay a full compile. Padding rows
+        # replicate the last request; their outputs are discarded.
+        n_live = len(batch)
+        pad_to = min(1 << (n_live - 1).bit_length(), self.max_batch)
+        padded = batch + [batch[-1]] * (pad_to - n_live)
+
+        keys = np.stack([r.key for r in padded])
+        asks = jax.tree.map(lambda *xs: np.stack(xs), *[r.asks for r in padded])
+        token = batch[0].token
+        if token is not None and all(r.token == token for r in batch):
+            # Shared-base fast path: base cached on device, only the
+            # per-job overlays cross host->device this dispatch.
+            dev = self._device_base(token, batch[0].base)
+            state = NodeState(
+                capacity=dev[0], sched_capacity=dev[1], util=dev[2],
+                bw_avail=dev[3], bw_used=dev[4], ports_free=dev[5],
+                job_count=np.stack([r.overlay[0] for r in padded]),
+                tg_count=np.stack([r.overlay[1] for r in padded]),
+                feasible=np.stack([r.overlay[2] for r in padded]),
+                node_ok=dev[6],
+            )
+            choices, scores, _ = batched_placement_program_overlay(
+                state, asks, keys, config)
+            self.overlay_dispatches += 1
+        else:
+            states = jax.tree.map(
+                lambda *xs: np.stack(xs), *[r.full_state() for r in padded])
+            choices, scores, _ = batched_placement_program(
+                states, asks, keys, config)
+        choices = np.asarray(choices)
+        scores = np.asarray(scores)
+        for i, req in enumerate(batch):
+            req.choices = choices[i]
+            req.scores = scores[i]
+
+    def _dispatch(self, shape_key, config, wait_window: bool) -> None:
         """Everything — including imports and the queue pop — runs
         under the error handler: a dispatcher that dies without setting
         its requests' events (e.g. a TPU runtime init failure) would
@@ -85,12 +196,12 @@ class PlacementBatcher:
         try:
             import time as _time
 
-            import jax
-
-            from ..ops.binpack import batched_placement_program
-
-            # Accumulation window: let concurrent workers join.
-            _time.sleep(self.window)
+            if wait_window and self.window > 0:
+                # Idle batcher: give concurrent workers a moment to
+                # pile on. Post-dispatch respawns skip this — whatever
+                # accumulated during the in-flight device call ships
+                # immediately (the adaptive part of the window).
+                _time.sleep(self.window)
             with self._lock:
                 waiting = self._queues.pop(shape_key, [])
                 batch = waiting[: self.max_batch]
@@ -102,27 +213,7 @@ class PlacementBatcher:
                 self._dispatcher_live[shape_key] = False
             if not batch:
                 return
-            if len(batch) == 1:
-                from ..ops.binpack import placement_program_jit
-
-                req = batch[0]
-                choices, scores, _ = placement_program_jit(
-                    req.state, req.asks, req.key, config)
-                req.choices = np.asarray(choices)
-                req.scores = np.asarray(scores)
-            else:
-                states = jax.tree.map(
-                    lambda *xs: np.stack(xs), *[r.state for r in batch])
-                asks = jax.tree.map(
-                    lambda *xs: np.stack(xs), *[r.asks for r in batch])
-                keys = np.stack([r.key for r in batch])
-                choices, scores, _ = batched_placement_program(
-                    states, asks, keys, config)
-                choices = np.asarray(choices)
-                scores = np.asarray(scores)
-                for i, req in enumerate(batch):
-                    req.choices = choices[i]
-                    req.scores = scores[i]
+            self._run_batch(batch, config)
             self.dispatches += 1
             self.batched_requests += len(batch)
         except BaseException as e:  # noqa: BLE001 - propagate per request
@@ -151,13 +242,15 @@ class PlacementBatcher:
                     spawn = False
             if spawn:
                 threading.Thread(
-                    target=self._dispatch, args=(shape_key, config),
+                    target=self._dispatch, args=(shape_key, config, False),
                     daemon=True, name="placement-batch").start()
 
     def stats(self) -> dict:
         return {
             "dispatches": self.dispatches,
             "batched_requests": self.batched_requests,
+            "base_uploads": self.base_uploads,
+            "overlay_dispatches": self.overlay_dispatches,
         }
 
 

@@ -97,11 +97,7 @@ class BatchedTPUScheduler(GenericScheduler):
         import jax
 
         from ..models.matrix import ClusterMatrix
-        from ..ops.binpack import (
-            PlacementConfig,
-            make_asks,
-            make_node_state,
-        )
+        from ..ops.binpack import PlacementConfig, make_asks
         from .batcher import get_batcher
         from .stack import (
             BATCH_JOB_ANTI_AFFINITY_PENALTY,
@@ -135,11 +131,6 @@ class BatchedTPUScheduler(GenericScheduler):
         tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
         placements = [tg_indices[m.task_group.name] for m in bulk]
 
-        state = make_node_state(
-            matrix.capacity, matrix.sched_capacity, matrix.util,
-            matrix.bw_avail, matrix.bw_used, matrix.ports_free,
-            matrix.job_count, matrix.tg_count, matrix.feasible, matrix.node_ok,
-        )
         asks = make_asks(*matrix.build_asks(placements))
         penalty = (
             BATCH_JOB_ANTI_AFFINITY_PENALTY
@@ -151,8 +142,9 @@ class BatchedTPUScheduler(GenericScheduler):
 
         # The drain-to-batch shim (BASELINE north star): concurrent
         # workers' same-shaped placement programs coalesce into one
-        # vmapped device dispatch instead of N serial calls.
-        choices, scores = get_batcher().place(state, asks, key, config)
+        # vmapped device dispatch instead of N serial calls, and evals
+        # sharing a cluster base ride one cached device upload.
+        choices, scores = get_batcher().place(matrix, asks, key, config)
         choices = np.asarray(choices)
         scores = np.asarray(scores)
 

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..structs import Evaluation
 from ..utils.ids import generate_uuid
+from ..utils.timer import default_wheel
 
 FAILED_QUEUE = "_failed"
 
@@ -72,7 +73,8 @@ class EvalBroker:
         self._unack: Dict[str, _Unack] = {}
         self._job_evals: Dict[str, str] = {}  # job claim: job_id -> eval id
         self._blocked: Dict[str, _Heap] = {}  # per-job wait heaps
-        self._wait_timers: Dict[str, threading.Timer] = {}
+        self._wheel = default_wheel()  # shared timer thread (utils/timer.py)
+        self._wait_timers: Dict[str, object] = {}
         # Evals the scheduler re-submitted (reblock) while outstanding;
         # processed on Ack (eval_broker.go:171-182 requeue).
         self._requeue: Dict[str, Evaluation] = {}
@@ -130,10 +132,8 @@ class EvalBroker:
         if self._enabled:
             self._evals[ev.id] = 0
         if ev.wait and ev.wait > 0:
-            timer = threading.Timer(ev.wait, self._wait_done, args=(ev,))
-            timer.daemon = True
-            self._wait_timers[ev.id] = timer
-            timer.start()
+            self._wait_timers[ev.id] = self._wheel.schedule(
+                ev.wait, self._wait_done, ev)
             return
         self._enqueue_locked(ev, ev.type)
 
@@ -178,6 +178,26 @@ class EvalBroker:
                         return None, ""
                 self._cond.wait(remaining if remaining is not None else 1.0)
 
+    def dequeue_many(
+        self, schedulers: List[str], max_n: int
+    ) -> List[Tuple[Evaluation, str]]:
+        """Non-blocking drain of up to max_n ready evals for the given
+        scheduler types. Extension over the reference's single-dequeue
+        (eval_broker.go:259) for the dense backend's drain-to-batch
+        path: per-job serialization still holds (a job's later evals
+        stay in its blocked heap), so a drained batch is always over
+        distinct jobs."""
+        out: List[Tuple[Evaluation, str]] = []
+        with self._lock:
+            if not self._enabled:
+                return out
+            while len(out) < max_n:
+                ev = self._scan_for_schedulers(schedulers)
+                if ev is None:
+                    break
+                out.append(self._dequeue_locked(ev))
+        return out
+
     def _scan_for_schedulers(self, schedulers: List[str]) -> Optional[Evaluation]:
         best_queue = None
         best_priority = -1
@@ -196,10 +216,9 @@ class EvalBroker:
     def _dequeue_locked(self, ev: Evaluation) -> Tuple[Evaluation, str]:
         token = generate_uuid()
         self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
-        timer = threading.Timer(self.nack_timeout, self._nack_timeout, args=(ev.id, token))
-        timer.daemon = True
+        timer = self._wheel.schedule(
+            self.nack_timeout, self._nack_timeout, ev.id, token)
         self._unack[ev.id] = _Unack(ev, token, timer)
-        timer.start()
         return ev, token
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
@@ -270,13 +289,9 @@ class EvalBroker:
         with self._lock:
             unack = self._check_token(eval_id, token)
             if unack.nack_timer_paused:
-                timer = threading.Timer(
-                    self.nack_timeout, self._nack_timeout, args=(eval_id, token)
-                )
-                timer.daemon = True
-                unack.timer = timer
+                unack.timer = self._wheel.schedule(
+                    self.nack_timeout, self._nack_timeout, eval_id, token)
                 unack.nack_timer_paused = False
-                timer.start()
 
     # ------------------------------------------------------------------
 

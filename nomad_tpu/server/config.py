@@ -32,6 +32,12 @@ class ServerConfig:
     eval_nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
 
+    # Max evals a worker drains per broker visit when the eval's
+    # factory is a dense (TPU) one, so their placement programs share
+    # one batched device dispatch (extension over the reference's
+    # single dequeue, eval_broker.go:259). 1 disables batching.
+    eval_batch_size: int = 16
+
     # Telemetry gauge emission period (command.go:570 setupTelemetry)
     telemetry_interval: float = 10.0
     statsd_addr: str = ""

@@ -11,9 +11,10 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 from ..structs import consts
+from ..utils.timer import default_wheel
 
 
 class HeartbeatTimers:
@@ -21,7 +22,8 @@ class HeartbeatTimers:
         self.server = server
         self.logger = logging.getLogger("nomad_tpu.heartbeat")
         self._lock = threading.Lock()
-        self._timers: Dict[str, threading.Timer] = {}
+        self._wheel = default_wheel()  # one thread for ALL node TTLs
+        self._timers: Dict[str, object] = {}
         self._enabled = False
 
     def set_enabled(self, enabled: bool) -> None:
@@ -55,13 +57,10 @@ class HeartbeatTimers:
             if existing is not None:
                 existing.cancel()
             ttl = self.ttl()
-            timer = threading.Timer(
+            self._timers[node_id] = self._wheel.schedule(
                 ttl + self.server.config.heartbeat_grace,
-                self._invalidate, args=(node_id,),
+                self._invalidate, node_id,
             )
-            timer.daemon = True
-            self._timers[node_id] = timer
-            timer.start()
             return ttl
 
     def clear_timer(self, node_id: str) -> None:

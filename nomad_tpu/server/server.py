@@ -789,6 +789,18 @@ class Server:
         time.sleep(min(timeout, 0.2))
         return None, ""
 
+    def eval_dequeue_many(
+        self, schedulers: List[str], max_n: int
+    ) -> List[Tuple[Evaluation, str]]:
+        """Non-blocking drain of additional ready evals (dense-backend
+        batch path; see broker.dequeue_many). Remote-leader forwarding
+        is intentionally omitted: batching only pays on the worker's
+        local broker, a follower just processes singly."""
+        leader = self._leader_server()
+        if leader is None or max_n <= 0:
+            return []
+        return leader.broker.dequeue_many(schedulers, max_n)
+
     def eval_ack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
         if leader is not None:

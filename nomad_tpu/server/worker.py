@@ -5,15 +5,24 @@ Reference: nomad/worker.go:50 — the worker implements the scheduler's
 Planner interface (worker.go:285-483): plans go through the leader's
 plan queue; a RefreshIndex response makes the worker catch its local
 state up and hand the scheduler a fresh snapshot.
+
+Extension over the reference (VERDICT round 1 / BASELINE north star):
+when an eval routes to a dense (TPU) factory, the worker drains more
+ready evals of the same type in one broker visit (dequeue_many) and
+processes them concurrently, so their placement programs coalesce into
+one batched device dispatch (scheduler/batcher.py) even with a single
+active worker. The reference's single-dequeue loop cannot form device
+batches; this is the drain-to-batch shim the dense backend needs.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
 from ..utils import metrics
@@ -22,6 +31,60 @@ from ..structs import Evaluation, Plan, PlanResult, consts
 DEQUEUE_TIMEOUT = 0.5
 BACKOFF_BASE = 0.02
 BACKOFF_LIMIT = 2.0
+
+
+def is_dense_factory(name: str) -> bool:
+    """Dense/TPU factories benefit from drain-to-batch processing."""
+    return name.endswith("-tpu")
+
+
+class EvalSession:
+    """Per-eval Planner (worker.go:285-483). One session per in-flight
+    eval so a worker can process a drained batch concurrently — the
+    Planner callbacks need the eval's own token, not worker state."""
+
+    def __init__(self, worker: "Worker", ev: Evaluation, token: str):
+        self.worker = worker
+        self.server = worker.server
+        self.eval = ev
+        self.token = token
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        start = time.monotonic()
+        plan.eval_token = self.token
+        # The Nack clock stops while the plan waits in the queue
+        # (plan_endpoint.go:16).
+        try:
+            self.server.eval_pause_nack(self.eval.id, self.token)
+        except ValueError:
+            pass
+        try:
+            result = self.server.plan_submit(plan)
+        finally:
+            try:
+                self.server.eval_resume_nack(self.eval.id, self.token)
+            except ValueError:
+                pass
+        metrics.measure_since(("worker", "submit_plan"), start)
+        if result.refresh_index:
+            # Stale snapshot: catch up and hand back fresh state.
+            self.worker._wait_for_index(result.refresh_index, timeout=5.0)
+            return result, self.server.fsm.state.snapshot()
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.eval_update([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.snapshot_index = self.server.fsm.state.latest_index()
+        self.server.eval_update([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        token = self.server.eval_outstanding(ev.id)
+        if token != self.token:
+            raise ValueError(f"eval {ev.id!r} is not outstanding")
+        ev.snapshot_index = self.server.fsm.state.latest_index()
+        self.server.eval_update([ev], token=self.token)
 
 
 class Worker:
@@ -34,9 +97,6 @@ class Worker:
         self._pause_lock = threading.Lock()
         self._pause_cond = threading.Condition(self._pause_lock)
         self._thread: Optional[threading.Thread] = None
-        # Current eval context for the Planner interface
-        self._eval: Optional[Evaluation] = None
-        self._token: str = ""
         self.rng = random.Random()
 
     # ------------------------------------------------------------------
@@ -78,25 +138,51 @@ class Worker:
             if ev is None:
                 continue
             metrics.measure_since(("worker", "dequeue_eval"), start)
-            start = time.monotonic()
-            if not self._wait_for_index(ev.modify_index, timeout=5.0):
-                self.server.eval_nack(ev.id, token)
-                continue
-            metrics.measure_since(("worker", "wait_for_index"), start)
-            self._eval, self._token = ev, token
-            start = time.monotonic()
-            try:
-                self._invoke_scheduler(ev)
-            except Exception:
-                self.logger.exception("eval %s failed", ev.id)
-                self._safe_nack(ev.id, token)
-                continue
-            finally:
-                metrics.measure_since(("worker", "invoke_scheduler", ev.type), start)
-            try:
-                self.server.eval_ack(ev.id, token)
-            except ValueError:
-                pass  # nack timer fired concurrently
+            group = [(ev, token)]
+            batch_max = self.server.config.eval_batch_size
+            if batch_max > 1 and is_dense_factory(
+                self.server.config.factory_for(ev.type)
+            ):
+                # Drain-to-batch: siblings of the same type ride one
+                # device dispatch. Non-blocking — whatever is ready now.
+                group.extend(
+                    self.server.eval_dequeue_many([ev.type], batch_max - 1)
+                )
+            if len(group) == 1:
+                self._process_eval(ev, token)
+            else:
+                metrics.add_sample(("worker", "eval_batch"), len(group))
+                threads = [
+                    threading.Thread(
+                        target=self._process_eval, args=(e, t),
+                        name=f"worker-{self.id}-batch", daemon=True)
+                    for e, t in group[1:]
+                ]
+                for t in threads:
+                    t.start()
+                self._process_eval(ev, token)
+                for t in threads:
+                    t.join()
+
+    def _process_eval(self, ev: Evaluation, token: str) -> None:
+        start = time.monotonic()
+        if not self._wait_for_index(ev.modify_index, timeout=5.0):
+            self._safe_nack(ev.id, token)
+            return
+        metrics.measure_since(("worker", "wait_for_index"), start)
+        start = time.monotonic()
+        try:
+            self._invoke_scheduler(ev, token)
+        except Exception:
+            self.logger.exception("eval %s failed", ev.id)
+            self._safe_nack(ev.id, token)
+            return
+        finally:
+            metrics.measure_since(("worker", "invoke_scheduler", ev.type), start)
+        try:
+            self.server.eval_ack(ev.id, token)
+        except ValueError:
+            pass  # nack timer fired concurrently
 
     def _safe_nack(self, eval_id: str, token: str) -> None:
         try:
@@ -116,47 +202,14 @@ class Worker:
             backoff = min(backoff * 2, BACKOFF_LIMIT)
         return True
 
-    def _invoke_scheduler(self, ev: Evaluation) -> None:
+    def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
         snapshot = self.server.fsm.state.snapshot()
         factory = self.server.config.factory_for(ev.type)
-        sched = new_scheduler(factory, self.logger, snapshot, self, rng=self.rng)
+        session = EvalSession(self, ev, token)
+        # Independent PRNG per eval: concurrent batch members must not
+        # share tie-break streams (duplicate streams would correlate
+        # their placements, spiking plan conflicts); seeding from the OS
+        # keeps this race-free across the batch threads.
+        rng = random.Random(int.from_bytes(os.urandom(8), "little"))
+        sched = new_scheduler(factory, self.logger, snapshot, session, rng=rng)
         sched.process_eval(ev)
-
-    # ------------------------------------------------ Planner interface
-
-    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
-        start = time.monotonic()
-        plan.eval_token = self._token
-        # The Nack clock stops while the plan waits in the queue
-        # (plan_endpoint.go:16).
-        try:
-            self.server.eval_pause_nack(self._eval.id, self._token)
-        except ValueError:
-            pass
-        try:
-            result = self.server.plan_submit(plan)
-        finally:
-            try:
-                self.server.eval_resume_nack(self._eval.id, self._token)
-            except ValueError:
-                pass
-        metrics.measure_since(("worker", "submit_plan"), start)
-        if result.refresh_index:
-            # Stale snapshot: catch up and hand back fresh state.
-            self._wait_for_index(result.refresh_index, timeout=5.0)
-            return result, self.server.fsm.state.snapshot()
-        return result, None
-
-    def update_eval(self, ev: Evaluation) -> None:
-        self.server.eval_update([ev])
-
-    def create_eval(self, ev: Evaluation) -> None:
-        ev.snapshot_index = self.server.fsm.state.latest_index()
-        self.server.eval_update([ev])
-
-    def reblock_eval(self, ev: Evaluation) -> None:
-        token = self.server.eval_outstanding(ev.id)
-        if token != self._token:
-            raise ValueError(f"eval {ev.id!r} is not outstanding")
-        ev.snapshot_index = self.server.fsm.state.latest_index()
-        self.server.eval_update([ev], token=self._token)

@@ -1,4 +1,11 @@
-import uuid
+import os
+import threading
+
+# One getrandom() syscall buys 256 ids: the per-call syscall (which
+# also releases the GIL, stalling the scheduler hot loop under
+# contention) showed up as a top sample in control-plane profiles.
+_BATCH_IDS = 256
+_local = threading.local()
 
 
 def generate_uuid() -> str:
@@ -6,5 +13,17 @@ def generate_uuid() -> str:
 
     Same shape as the reference's structs.GenerateUUID
     (reference nomad/structs/structs.go uses crypto/rand hex-8-4-4-4-12).
+    Entropy is drawn in thread-local batches; each id is an independent
+    16-byte slice, so ids stay crypto-random and collision-free across
+    threads and processes.
     """
-    return str(uuid.uuid4())
+    pos = getattr(_local, "pos", 0)
+    if pos == 0:
+        _local.hexbuf = os.urandom(16 * _BATCH_IDS).hex()
+    h = _local.hexbuf
+    off = pos * 32
+    _local.pos = (pos + 1) % _BATCH_IDS
+    return (
+        f"{h[off:off + 8]}-{h[off + 8:off + 12]}-{h[off + 12:off + 16]}"
+        f"-{h[off + 16:off + 20]}-{h[off + 20:off + 32]}"
+    )
