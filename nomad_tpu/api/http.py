@@ -37,13 +37,39 @@ class HTTPError(Exception):
 class RawResponse:
     """Non-JSON reply (file contents for the fs endpoints). A non-None
     index overrides the X-Nomad-Index header (used by cross-region
-    forwarding so the remote region's index is preserved)."""
+    forwarding so the remote region's index is preserved).
 
-    def __init__(self, data: bytes, content_type: str = "application/octet-stream",
-                 index: Optional[int] = None):
+    `stream` (mutually exclusive with `data`) is a callable taking a
+    writable file-like; the reply goes out chunked as the callable
+    writes, so arbitrarily large payloads — the sticky-disk snapshot
+    tar (alloc_dir.go Snapshot streams it in the reference) — never
+    materialize in server memory."""
+
+    def __init__(self, data: bytes = b"",
+                 content_type: str = "application/octet-stream",
+                 index: Optional[int] = None, stream=None):
         self.data = data
         self.content_type = content_type
         self.index = index
+        self.stream = stream
+
+
+class _ChunkedWriter:
+    """Wraps the raw socket file in HTTP/1.1 chunked framing."""
+
+    def __init__(self, wfile):
+        self._w = wfile
+
+    def write(self, data: bytes) -> int:
+        if not data:
+            return 0
+        self._w.write(f"{len(data):x}\r\n".encode())
+        self._w.write(data)
+        self._w.write(b"\r\n")
+        return len(data)
+
+    def finish(self) -> None:
+        self._w.write(b"0\r\n\r\n")
 
 
 class HTTPServer:
@@ -85,19 +111,28 @@ class HTTPServer:
                 metrics.measure_since(("http", "request"), _start)
 
             def _reply(self, status, body, index=None):
+                stream = None
                 if isinstance(body, RawResponse):
-                    data, ctype = body.data, body.content_type
+                    data, ctype, stream = body.data, body.content_type, body.stream
                     if body.index is not None:
                         index = body.index
                 else:
                     data, ctype = json.dumps(body).encode(), "application/json"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
+                if stream is None:
+                    self.send_header("Content-Length", str(len(data)))
+                else:
+                    self.send_header("Transfer-Encoding", "chunked")
                 if index is not None:
                     self.send_header("X-Nomad-Index", str(index))
                 self.end_headers()
-                self.wfile.write(data)
+                if stream is None:
+                    self.wfile.write(data)
+                else:
+                    w = _ChunkedWriter(self.wfile)
+                    stream(w)
+                    w.finish()
 
             do_GET = do_PUT = do_POST = do_DELETE = _dispatch
 
@@ -680,9 +715,10 @@ class HTTPServer:
     def _client_alloc_snapshot(self, method, query, body, alloc_id):
         """Tar archive of the alloc's migratable dirs: the source side
         of sticky-disk migration (client.go:1481 GETs this from the old
-        node; served off the local alloc dir, alloc_dir.go:134)."""
-        data = self._require_client().snapshot_alloc(alloc_id)
-        return RawResponse(data, content_type="application/x-tar")
+        node; streamed chunked off the local alloc dir so a large
+        ephemeral disk never buffers in memory, alloc_dir.go:134)."""
+        fs = self._require_client().fs(alloc_id)
+        return RawResponse(stream=fs.snapshot, content_type="application/x-tar")
 
 
 def _job_stub(job: Job) -> dict:

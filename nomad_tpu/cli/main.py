@@ -722,6 +722,19 @@ def cmd_agent(args) -> int:
         for d in (client_cfg.state_dir, client_cfg.alloc_dir):
             if d:
                 os.makedirs(d, exist_ok=True)
+        client_only = http is None
+        if client_only:
+            # Every agent serves HTTP (agent.go): a client-only node
+            # still exposes its fs/logs/stats endpoints. Started before
+            # the agent so the advertised port is known at registration.
+            http = HTTPServer(None, host=cfg.bind_addr,
+                              port=cfg.ports.http)
+            http.start()
+        # The node must register with a routable HTTP endpoint: peer
+        # clients GET /v1/client/allocation/<id>/snapshot from it for
+        # sticky-disk migration (client.go:1441 migrateRemoteAllocDir);
+        # an empty http_addr makes every remote migration a no-op.
+        client_cfg.http_addr = f"http://{_advertise_addr(cfg)}:{http.port}"
         try:
             client_agent = ClientAgent(client_cfg)
             client_agent.start()
@@ -734,16 +747,10 @@ def cmd_agent(args) -> int:
             if server is not None:
                 server.shutdown()
             return 1
-        if http is None:
-            # Every agent serves HTTP (agent.go): a client-only node
-            # still exposes its fs/logs/stats endpoints.
-            http = HTTPServer(None, host=cfg.bind_addr,
-                              port=cfg.ports.http, client=client_agent)
-            http.start()
+        # fs/stats endpoints are served off the co-located client.
+        http.client = client_agent
+        if client_only:
             print(f"==> nomad-tpu agent started (client)! HTTP: {http.addr}")
-        else:
-            # fs/stats endpoints are served off the co-located client.
-            http.client = client_agent
         print(f"    Client node: {client_agent.node.id}")
 
     # Agent-level consul registration: advertise this agent's HTTP

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 from ..api.client import APIError, Client as APIClient
 from ..structs import Allocation, Node, Resources, consts
 from ..utils.ids import generate_uuid
+from ..utils.pool import WorkPool
 from .alloc_runner import AllocRunner
 from .config import ClientConfig
 from .drivers import DRIVER_REGISTRY
@@ -86,10 +87,21 @@ class ClientAgent:
         # alloc is being waited on / migrated (client.go:153
         # migratingAllocs).
         self._blocked_allocs: Dict[str, Allocation] = {}
+        # Guards _blocked_allocs alone: _release_blocked fires from
+        # runner state-change callbacks, where taking _runners_lock
+        # could deadlock against a runner started under it.
+        self._blocked_lock = threading.Lock()
         self._migrating_allocs: Dict[str, None] = {}
         self._migrate_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Bounded pools replacing per-event thread spawns: remote
+        # migrations can block for minutes (waiting out the previous
+        # alloc), so they get their own pool and can't starve the quick
+        # housekeeping tasks (blocked-alloc release, runner destroy,
+        # executor reaping).
+        self._migrate_pool = WorkPool(8, name="client-migrate")
+        self._task_pool = WorkPool(4, name="client-bg")
         self.heartbeat_ttl = 1.0
 
     # ------------------------------------------------------------------
@@ -134,6 +146,7 @@ class ClientAgent:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        self._sweep_stale_prev_dirs()
         try:
             self.heartbeat_ttl = self.api.nodes.register(self.node)
             self.api.nodes.update_status(self.node.id, consts.NODE_STATUS_READY)
@@ -298,7 +311,7 @@ class ClientAgent:
                 if alloc_id not in pulled_ids:
                     runner = self.alloc_runners.pop(alloc_id)
                     self._remove_alloc_services(alloc_id)
-                    threading.Thread(target=runner.destroy, daemon=True).start()
+                    self._task_pool.submit(runner.destroy)
             for alloc in pulled:
                 runner = self.alloc_runners.get(alloc.id)
                 if runner is not None:
@@ -317,8 +330,14 @@ class ClientAgent:
                 )
                 if prev_runner is not None and not prev_runner.alloc.terminal_status():
                     # Chained to a live local alloc: start when it
-                    # terminates (client.go:1330 blocked queue).
-                    self._blocked_allocs[prev_id] = alloc
+                    # terminates (client.go:1330 blocked queue). The
+                    # terminal transition can land between the check
+                    # above and the insertion — re-check afterwards and
+                    # release ourselves if the event already fired.
+                    with self._blocked_lock:
+                        self._blocked_allocs[prev_id] = alloc
+                    if prev_runner.alloc.terminal_status():
+                        self._release_blocked(prev_id)
                     continue
                 if prev_id and prev_runner is None:
                     # Previous alloc lives on another node: wait for it
@@ -326,10 +345,7 @@ class ClientAgent:
                     # (client.go:1371 blockForRemoteAlloc).
                     with self._migrate_lock:
                         self._migrating_allocs[alloc.id] = None
-                    threading.Thread(
-                        target=self._block_for_remote_alloc, args=(alloc,),
-                        daemon=True, name=f"migrate-{alloc.id[:8]}",
-                    ).start()
+                    self._migrate_pool.submit(self._block_for_remote_alloc, alloc)
                     continue
                 self._add_alloc_locked(
                     alloc, self._sticky_prev_dir(alloc, prev_runner))
@@ -375,54 +391,81 @@ class ClientAgent:
 
     # ------------------------------------------- sticky-disk migration
 
-    def snapshot_alloc(self, alloc_id: str) -> bytes:
-        """Tar of a local alloc's migratable dirs — the payload served
-        at /v1/client/allocation/<id>/snapshot (alloc_dir.go:134)."""
-        return self.fs(alloc_id).snapshot_bytes()
+    def _sweep_stale_prev_dirs(self) -> None:
+        """Remove leftover migration staging dirs (<alloc>.prev[.tmp]).
+        At boot no migration is in flight — any pending one restarts
+        from scratch — so everything matching is garbage from a crash
+        or a mid-stream fetch failure."""
+        import shutil
 
-    def _block_for_remote_alloc(self, alloc: Allocation) -> None:
-        """Wait out a remote previous allocation, pull its sticky disk,
-        then start the replacement (client.go:1371 blockForRemoteAlloc +
-        :1441 migrateRemoteAllocDir)."""
+        try:
+            names = os.listdir(self.config.alloc_dir)
+        except OSError:
+            return
+        for name in names:
+            if name.endswith(".prev") or name.endswith(".prev.tmp"):
+                shutil.rmtree(
+                    os.path.join(self.config.alloc_dir, name),
+                    ignore_errors=True)
+
+    def _block_for_remote_alloc(self, alloc: Allocation, index: int = 0) -> None:
+        """One bounded round of waiting out a remote previous allocation
+        (client.go:1371 blockForRemoteAlloc + :1405 waitForAllocTerminal):
+        a single blocking-query poll; when the previous alloc is
+        terminal, pull its sticky disk and start the replacement. Not
+        yet terminal -> re-submit to the pool tail, so long-lived waits
+        rotate through the bounded pool instead of wedging it (a 9th
+        concurrent migration still makes progress with 8 workers)."""
+        if self._stop.is_set():
+            with self._migrate_lock:
+                self._migrating_allocs.pop(alloc.id, None)
+            return
+        prev_id = alloc.previous_allocation
+        try:
+            prev, new_index = self.api.allocations.info(
+                prev_id, index=index, wait=2.0)
+        except APIError as e:
+            if e.status == 404:
+                self._finish_migration(alloc, None)
+                return
+            self._resubmit_migration(alloc, index, delay=1.0)
+            return
+        except Exception:
+            self._resubmit_migration(alloc, index, delay=1.0)
+            return
+        if prev is not None and not prev.terminal_status():
+            self._resubmit_migration(alloc, max(new_index, index), delay=0.0)
+            return
         prev_dir = None
         try:
-            prev = self._wait_for_alloc_terminal(alloc.previous_allocation)
             if prev is not None:
                 prev_dir = self._migrate_remote_alloc_dir(prev, alloc)
         except Exception:
             self.logger.exception(
-                "migration from remote alloc %s failed",
-                alloc.previous_allocation)
+                "migration from remote alloc %s failed", prev_id)
+        self._finish_migration(alloc, prev_dir)
+
+    def _resubmit_migration(self, alloc: Allocation, index: int,
+                            delay: float) -> None:
+        from ..utils.timer import default_wheel
+
+        if delay > 0:
+            default_wheel().schedule(
+                delay, self._migrate_pool.submit,
+                self._block_for_remote_alloc, alloc, index)
+        else:
+            self._migrate_pool.submit(self._block_for_remote_alloc, alloc, index)
+
+    def _finish_migration(self, alloc: Allocation, prev_dir) -> None:
         if self._stop.is_set():
+            with self._migrate_lock:
+                self._migrating_allocs.pop(alloc.id, None)
             return
         try:
             self._add_alloc(alloc, prev_dir)
         finally:
             with self._migrate_lock:
                 self._migrating_allocs.pop(alloc.id, None)
-
-    def _wait_for_alloc_terminal(self, alloc_id: str):
-        """Blocking-query loop until the alloc is terminal
-        (client.go:1405 waitForAllocTerminal)."""
-        index = 0
-        while not self._stop.is_set():
-            try:
-                prev, new_index = self.api.allocations.info(
-                    alloc_id, index=index, wait=2.0)
-            except APIError as e:
-                if e.status == 404:
-                    return None
-                if self._stop.wait(1.0):
-                    return None
-                continue
-            except Exception:
-                if self._stop.wait(1.0):
-                    return None
-                continue
-            if prev is None or prev.terminal_status():
-                return prev
-            index = max(new_index, index)
-        return None
 
     def _migrate_remote_alloc_dir(self, prev: Allocation, alloc: Allocation):
         """Fetch the previous alloc's snapshot tar from its node's HTTP
@@ -443,14 +486,31 @@ class ClientAgent:
                 prev.id, prev.node_id)
             return None
         url = f"{node.http_addr}/v1/client/allocation/{prev.id}/snapshot"
+        import shutil
         import urllib.request
 
-        with urllib.request.urlopen(url, timeout=60.0) as resp:
-            data = resp.read()
         dest = os.path.join(self.config.alloc_dir, f"{alloc.id}.prev")
+        tmp = dest + ".tmp"
         from .allocdir import AllocDir
 
-        return AllocDir.restore_snapshot(data, dest)
+        # The response feeds the tar reader incrementally (stream mode)
+        # so a large ephemeral disk never materializes in client memory
+        # on either end (the source streams chunked too). Unpack into a
+        # staging dir and rename on success: a mid-stream failure (the
+        # source truncating the chunked reply, the 60s timeout) must not
+        # leave a partial .prev dir that move() would half-adopt — and
+        # cleanup here (plus the boot sweep) keeps failures from leaking
+        # gigabytes of ephemeral disk.
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            with urllib.request.urlopen(url, timeout=60.0) as resp:
+                AllocDir.restore_snapshot_stream(resp, tmp)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        shutil.rmtree(dest, ignore_errors=True)
+        os.rename(tmp, dest)
+        return AllocDir.from_existing(dest)
 
     def _kill_restored_handles(self, alloc_id: str) -> None:
         handles = self._restored_handles.pop(alloc_id, None) or {}
@@ -470,9 +530,7 @@ class ClientAgent:
 
         # Off-thread: reattach probes can block seconds and this is
         # called while _runners_lock is held.
-        threading.Thread(
-            target=reap, daemon=True, name=f"reap-{alloc_id[:8]}"
-        ).start()
+        self._task_pool.submit(reap)
 
     def _template_kv(self, path: str):
         """KV source for {{ key "..." }} templates: consul KV when an
@@ -498,7 +556,8 @@ class ClientAgent:
         """A local alloc went terminal: start any replacement that was
         queued behind it, handing over its sticky disk
         (client.go:1067-1079 blocked-allocation handoff)."""
-        blocked = self._blocked_allocs.pop(prev_id, None)
+        with self._blocked_lock:
+            blocked = self._blocked_allocs.pop(prev_id, None)
         if blocked is None:
             return
 
@@ -510,9 +569,7 @@ class ClientAgent:
 
         # Off the state-change callback thread: runner start touches
         # _runners_lock and may do filesystem renames.
-        threading.Thread(
-            target=_start, daemon=True, name=f"unblock-{blocked.id[:8]}"
-        ).start()
+        self._task_pool.submit(_start)
 
     # ------------------------------------------------ consul services
 

@@ -8,7 +8,6 @@ the sticky-disk migration pair Snapshot:134 / Move:194.
 
 from __future__ import annotations
 
-import io
 import os
 import shutil
 import stat
@@ -74,20 +73,18 @@ class AllocDir:
                         rel = os.path.relpath(full, self.root)
                         tw.add(full, arcname=rel, recursive=False)
 
-    def snapshot_bytes(self) -> bytes:
-        buf = io.BytesIO()
-        self.snapshot(buf)
-        return buf.getvalue()
-
     @staticmethod
-    def restore_snapshot(data: bytes, dest_root: str) -> "AllocDir":
+    def restore_snapshot_stream(fileobj, dest_root: str) -> "AllocDir":
         """Unpack a snapshot() archive into `dest_root`, producing a
         previous-alloc dir that move() can consume (the untar loop of
-        client.go:1489-1529). Member paths are validated against the
-        destination root (the reference trusts its peer; we don't)."""
+        client.go:1489-1529). Reads `fileobj` incrementally (tar stream
+        mode), so a large ephemeral disk never materializes in memory —
+        the reference streams too (alloc_dir.go Snapshot). Member paths
+        are validated against the destination root (the reference trusts
+        its peer; we don't)."""
         os.makedirs(dest_root, exist_ok=True)
         dest = os.path.normpath(dest_root)
-        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tr:
+        with tarfile.open(fileobj=fileobj, mode="r|") as tr:
             for member in tr:
                 if not (member.isreg() or member.isdir()):
                     continue
@@ -102,12 +99,18 @@ class AllocDir:
                     src = tr.extractfile(member)
                     with open(full, "wb") as out:
                         shutil.copyfileobj(src, out)
-        prev = AllocDir(dest_root)
-        for name in os.listdir(dest_root):
+        return AllocDir.from_existing(dest_root)
+
+    @staticmethod
+    def from_existing(root: str) -> "AllocDir":
+        """Wrap an on-disk previous-alloc dir: non-shared top-level dirs
+        are task dirs (the inverse of snapshot()'s relative layout)."""
+        prev = AllocDir(root)
+        for name in os.listdir(root):
             if name != SHARED_ALLOC_NAME and os.path.isdir(
-                os.path.join(dest_root, name)
+                os.path.join(root, name)
             ):
-                prev.task_dirs[name] = os.path.join(dest_root, name)
+                prev.task_dirs[name] = os.path.join(root, name)
         return prev
 
     def move(self, other: "AllocDir", task_names: List[str]) -> None:
@@ -118,14 +121,23 @@ class AllocDir:
         data_dir = os.path.join(self.shared_dir, "data")
         if os.path.isdir(other_data):
             shutil.rmtree(data_dir, ignore_errors=True)
-            os.rename(other_data, data_dir)
+            try:
+                os.rename(other_data, data_dir)
+            except FileNotFoundError:
+                # Source destroyed between the isdir check and the
+                # rename (previous runner GC racing the handoff):
+                # migration is best-effort, start with a fresh dir.
+                os.makedirs(data_dir, exist_ok=True)
         for name in task_names:
             other_local = os.path.join(other.root, name, TASK_LOCAL)
             mine = self.task_dirs.get(name)
             if mine and os.path.isdir(other_local):
                 local = os.path.join(mine, TASK_LOCAL)
                 shutil.rmtree(local, ignore_errors=True)
-                os.rename(other_local, local)
+                try:
+                    os.rename(other_local, local)
+                except FileNotFoundError:
+                    os.makedirs(local, exist_ok=True)
 
     # ------------------------------ file APIs (HTTP fs endpoints) -----
 

@@ -81,8 +81,21 @@ class ExecutorHandle(DriverHandle):
         self.state_path = state_path
         self.executor_pid = executor_pid
         self.child_pid = child_pid
+        # Persistent connection for the wait loop ONLY. Control calls
+        # (kill/signal/stats) use their own connections: wait RPCs block
+        # up to max_kill_timeout holding the connection lock, and a
+        # kill() queued behind one would wait out the very timeout it is
+        # supposed to cut short (the executor serves connections
+        # concurrently — ThreadingUnixStreamServer).
         self._client = ExecutorClient(sock_path)
         self._result: Optional[WaitResult] = None
+
+    def _oneshot(self, method: str, *, _timeout: Optional[float], **kw) -> dict:
+        client = ExecutorClient(self.sock_path)
+        try:
+            return client.call(method, _timeout=_timeout, **kw)
+        finally:
+            client.close()
 
     # -- identity ------------------------------------------------------
 
@@ -180,9 +193,9 @@ class ExecutorHandle(DriverHandle):
 
     def kill(self, kill_timeout: float = 5.0) -> None:
         try:
-            self._client.call("kill", timeout=kill_timeout,
-                              _timeout=kill_timeout + 10.0)
-            self._client.call("shutdown", _timeout=5.0)
+            self._oneshot("kill", timeout=kill_timeout,
+                          _timeout=kill_timeout + 10.0)
+            self._oneshot("shutdown", _timeout=5.0)
         except (OSError, ValueError, ConnectionError):
             # RPC unavailable. If the task's exit is already on record
             # there is nothing to kill — signalling the stored pids
@@ -211,11 +224,11 @@ class ExecutorHandle(DriverHandle):
                     pass
 
     def signal(self, signum: int) -> None:
-        self._client.call("signal", signum=signum, _timeout=10.0)
+        self._oneshot("signal", signum=signum, _timeout=10.0)
 
     def stats(self) -> dict:
         try:
-            return self._client.call("stats", _timeout=5.0)
+            return self._oneshot("stats", _timeout=5.0)
         except (OSError, ValueError, ConnectionError):
             return {}
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -76,7 +77,7 @@ class PlacementBatcher:
         self._lock = threading.Lock()
         self._queues: Dict[Tuple, List[_Request]] = {}
         self._dispatcher_live: Dict[Tuple, bool] = {}
-        self._device_bases: "Dict[object, tuple]" = {}  # token -> device arrays
+        self._device_bases: "OrderedDict[object, tuple]" = OrderedDict()  # token -> device arrays
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
         self.base_uploads = 0  # cluster-base host->device transfers
@@ -122,12 +123,16 @@ class PlacementBatcher:
 
         with self._lock:
             cached = self._device_bases.get(token)
+            if cached is not None:
+                # True LRU: a hit refreshes recency, so alternating hot
+                # snapshots don't thrash the eviction order.
+                self._device_bases.move_to_end(token)
         if cached is not None:
             return cached
         dev = tuple(jax.device_put(np.asarray(x)) for x in base)
         with self._lock:
             while len(self._device_bases) >= DEVICE_BASE_CACHE:
-                self._device_bases.pop(next(iter(self._device_bases)))
+                self._device_bases.popitem(last=False)
             self._device_bases[token] = dev
         self.base_uploads += 1
         return dev
@@ -142,7 +147,12 @@ class PlacementBatcher:
             placement_program_jit,
         )
 
-        if len(batch) == 1:
+        if len(batch) == 1 and batch[0].token is None:
+            # Unshared lone request: nothing cacheable, dispatch as-is.
+            # Token-carrying lone requests fall through to the overlay
+            # path below (B=1): the trickle regime — one eval at a time
+            # against a stable snapshot — is exactly where re-uploading
+            # the full [N,4] base every dispatch hurt most.
             req = batch[0]
             choices, scores, _ = placement_program_jit(
                 req.full_state(), req.asks, req.key, config)

@@ -14,7 +14,10 @@ import threading
 from typing import Dict
 
 from ..structs import consts
+from ..utils.pool import WorkPool
 from ..utils.timer import default_wheel
+
+INVALIDATE_WORKERS = 8
 
 
 class HeartbeatTimers:
@@ -25,6 +28,11 @@ class HeartbeatTimers:
         self._wheel = default_wheel()  # one thread for ALL node TTLs
         self._timers: Dict[str, object] = {}
         self._enabled = False
+        # Invalidation does a raft apply, which can block for a leader
+        # term; running it on the wheel's dispatch pool would let a
+        # drain storm head-of-line-block broker nack timers. A private
+        # bounded pool absorbs the storm instead.
+        self._invalidate_pool = WorkPool(INVALIDATE_WORKERS, name="hb-invalidate")
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -71,10 +79,21 @@ class HeartbeatTimers:
 
     def _invalidate(self, node_id: str) -> None:
         """TTL expired without a heartbeat: node is down
-        (heartbeat.go:84 invalidateHeartbeat)."""
+        (heartbeat.go:84 invalidateHeartbeat). Runs on the wheel's
+        dispatch pool — only bookkeeping here; the raft apply moves to
+        the private pool."""
         with self._lock:
             self._timers.pop(node_id, None)
             if not self._enabled:
+                return
+        self._invalidate_pool.submit(self._apply_down, node_id)
+
+    def _apply_down(self, node_id: str) -> None:
+        # The apply may have sat queued behind raft-blocked workers for
+        # a while: if the node heartbeated meanwhile (timer re-armed) or
+        # leadership was lost, downing it now would be spurious.
+        with self._lock:
+            if not self._enabled or node_id in self._timers:
                 return
         self.logger.warning("node %s TTL expired, marking down", node_id)
         try:

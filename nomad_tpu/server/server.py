@@ -27,6 +27,7 @@ from ..structs import (
     new_eval,
 )
 from ..utils.ids import generate_uuid
+from ..utils.pool import WorkPool
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import EvalBroker
@@ -59,6 +60,18 @@ class Server:
         self.heartbeats = HeartbeatTimers(self)
         self.periodic = PeriodicDispatch(self)
         self.workers: List[Worker] = []
+        # Shared pool for drain-to-batch eval processing: batch members
+        # must run concurrently (the batcher coalesces their blocked
+        # place() calls into one device dispatch) but thread-per-eval at
+        # storm rates is churn — a fixed ceiling of persistent daemon
+        # workers serves every Worker's batches. Sized so every worker's
+        # full drain fits at once: a dequeued eval queued behind other
+        # workers' batches would hold its broker lease past the nack
+        # clock and miss its batch's dispatch window.
+        self.eval_pool = WorkPool(
+            max(2, min(64, self.config.num_schedulers
+                       * max(1, self.config.eval_batch_size - 1))),
+            name="eval-batch")
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []

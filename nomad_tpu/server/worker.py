@@ -152,17 +152,16 @@ class Worker:
                 self._process_eval(ev, token)
             else:
                 metrics.add_sample(("worker", "eval_batch"), len(group))
-                threads = [
-                    threading.Thread(
-                        target=self._process_eval, args=(e, t),
-                        name=f"worker-{self.id}-batch", daemon=True)
+                # Batch members run concurrently on the server's shared
+                # bounded pool (their place() calls coalesce in the
+                # batcher); the worker thread takes the first itself.
+                futures = [
+                    self.server.eval_pool.submit(self._process_eval, e, t)
                     for e, t in group[1:]
                 ]
-                for t in threads:
-                    t.start()
                 self._process_eval(ev, token)
-                for t in threads:
-                    t.join()
+                for f in futures:
+                    f.wait()
 
     def _process_eval(self, ev: Evaluation, token: str) -> None:
         start = time.monotonic()

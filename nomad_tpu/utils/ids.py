@@ -8,6 +8,17 @@ _BATCH_IDS = 256
 _local = threading.local()
 
 
+def _reset_after_fork() -> None:
+    # A forked child inherits the surviving thread's hexbuf/pos and
+    # would replay up to 255 of the parent's upcoming ids — colliding
+    # eval/alloc ids across processes. Force a fresh urandom draw.
+    _local.pos = 0
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)
+
+
 def generate_uuid() -> str:
     """Random identifier for jobs-internal objects (allocs, evals, nodes).
 

@@ -1,12 +1,22 @@
-"""Shared timer wheel: many logical timers on one thread.
+"""Shared timer wheel: many logical timers on one firing thread.
 
 The reference leans on Go's runtime timers, which are cheap (a heap
-inside the scheduler, no thread per timer). Python's threading.Timer
-spawns a whole OS thread per timer — at hundreds of eval dequeues per
-second (one nack timer each, eval_broker.go:365) plus one heartbeat TTL
-timer per node (heartbeat.go:14, 10k+ nodes), that's untenable. This
-wheel gives the Go cost model: schedule/cancel are O(log n) heap ops
-and every callback runs on one shared daemon thread.
+inside the scheduler, no thread per timer) and fire each callback on
+its own goroutine. Python's threading.Timer spawns a whole OS thread
+per timer — at hundreds of eval dequeues per second (one nack timer
+each, eval_broker.go:365) plus one heartbeat TTL timer per node
+(heartbeat.go:14, 10k+ nodes), that's untenable. This wheel gives the
+Go cost model: schedule/cancel are O(log n) heap ops on one shared
+firing thread.
+
+Callback execution is decoupled from firing: the firing thread only
+pops due handles and hands them to a small bounded WorkPool, so one
+slow callback (a heartbeat expiry doing a raft apply during leader
+loss) cannot delay every other timer in the process — Go's
+run-on-own-goroutine property, at bounded thread cost. Known-slow
+callbacks should still offload their heavy part to their own pool
+(server/heartbeat.py) so a storm of them cannot occupy all dispatch
+workers and head-of-line-block fast timers like broker nacks.
 
 Cancellation is a flag check at fire time; a cancelled handle's entry
 just drains out of the heap. Callbacks run outside the wheel lock, so
@@ -23,7 +33,11 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from .pool import WorkPool
+
 logger = logging.getLogger("nomad_tpu.timer")
+
+DISPATCH_WORKERS = 4
 
 
 class TimerHandle:
@@ -41,12 +55,14 @@ class TimerHandle:
 
 
 class TimerWheel:
-    def __init__(self, name: str = "timer-wheel"):
+    def __init__(self, name: str = "timer-wheel",
+                 dispatch_workers: int = DISPATCH_WORKERS):
         self._name = name
         self._cond = threading.Condition()
         self._heap: List[Tuple[float, int, TimerHandle]] = []
         self._counter = itertools.count()
         self._thread: Optional[threading.Thread] = None
+        self._pool = WorkPool(dispatch_workers, name=f"{name}-cb")
 
     def schedule(self, delay: float, fn: Callable, *args) -> TimerHandle:
         handle = TimerHandle(fn, args)
@@ -80,10 +96,18 @@ class TimerWheel:
                     self._cond.wait(timeout)
             if handle.cancelled:
                 continue
-            try:
-                handle.fn(*handle.args)
-            except Exception:  # noqa: BLE001 - one bad timer can't kill the wheel
-                logger.exception("timer callback failed")
+            # Hand off: the firing thread never runs user code, so a
+            # blocked callback cannot make other timers fire late.
+            self._pool.submit(self._fire, handle)
+
+    @staticmethod
+    def _fire(handle: TimerHandle) -> None:
+        if handle.cancelled:
+            return
+        try:
+            handle.fn(*handle.args)
+        except Exception:  # noqa: BLE001 - one bad timer can't kill the wheel
+            logger.exception("timer callback failed")
 
     def pending(self) -> int:
         with self._cond:

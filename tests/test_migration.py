@@ -1,0 +1,203 @@
+"""Sticky-disk migration end-to-end (reference:
+client/client.go:1371-1505 blockForRemoteAlloc + migrateRemoteAllocDir,
+allocdir/alloc_dir.go:134 Snapshot / :194 Move):
+
+- remote migration: drain node 1, the replacement on node 2 pulls the
+  previous alloc's snapshot tar over the peer's HTTP API and adopts it;
+- local blocked-alloc handoff: a destructive update's replacement waits
+  for the old alloc to terminate, then adopts its sticky disk by rename;
+- node-down refusal: a lost node's data is NOT fetched — the
+  replacement starts with a fresh disk.
+"""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import HTTPServer
+from nomad_tpu.client import ClientAgent, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# Writes its alloc id into the sticky disk only if no previous tenant
+# did — so the file's content proves whose disk the task inherited.
+STICKY_CMD = (
+    '[ -f "$NOMAD_TASK_DIR/data.txt" ] || '
+    'echo "$NOMAD_ALLOC_ID" > "$NOMAD_TASK_DIR/data.txt"; sleep 600'
+)
+
+
+def sticky_job(migrate=True):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.ephemeral_disk.sticky = True
+    tg.ephemeral_disk.migrate = migrate
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", STICKY_CMD]}
+    task.resources.networks = []
+    return job
+
+
+def start_agent(server_addr, tmp_path, name):
+    """Client agent + its own HTTP endpoint (every agent serves HTTP in
+    the reference, agent.go — the snapshot GET rides it)."""
+    http = HTTPServer(None)
+    http.start()
+    cfg = ClientConfig(
+        servers=[server_addr],
+        state_dir=str(tmp_path / f"{name}-state"),
+        alloc_dir=str(tmp_path / f"{name}-allocs"),
+        options={"driver.raw_exec.enable": "1"},
+        http_addr=http.addr,
+        dev_mode=True,
+    )
+    os.makedirs(cfg.state_dir, exist_ok=True)
+    agent = ClientAgent(cfg)
+    http.client = agent
+    agent.start()
+    return agent, http
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1, eval_nack_timeout=5.0))
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    started = []
+
+    def spawn(name):
+        agent, ahttp = start_agent(http.addr, tmp_path, name)
+        started.append((agent, ahttp))
+        return agent
+
+    yield server, spawn
+    for agent, ahttp in started:
+        agent.shutdown(destroy_allocs=True)
+        ahttp.stop()
+    http.stop()
+    server.shutdown()
+
+
+def running_alloc(server, job_id, exclude=()):
+    for a in server.fsm.state.allocs_by_job(job_id):
+        if a.id not in exclude and a.client_status == consts.ALLOC_CLIENT_RUNNING:
+            return a
+    return None
+
+
+def read_sticky(agent, alloc_id):
+    runner = agent.alloc_runners.get(alloc_id)
+    if runner is None:
+        return None
+    try:
+        return runner.alloc_dir.read_at("web/local/data.txt").decode().strip()
+    except (FileNotFoundError, PermissionError, OSError):
+        return None
+
+
+def test_remote_migration_on_drain(cluster):
+    server, spawn = cluster
+    agent1 = spawn("n1")
+    job = sticky_job(migrate=True)
+    server.job_register(job)
+    assert wait_until(lambda: running_alloc(server, job.id) is not None)
+    alloc1 = running_alloc(server, job.id)
+    assert alloc1.node_id == agent1.node.id
+    assert wait_until(lambda: read_sticky(agent1, alloc1.id) == alloc1.id)
+
+    agent2 = spawn("n2")
+    assert wait_until(
+        lambda: server.fsm.state.node_by_id(agent2.node.id) is not None
+        and server.fsm.state.node_by_id(agent2.node.id).status
+        == consts.NODE_STATUS_READY
+    )
+    server.node_update_drain(agent1.node.id, True)
+
+    # Replacement lands on node 2, chained to alloc1, and the file
+    # written by alloc1 arrives with the migrated sticky disk.
+    assert wait_until(
+        lambda: running_alloc(server, job.id, exclude={alloc1.id}) is not None,
+        timeout=30.0,
+    )
+    alloc2 = running_alloc(server, job.id, exclude={alloc1.id})
+    assert alloc2.node_id == agent2.node.id
+    assert alloc2.previous_allocation == alloc1.id
+    assert wait_until(lambda: read_sticky(agent2, alloc2.id) == alloc1.id,
+                      timeout=30.0)
+
+
+def test_local_blocked_alloc_handoff(cluster):
+    """Destructive in-node update: the replacement waits for the old
+    alloc to terminate (blocked queue, client.go:1330) then adopts the
+    sticky disk by rename — no HTTP fetch on the local path."""
+    server, spawn = cluster
+    agent = spawn("n1")
+    job = sticky_job(migrate=False)  # sticky alone suffices locally
+    server.job_register(job)
+    assert wait_until(lambda: running_alloc(server, job.id) is not None)
+    alloc1 = running_alloc(server, job.id)
+    assert wait_until(lambda: read_sticky(agent, alloc1.id) == alloc1.id)
+
+    # Destructive update (env change forces replacement, util.go:332
+    # tasksUpdated).
+    job2 = sticky_job(migrate=False)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"V": "2"}
+    server.job_register(job2)
+
+    assert wait_until(
+        lambda: running_alloc(server, job.id, exclude={alloc1.id}) is not None,
+        timeout=30.0,
+    )
+    alloc2 = running_alloc(server, job.id, exclude={alloc1.id})
+    assert alloc2.node_id == agent.node.id
+    assert wait_until(lambda: read_sticky(agent, alloc2.id) == alloc1.id,
+                      timeout=30.0)
+
+
+def test_node_down_refuses_migration(cluster):
+    """The previous node is DOWN: its disk is unreachable; the
+    replacement must start fresh rather than hang or fetch garbage
+    (client.go:1449 node-down check)."""
+    server, spawn = cluster
+    agent1 = spawn("n1")
+    job = sticky_job(migrate=True)
+    server.job_register(job)
+    assert wait_until(lambda: running_alloc(server, job.id) is not None)
+    alloc1 = running_alloc(server, job.id)
+    assert wait_until(lambda: read_sticky(agent1, alloc1.id) == alloc1.id)
+
+    agent2 = spawn("n2")
+    assert wait_until(
+        lambda: server.fsm.state.node_by_id(agent2.node.id) is not None
+        and server.fsm.state.node_by_id(agent2.node.id).status
+        == consts.NODE_STATUS_READY
+    )
+    # Kill node 1 without draining: stop its heartbeats, mark it down.
+    agent1.shutdown(destroy_allocs=False)
+    server.node_update_status(agent1.node.id, consts.NODE_STATUS_DOWN)
+
+    assert wait_until(
+        lambda: running_alloc(server, job.id, exclude={alloc1.id}) is not None,
+        timeout=30.0,
+    )
+    alloc2 = running_alloc(server, job.id, exclude={alloc1.id})
+    assert alloc2.node_id == agent2.node.id
+    # Fresh disk: the file carries alloc2's own id, not alloc1's.
+    assert wait_until(lambda: read_sticky(agent2, alloc2.id) == alloc2.id,
+                      timeout=30.0)
