@@ -623,6 +623,9 @@ def cmd_agent(args) -> int:
             server_cfg.node_gc_threshold = node_gc_threshold
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
+        if cfg.vault.address:
+            server_cfg.vault_addr = cfg.vault.address
+            server_cfg.vault_token = cfg.vault.token
         server = Server(server_cfg)
         # bootstrap_expect > 1: real raft consensus over TCP; the
         # cluster forms once enough servers gossip a raft address

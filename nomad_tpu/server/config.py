@@ -38,6 +38,14 @@ class ServerConfig:
     # single dequeue, eval_broker.go:259). 1 disables batching.
     eval_batch_size: int = 16
 
+    # Latency-aware routing: a dense factory only pays off when the
+    # device dispatch amortizes over a batch; a lone interactive eval
+    # would eat the full batch-window + dispatch latency for nothing.
+    # Drained groups smaller than this run on the host (CPU iterator)
+    # factory instead — same placement semantics (CPU/TPU parity is a
+    # test invariant), millisecond latency. 1 forces dense always.
+    dense_min_batch: int = 2
+
     # Telemetry gauge emission period (command.go:570 setupTelemetry)
     telemetry_interval: float = 10.0
     statsd_addr: str = ""
@@ -61,8 +69,13 @@ class ServerConfig:
     # Blocked-evals failed-eval unblock cadence (leader.go:441).
     failed_eval_unblock_interval: float = 60.0
 
-    # Vault token authority (nomad/vault.go; stub provider in-process).
+    # Vault token authority (nomad/vault.go). With vault_addr set the
+    # server talks to a real Vault over HTTP using vault_token as its
+    # own token (renewed at half-life); otherwise an in-process stub
+    # keeps the derive→renew→revoke lifecycle working vault-less.
     vault_enabled: bool = True
+    vault_addr: str = ""
+    vault_token: str = ""
     vault_token_ttl: float = 3600.0
     # None = any policy except root; else an allowlist.
     vault_allowed_policies: Optional[List[str]] = None

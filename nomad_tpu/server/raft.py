@@ -16,9 +16,15 @@ replayed on restart, the FSM snapshots every `snapshot_threshold`
 applies (retained files, log truncated), and followers too far behind
 the compacted log receive an InstallSnapshot RPC.
 
-Not implemented (acceptable for the capability target): dynamic
-membership change — the peer set is fixed when the node starts
-(bootstrap_expect semantics; see server.setup_raft_cluster).
+Dynamic membership (reference: hashicorp/raft AddPeer/RemovePeer driven
+by serf events, nomad/leader.go:551 addRaftPeer / :577 removeRaftPeer):
+single-server configuration-change entries (`_raft.config`) carrying
+the full member set. A configuration becomes ACTIVE when appended (the
+dissertation's §4.1 rule — commitment is counted under the latest
+appended config), one change may be in flight at a time, truncation
+reverts to the previous config in the log, and snapshots embed the
+member set so a restarted or far-behind node recovers membership with
+its FSM.
 """
 
 from __future__ import annotations
@@ -63,6 +69,7 @@ class NotLeaderError(Exception):
 
 
 NOOP_TYPE = "_raft.noop"  # leadership barrier entry; never hits the FSM
+CONFIG_TYPE = "_raft.config"  # membership change; payload {"peers": [...]}
 
 
 class Transport:
@@ -141,6 +148,12 @@ class RaftNode:
     ):
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
+        # Membership: seed config until a _raft.config entry or a
+        # config-carrying snapshot overrides it. `removed` parks this
+        # node (no campaigning) once a config excludes it.
+        self._seed_peers = list(self.peers)
+        self._snapshot_peers: Optional[List[str]] = None
+        self.removed = False
         self.transport = transport
         self.fsm_apply = fsm_apply
         self.on_leadership = on_leadership
@@ -225,6 +238,73 @@ class RaftNode:
         if was_leader:
             self.on_leadership(False)  # dispatcher stopped; call direct
 
+    # ------------------------------------------------------ membership
+
+    @staticmethod
+    def _wrap_snapshot(data, peers: List[str]) -> dict:
+        """Snapshots carry the member set so membership survives
+        compaction/restart/InstallSnapshot alongside the FSM."""
+        return {"__raft_fsm__": data, "__raft_peers__": sorted(peers)}
+
+    @staticmethod
+    def _unwrap_snapshot(blob) -> Tuple[Any, Optional[List[str]]]:
+        if isinstance(blob, dict) and "__raft_fsm__" in blob:
+            return blob["__raft_fsm__"], list(blob.get("__raft_peers__") or [])
+        return blob, None  # legacy snapshot without a config
+
+    def _members_locked(self) -> List[str]:
+        return sorted(set(self.peers) | {self.node_id})
+
+    def _activate_config_locked(self, members: List[str]) -> None:
+        """A configuration takes effect as soon as it is appended (the
+        single-server-change rule): votes and commit quorums count under
+        the newest config in the log."""
+        self.peers = [m for m in members if m != self.node_id]
+        self.removed = self.node_id not in members
+        if self.state == LEADER:
+            nxt = self._last_log_index() + 1
+            for p in self.peers:
+                self.next_index.setdefault(p, nxt)
+                self.match_index.setdefault(p, 0)
+            for p in list(self.next_index):
+                if p not in self.peers:
+                    self.next_index.pop(p, None)
+                    self.match_index.pop(p, None)
+        self.logger.info("raft config active: %s", members)
+
+    def _recompute_config_locked(self) -> None:
+        """After truncation or restore: the active config is the last
+        _raft.config entry in the log, else the snapshot's, else the
+        seed peer set."""
+        for entry in reversed(self.log):
+            if entry.msg_type == CONFIG_TYPE:
+                self._activate_config_locked(list(entry.payload["peers"]))
+                return
+        if self._snapshot_peers is not None:
+            self._activate_config_locked(list(self._snapshot_peers))
+            return
+        self._activate_config_locked(
+            sorted(set(self._seed_peers) | {self.node_id}))
+
+    def _uncommitted_config_locked(self) -> bool:
+        return any(
+            e.msg_type == CONFIG_TYPE and e.index > self.commit_index
+            for e in self.log
+        )
+
+    def _config_at_locked(self, index: int) -> List[str]:
+        """Member set as of log position `index`: the last config entry
+        at or below it, else the previous snapshot's, else the seed.
+        Snapshots must embed THIS (not the active config): an active
+        config past `index` may still be uncommitted, and persisting it
+        would resurrect a truncated change after restart."""
+        for entry in reversed(self.log):
+            if entry.msg_type == CONFIG_TYPE and entry.index <= index:
+                return list(entry.payload["peers"])
+        if self._snapshot_peers is not None:
+            return list(self._snapshot_peers)
+        return sorted(set(self._seed_peers) | {self.node_id})
+
     # ----------------------------------------------------- persistence
 
     def _restore_from_storage(self) -> None:
@@ -233,9 +313,11 @@ class RaftNode:
         self.current_term, self.voted_for = self.storage.load_meta()
         snap = self.storage.load_latest_snapshot()
         if snap is not None:
-            index, term, data = snap
+            index, term, blob = snap
+            data, peers = self._unwrap_snapshot(blob)
             if self.fsm_restore is not None:
                 self.fsm_restore(data)
+            self._snapshot_peers = peers
             self.log_offset = index
             self.snapshot_term = term
             self.commit_index = index
@@ -250,6 +332,9 @@ class RaftNode:
                 break
             self.log.append(e)
             expect += 1
+        if snap is not None or any(
+                e.msg_type == CONFIG_TYPE for e in self.log):
+            self._recompute_config_locked()
         if self.log or snap is not None:
             self.logger.info(
                 "restored raft state: snapshot@%d + %d log entries",
@@ -284,6 +369,13 @@ class RaftNode:
     def handle_request_vote(self, args: dict) -> dict:
         with self._lock:
             term = args["term"]
+            if args["candidate_id"] not in set(self.peers) | {self.node_id}:
+                # Non-member candidate (a removed server timing out —
+                # the leader stops replicating to it at removal, so it
+                # never learns): deny WITHOUT adopting its term, or its
+                # election timeouts would depose the live leader
+                # (dissertation §4.2.2 disruption problem).
+                return {"term": self.current_term, "vote_granted": False}
             if term < self.current_term:
                 return {"term": self.current_term, "vote_granted": False}
             if term > self.current_term:
@@ -340,6 +432,11 @@ class RaftNode:
                 else:
                     for entry in appended:
                         self.storage.append_entry(entry)
+            if truncated or any(
+                    e.msg_type == CONFIG_TYPE for e in appended):
+                # Config entries activate on append; a truncation may
+                # have removed one, reverting to the prior config.
+                self._recompute_config_locked()
 
             if args["leader_commit"] > self.commit_index:
                 self.commit_index = min(
@@ -361,8 +458,10 @@ class RaftNode:
             last_index = args["last_index"]
             if last_index <= self.log_offset:
                 return {"term": self.current_term}  # already have it
+            data, peers = self._unwrap_snapshot(args["data"])
             if self.fsm_restore is not None:
-                self.fsm_restore(args["data"])
+                self.fsm_restore(data)
+            self._snapshot_peers = peers
             self.log = []
             self.log_offset = last_index
             self.snapshot_term = args["last_term"]
@@ -370,6 +469,8 @@ class RaftNode:
             self.last_applied = last_index
             self._latest_snapshot = (last_index, args["last_term"],
                                      args["data"])
+            if peers is not None:
+                self._recompute_config_locked()
             if self.storage is not None:
                 self.storage.save_snapshot(last_index, args["last_term"],
                                            args["data"])
@@ -395,6 +496,11 @@ class RaftNode:
             time.sleep(0.02)
             with self._lock:
                 if self.state == LEADER:
+                    continue
+                if self.removed:
+                    # Excluded by the active config: never campaign (a
+                    # removed node bumping terms would disrupt the
+                    # cluster it was removed from).
                     continue
                 if time.monotonic() < self._election_deadline:
                     continue
@@ -563,19 +669,26 @@ class RaftNode:
                 if leader is None:
                     raise NotLeaderError(None)
                 forward = True
+                index = waiter = None
             else:
                 forward = False
-                index = self._last_log_index() + 1
-                term = self.current_term
-                entry = LogEntry(term, index, msg_type, payload)
-                self.log.append(entry)
-                if self.storage is not None:
-                    self.storage.append_entry(entry)
-                waiter = _ApplyWaiter()
-                self._apply_waiters[index] = (term, waiter)
+                index, waiter = self._leader_append_locked(msg_type, payload)
         if forward:
             return self.transport.forward_apply(leader, msg_type, payload)
+        return self._wait_commit(index, waiter)
 
+    def _leader_append_locked(self, msg_type: str, payload: Any):
+        index = self._last_log_index() + 1
+        term = self.current_term
+        entry = LogEntry(term, index, msg_type, payload)
+        self.log.append(entry)
+        if self.storage is not None:
+            self.storage.append_entry(entry)
+        waiter = _ApplyWaiter()
+        self._apply_waiters[index] = (term, waiter)
+        return index, waiter
+
+    def _wait_commit(self, index: int, waiter: "_ApplyWaiter") -> int:
         # Actively drive replication while waiting: a dropped round
         # otherwise stalls the commit until the next heartbeat tick.
         deadline = time.monotonic() + APPLY_TIMEOUT
@@ -591,6 +704,44 @@ class RaftNode:
             raise NotLeaderError(self.leader_id)
         return index
 
+    # --------------------------------------------- membership change API
+
+    def add_peer(self, peer_id: str) -> None:
+        """Leader-only: add a server to the cluster (leader.go:551
+        addRaftPeer). No-op if already a member."""
+        self._change_config(add=peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        """Leader-only: remove a server (leader.go:577 removeRaftPeer).
+        No-op if not a member."""
+        self._change_config(remove=peer_id)
+
+    def _change_config(self, add: Optional[str] = None,
+                       remove: Optional[str] = None) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            if remove == self.node_id:
+                raise ValueError(
+                    "cannot remove the leader; transfer leadership first")
+            if self._uncommitted_config_locked():
+                raise ValueError("configuration change already in progress")
+            members = set(self.peers) | {self.node_id}
+            if add is not None:
+                if add in members:
+                    return
+                members.add(add)
+            if remove is not None:
+                if remove not in members:
+                    return
+                members.discard(remove)
+            index, waiter = self._leader_append_locked(
+                CONFIG_TYPE, {"peers": sorted(members)})
+            # Active on append: replication and commit of this very
+            # entry already count under the new configuration.
+            self._activate_config_locked(sorted(members))
+        self._wait_commit(index, waiter)
+
     def _run_apply(self) -> None:
         while not self._stop.is_set():
             applied_any = False
@@ -599,7 +750,8 @@ class RaftNode:
                     self.last_applied += 1
                     entry = self._entry_at(self.last_applied)
                     waiting = self._apply_waiters.pop(self.last_applied, None)
-                    if entry is not None and entry.msg_type != NOOP_TYPE:
+                    if entry is not None and entry.msg_type not in (
+                            NOOP_TYPE, CONFIG_TYPE):
                         try:
                             self.fsm_apply(entry.index, entry.msg_type, entry.payload)
                         except Exception:
@@ -642,12 +794,15 @@ class RaftNode:
                 return  # superseded by a concurrent snapshot install
             entry = self._entry_at(snap_index)
             snap_term = entry.term if entry else self.snapshot_term
+            snap_peers = self._config_at_locked(snap_index)
+            blob = self._wrap_snapshot(data, snap_peers)
             self.log = self.log[snap_index - self.log_offset:]
             self.log_offset = snap_index
             self.snapshot_term = snap_term
-            self._latest_snapshot = (snap_index, snap_term, data)
+            self._snapshot_peers = snap_peers
+            self._latest_snapshot = (snap_index, snap_term, blob)
             if self.storage is not None:
-                self.storage.save_snapshot(snap_index, snap_term, data)
+                self.storage.save_snapshot(snap_index, snap_term, blob)
                 self.storage.rewrite_log(self.log)
         self.logger.info("compacted log @%d (%d entries kept)",
                          snap_index, len(self.log))
@@ -674,6 +829,8 @@ class RaftNode:
                 "commit_index": self.commit_index,
                 "last_applied": self.last_applied,
                 "log_len": len(self.log),
+                "members": self._members_locked(),
+                "removed": self.removed,
             }
 
 

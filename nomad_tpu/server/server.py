@@ -88,19 +88,51 @@ class Server:
         self.serf = None
         self.peers: Dict[str, Dict[str, object]] = {}
         self._peers_lock = threading.Lock()
-        # Vault token authority (vault.go; stub provider by default so
-        # the derive→renew→revoke lifecycle works without an external
-        # service — swap in a real provider via set_vault_provider).
+        # Raft membership changes triggered by gossip run here, never
+        # on the serf event thread (they block on a raft commit).
+        self._membership_pool = WorkPool(1, name="raft-membership")
+        # Vault token authority (vault.go): the HTTP provider when an
+        # address is configured, else the in-process stub so the
+        # derive→renew→revoke lifecycle works without an external
+        # service. Swappable via set_vault_provider.
         self.vault = None
         if self.config.vault_enabled:
-            from .vault import StubVault
+            if self.config.vault_addr:
+                from .vault import HTTPVaultProvider, VaultError
 
-            self.vault = StubVault(
-                ttl=self.config.vault_token_ttl,
-                allowed_policies=self.config.vault_allowed_policies,
-            )
+                provider = HTTPVaultProvider(
+                    self.config.vault_addr, self.config.vault_token,
+                    ttl=self.config.vault_token_ttl,
+                    allowed_policies=self.config.vault_allowed_policies,
+                )
+                try:
+                    # Startup check of our own token (vault.go
+                    # establishConnection): surfaces a bad/revoked token
+                    # now, not at the first task derive. Vault being
+                    # temporarily down is not fatal — the renewal loop
+                    # keeps retrying.
+                    provider.validate()
+                except VaultError as e:
+                    self.logger.error("vault token validation failed: %s", e)
+                provider.start_renewal()
+                self.vault = provider
+            else:
+                from .vault import StubVault
+
+                self.vault = StubVault(
+                    ttl=self.config.vault_token_ttl,
+                    allowed_policies=self.config.vault_allowed_policies,
+                )
 
         self._register_core_scheduler()
+
+    def set_vault_provider(self, provider) -> None:
+        """Swap the token authority (tests; operators re-pointing vault
+        without a restart)."""
+        old = self.vault
+        self.vault = provider
+        if old is not None and hasattr(old, "stop"):
+            old.stop()
 
     def _register_core_scheduler(self) -> None:
         server = self
@@ -195,6 +227,8 @@ class Server:
             self.workers.append(worker)
             worker.start()
         self.raft.start()
+        threading.Thread(target=self._membership_reconcile_loop,
+                         name="raft-membership-sweep", daemon=True).start()
         self._start_telemetry()
 
     def setup_raft_cluster(self, transport, raft_addr: str, expect: int,
@@ -202,13 +236,14 @@ class Server:
                            snapshot_threshold: int = 1024) -> None:
         """Form a raft cluster through gossip: wait until
         `bootstrap_expect` same-region servers advertise a raft address
-        in their serf tags, then start raft over that fixed peer set
+        in their serf tags, then start raft over that seed peer set
         (server.go bootstrap_expect + leader.go peer wiring). Until
         then, writes fail with no-leader.
 
-        The peer set is fixed at formation (RaftNode has no dynamic
-        membership): every server must use the same bootstrap_expect
-        and be present when the cluster forms."""
+        The seed set only bootstraps: afterwards gossip drives dynamic
+        membership (_reconcile_raft_member -> raft add_peer/remove_peer),
+        so servers can join an established cluster late — the leader
+        adds them and replication corrects their seed config."""
         from .raft import UnavailableLog
 
         self.log = UnavailableLog()
@@ -308,6 +343,8 @@ class Server:
             self.raft.stop()
         for w in self.workers:
             w.stop()
+        if self.vault is not None and hasattr(self.vault, "stop"):
+            self.vault.stop()  # own-token renewal loop
 
     def is_leader(self) -> bool:
         return self._leader
@@ -321,7 +358,7 @@ class Server:
         Reference: server.go:740-760 (setupSerf tags) + serf.go
         (serfEventHandler maintaining peers/localPeers).
         """
-        from .serf import ALIVE, Serf
+        from .serf import ALIVE, LEFT, Serf
 
         def on_event(event: str, member) -> None:
             with self._peers_lock:
@@ -332,6 +369,11 @@ class Server:
                     region_peers.pop(member.name, None)
                     if not region_peers:
                         self.peers.pop(member.region, None)
+            # Off the gossip thread: add/remove_peer waits for a raft
+            # commit (up to APPLY_TIMEOUT) and blocking here would
+            # freeze probing — missed acks would mark healthy members
+            # failed.
+            self._membership_pool.submit(self._reconcile_raft_member, member)
 
         self.serf = Serf(
             name=f"{self.node_id}.{self.config.region}",
@@ -346,6 +388,51 @@ class Server:
             on_event=on_event,
         )
         return self.serf.serve(host, port)
+
+    def _reconcile_raft_member(self, member) -> None:
+        """Gossip drives raft membership on the leader (leader.go:491
+        reconcileMember -> :551 addRaftPeer / :577 removeRaftPeer):
+        a same-region server joining with a raft address is added as a
+        peer; one that LEAVES is removed (failures are transient and do
+        not shrink the quorum, matching the reference). Serf fires an
+        event only on the status TRANSITION, so a miss here (no leader
+        yet, or a config change in flight) is not redelivered — the
+        periodic sweep in _membership_reconcile_loop retries until the
+        cluster converges (the reference reconciles on its leader-loop
+        interval too, leader.go:47-60)."""
+        from .serf import ALIVE, LEFT
+
+        if self.raft is None or not self.raft.is_leader():
+            return
+        if getattr(member, "region", None) != self.config.region:
+            return
+        rpc_addr = member.tags.get("rpc_addr") if member.tags else None
+        if not rpc_addr or rpc_addr == self.raft.node_id:
+            return
+        try:
+            if member.status == ALIVE:
+                self.raft.add_peer(rpc_addr)
+            elif member.status == LEFT:
+                self.raft.remove_peer(rpc_addr)
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning(
+                "raft membership reconcile for %s failed (periodic sweep"
+                " will retry): %s", rpc_addr, e)
+
+    def _membership_reconcile_loop(self, interval: float = 5.0) -> None:
+        """Leader-only periodic sweep over the serf member list: the
+        event-driven path can miss transitions (see above), and
+        add_peer/remove_peer are no-ops when already converged, so the
+        sweep is cheap."""
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                if self.raft is None or not self.raft.is_leader():
+                    continue
+                for member in self.serf_members():
+                    self._reconcile_raft_member(member)
+            except Exception:  # noqa: BLE001 - sweep must survive
+                self.logger.exception("membership reconcile sweep failed")
 
     def serf_join(self, addrs: List[str]) -> int:
         if self.serf is None:
@@ -952,7 +1039,7 @@ class Server:
     # ------------------------------------------------------------ stats
 
     def stats(self) -> Dict[str, object]:
-        return {
+        out = {
             "leader": self._leader,
             "last_index": self.log.last_index(),
             "broker": self.broker.stats(),
@@ -961,3 +1048,9 @@ class Server:
             "heartbeat_timers": self.heartbeats.count(),
             "num_workers": len(self.workers),
         }
+        if self.raft is not None:
+            # Term/commit/membership for operators (the reference's
+            # Server.Stats exposes the raft section the same way,
+            # server.go:915).
+            out["raft"] = self.raft.stats()
+        return out

@@ -38,6 +38,12 @@ def is_dense_factory(name: str) -> bool:
     return name.endswith("-tpu")
 
 
+def host_factory(name: str) -> str:
+    """The host (CPU iterator) factory with identical placement
+    semantics — where latency-aware routing sends lone evals."""
+    return name[: -len("-tpu")] if is_dense_factory(name) else name
+
+
 class EvalSession:
     """Per-eval Planner (worker.go:285-483). One session per in-flight
     eval so a worker can process a drained batch concurrently — the
@@ -139,31 +145,44 @@ class Worker:
                 continue
             metrics.measure_since(("worker", "dequeue_eval"), start)
             group = [(ev, token)]
+            factory = self.server.config.factory_for(ev.type)
             batch_max = self.server.config.eval_batch_size
-            if batch_max > 1 and is_dense_factory(
-                self.server.config.factory_for(ev.type)
-            ):
+            if batch_max > 1 and is_dense_factory(factory):
                 # Drain-to-batch: siblings of the same type ride one
                 # device dispatch. Non-blocking — whatever is ready now.
                 group.extend(
                     self.server.eval_dequeue_many([ev.type], batch_max - 1)
                 )
+            if batch_max > 1 and is_dense_factory(factory) and (
+                len(group) < self.server.config.dense_min_batch
+            ):
+                # (batch_max == 1 disables batching AND routing — an
+                # operator who turned draining off still gets the dense
+                # factory they configured, one eval per dispatch.)
+                # Latency-aware routing: too few evals to amortize the
+                # device dispatch — a lone interactive eval must not pay
+                # the batch-window + device RTT. The host factory has
+                # identical placement semantics (parity-tested).
+                factory = host_factory(factory)
+                metrics.incr_counter(("worker", "route_host"))
             if len(group) == 1:
-                self._process_eval(ev, token)
+                self._process_eval(ev, token, factory)
             else:
                 metrics.add_sample(("worker", "eval_batch"), len(group))
                 # Batch members run concurrently on the server's shared
                 # bounded pool (their place() calls coalesce in the
                 # batcher); the worker thread takes the first itself.
                 futures = [
-                    self.server.eval_pool.submit(self._process_eval, e, t)
+                    self.server.eval_pool.submit(
+                        self._process_eval, e, t, factory)
                     for e, t in group[1:]
                 ]
-                self._process_eval(ev, token)
+                self._process_eval(ev, token, factory)
                 for f in futures:
                     f.wait()
 
-    def _process_eval(self, ev: Evaluation, token: str) -> None:
+    def _process_eval(self, ev: Evaluation, token: str,
+                      factory: Optional[str] = None) -> None:
         start = time.monotonic()
         if not self._wait_for_index(ev.modify_index, timeout=5.0):
             self._safe_nack(ev.id, token)
@@ -171,7 +190,7 @@ class Worker:
         metrics.measure_since(("worker", "wait_for_index"), start)
         start = time.monotonic()
         try:
-            self._invoke_scheduler(ev, token)
+            self._invoke_scheduler(ev, token, factory)
         except Exception:
             self.logger.exception("eval %s failed", ev.id)
             self._safe_nack(ev.id, token)
@@ -201,9 +220,11 @@ class Worker:
             backoff = min(backoff * 2, BACKOFF_LIMIT)
         return True
 
-    def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
+    def _invoke_scheduler(self, ev: Evaluation, token: str,
+                          factory: Optional[str] = None) -> None:
         snapshot = self.server.fsm.state.snapshot()
-        factory = self.server.config.factory_for(ev.type)
+        if factory is None:
+            factory = self.server.config.factory_for(ev.type)
         session = EvalSession(self, ev, token)
         # Independent PRNG per eval: concurrent batch members must not
         # share tie-break streams (duplicate streams would correlate

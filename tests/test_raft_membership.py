@@ -1,0 +1,242 @@
+"""Raft dynamic membership (VERDICT r2 missing #2; reference:
+nomad/leader.go:551 addRaftPeer / :577 removeRaftPeer over
+hashicorp/raft configuration changes): config-change entries grow and
+shrink the voting set at runtime, survive leader failover, and gossip
+drives them at the server level."""
+
+import time
+
+import pytest
+
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.raft import (
+    CONFIG_TYPE,
+    InmemTransport,
+    NotLeaderError,
+    RaftNode,
+)
+
+
+def wait_until(fn, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_node(transport, applied, node_id, peer_ids):
+    log = applied.setdefault(node_id, [])
+    node = RaftNode(
+        node_id, peer_ids, transport,
+        lambda index, mtype, payload, _log=log: _log.append(
+            (index, mtype, payload)),
+        lambda _: None,
+    )
+    transport.register(node)
+    return node
+
+
+def make_cluster(n):
+    transport = InmemTransport()
+    applied = {}
+    ids = [f"n{i}" for i in range(n)]
+    nodes = [make_node(transport, applied, i, ids) for i in ids]
+    for node in nodes:
+        node.start()
+    return transport, nodes, applied
+
+
+def find_leader(nodes):
+    leaders = [n for n in nodes if n.is_leader() and not n.removed]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def test_add_peer_grows_cluster_and_replicates():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        n3 = make_node(transport, applied, "n3", [leader.node_id])
+        n3.start()
+        leader.add_peer("n3")
+        nodes.append(n3)
+        assert "n3" in leader.stats()["members"]
+        # Everyone converges on the 4-member config.
+        assert wait_until(lambda: all(
+            "n3" in n.stats()["members"] for n in nodes))
+        # The new node receives both old and new writes.
+        idx = leader.apply("test", {"v": 1})
+        assert wait_until(lambda: any(
+            e[0] == idx for e in applied["n3"]))
+    finally:
+        stop_all(nodes)
+
+
+def test_grow_to_five_then_leader_loss_still_commits():
+    """The VERDICT acceptance test: 3 -> 5 servers, kill the leader,
+    the survivors elect and commit."""
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        for name in ("n3", "n4"):
+            nn = make_node(transport, applied, name, [leader.node_id])
+            nn.start()
+            leader.add_peer(name)
+            nodes.append(nn)
+        assert wait_until(lambda: all(
+            len(n.stats()["members"]) == 5 for n in nodes))
+        # Kill the leader.
+        transport.disconnect(leader.node_id)
+        survivors = [n for n in nodes if n is not leader]
+        assert wait_until(lambda: find_leader(survivors) is not None,
+                          timeout=15.0)
+        new_leader = find_leader(survivors)
+        idx = new_leader.apply("after-failover", {"v": 2})
+        # Majority of 5 = 3; four survivors must reach it.
+        assert wait_until(lambda: sum(
+            1 for n in survivors
+            if any(e[0] == idx for e in applied[n.node_id])) >= 3)
+    finally:
+        stop_all(nodes)
+
+
+def test_remove_peer_shrinks_quorum():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        victim = next(n for n in nodes if n is not leader)
+        leader.remove_peer(victim.node_id)
+        assert victim.node_id not in leader.stats()["members"]
+        # The removed node never hears about the config (the leader
+        # stops replicating to it) — its election timeouts must NOT
+        # depose the live leader: members deny votes to non-members.
+        time.sleep(0.8)  # several election timeouts
+        assert leader.is_leader()
+        # Disconnect the removed node entirely: with a 2-member config
+        # the surviving pair still commits (proves quorum shrank — in a
+        # fixed 3-set, 2 nodes could still commit, so also check the
+        # victim never rejoins the member list).
+        transport.disconnect(victim.node_id)
+        idx = leader.apply("post-remove", {"v": 3})
+        others = [n for n in nodes if n is not victim]
+        assert wait_until(lambda: all(
+            any(e[0] == idx for e in applied[n.node_id]) for n in others))
+        assert all(victim.node_id not in n.stats()["members"] for n in others)
+    finally:
+        stop_all(nodes)
+
+
+def test_leader_cannot_remove_self():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        with pytest.raises(ValueError, match="remove the leader"):
+            leader.remove_peer(leader.node_id)
+    finally:
+        stop_all(nodes)
+
+
+def test_follower_rejects_membership_change():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        follower = next(n for n in nodes if n is not leader)
+        with pytest.raises(NotLeaderError):
+            follower.add_peer("nX")
+    finally:
+        stop_all(nodes)
+
+
+def test_config_entries_skip_fsm():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        n3 = make_node(transport, applied, "n3", [leader.node_id])
+        n3.start()
+        leader.add_peer("n3")
+        nodes.append(n3)
+        idx = leader.apply("real", {"v": 1})
+        assert wait_until(lambda: any(
+            e[0] == idx for e in applied[leader.node_id]))
+        assert all(
+            mtype != CONFIG_TYPE
+            for log in applied.values() for _, mtype, _ in log)
+    finally:
+        stop_all(nodes)
+
+
+def test_duplicate_add_is_noop():
+    transport, nodes, applied = make_cluster(3)
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        before = leader.stats()["log_len"]
+        leader.add_peer("n1")  # already a member
+        assert leader.stats()["log_len"] == before
+        leader.remove_peer("nZ")  # never a member
+        assert leader.stats()["log_len"] == before
+    finally:
+        stop_all(nodes)
+
+
+def test_gossip_drives_membership_on_servers():
+    """Server-level wiring: a serf member joining with a raft address
+    is added by the leader; a leaving one is removed (leader.go:491
+    reconcileMember)."""
+    from nomad_tpu.server.serf import ALIVE, LEFT
+
+    class FakeMember:
+        def __init__(self, name, rpc, status=ALIVE, region="global"):
+            self.name = name
+            self.region = region
+            self.status = status
+            self.tags = {"rpc_addr": rpc}
+
+    transport = InmemTransport()
+    ids = ["s0", "s1", "s2"]
+    servers = []
+    cluster = {}
+    for sid in ids:
+        srv = Server(ServerConfig(num_schedulers=0, node_name=sid))
+        srv.start_with_raft(sid, ids, transport, cluster)
+        servers.append(srv)
+    try:
+        assert wait_until(lambda: sum(
+            1 for s in servers if s.raft.is_leader()) == 1)
+        leader = next(s for s in servers if s.raft.is_leader())
+        # New server gossips in.
+        s3 = Server(ServerConfig(num_schedulers=0, node_name="s3"))
+        s3.start_with_raft("s3", [leader.raft.node_id], transport, cluster)
+        servers.append(s3)
+        leader._reconcile_raft_member(FakeMember("s3.global", "s3"))
+        assert wait_until(lambda: all(
+            "s3" in s.raft.stats()["members"] for s in servers))
+        # Writes commit across the 4-member cluster.
+        job_index = leader.fsm.state.latest_index()
+        from nomad_tpu import mock
+
+        leader.job_register(mock.job())
+        assert leader.fsm.state.latest_index() > job_index
+        # The member leaves: removed from the voting set.
+        leader._reconcile_raft_member(
+            FakeMember("s3.global", "s3", status=LEFT))
+        assert wait_until(lambda: "s3" not in leader.raft.stats()["members"])
+        # Cross-region and tag-less members are ignored.
+        leader._reconcile_raft_member(
+            FakeMember("x.eu", "sX", region="eu"))
+        assert "sX" not in leader.raft.stats()["members"]
+    finally:
+        for s in servers:
+            s.shutdown()
