@@ -281,17 +281,21 @@ def config_4():
         cpu_p99, tpu_rate, tpu_p99
 
 
-def config_5():
+def _system_drain_storm(n_nodes, n_jobs, rack_partition):
     """System drain storm: every system job replans when nodes drain.
     System scheduling pins each placement to its node (no search), so
     the dense path ("system-tpu", scheduler/tpu.py
     DenseSystemScheduler) replaces the per-node iterator stack with one
-    vectorized feasibility+fit pass per eval."""
+    vectorized feasibility+fit pass per eval.
+
+    At blueprint scale (10k x 200, BASELINE.json config 5) each system
+    job is constrained to its rack partition (n_nodes/n_jobs nodes) —
+    each eval still scans ALL nodes for feasibility (the storm cost
+    that scales), while placement counts stay bounded the way real
+    rack-scoped system jobs are."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.testing import Harness
-    from nomad_tpu.structs import consts
-
-    n_nodes, n_jobs = 1000, 50  # scaled drain storm
+    from nomad_tpu.structs import Constraint, consts
 
     def build():
         harness = Harness()
@@ -299,6 +303,8 @@ def config_5():
         index = 0
         for i in range(n_nodes):
             node = mock.node()
+            if rack_partition:
+                node.meta["rack"] = f"r{i % n_jobs}"
             node.compute_class()
             index += 1
             store.upsert_node(index, node)
@@ -306,6 +312,9 @@ def config_5():
         for j in range(n_jobs):
             job = mock.system_job()
             job.id = f"sys-{j}"
+            if rack_partition:
+                job.constraints.append(Constraint(
+                    ltarget="${meta.rack}", operand="=", rtarget=f"r{j}"))
             job.task_groups[0].tasks[0].resources.networks = []
             job.task_groups[0].tasks[0].resources.cpu = 5
             job.task_groups[0].tasks[0].resources.memory_mb = 8
@@ -340,74 +349,159 @@ def config_5():
 
     cpu_rate, cpu_p99 = run("system")
     dense_rate, dense_p99 = run("system-tpu")
-    return (f"drain storm: {n_nodes} nodes x {n_jobs} system jobs, "
-            f"10% drained (host stack vs dense pass)"), cpu_rate, cpu_p99, \
+    return cpu_rate, cpu_p99, dense_rate, dense_p99
+
+
+def config_5():
+    """Blueprint-scale drain storm (BASELINE.json config 5): 10k nodes
+    x 200 rack-scoped system jobs, 10% drained."""
+    cpu_rate, cpu_p99, dense_rate, dense_p99 = _system_drain_storm(
+        10_000, 200, rack_partition=True)
+    return ("drain storm: 10k nodes x 200 system jobs (rack-scoped), "
+            "10% drained (host stack vs dense pass)"), cpu_rate, cpu_p99, \
+        dense_rate, dense_p99
+
+
+def config_5s():
+    """Smoke-scale drain storm (kept for quick runs): 1k x 50,
+    unconstrained (every job spans every node)."""
+    cpu_rate, cpu_p99, dense_rate, dense_p99 = _system_drain_storm(
+        1000, 50, rack_partition=False)
+    return ("drain storm smoke: 1k nodes x 50 system jobs, 10% drained "
+            "(host stack vs dense pass)"), cpu_rate, cpu_p99, \
         dense_rate, dense_p99
 
 
 def config_6():
     """End-to-end control plane: the REAL server pipeline (broker ->
-    workers -> scheduler -> plan queue -> pipelined applier -> FSM)
-    with CPU vs TPU factories on identical clusters. This measures the
-    BASELINE.json acceptance criterion directly: evals/sec at identical
-    plan-apply success rate."""
+    workers -> drain-to-batch -> scheduler -> plan queue -> pipelined
+    applier -> FSM) with CPU vs TPU factories on identical clusters.
+    This measures the BASELINE.json acceptance criterion directly:
+    evals/sec at identical plan-apply success rate.
+
+    Two regimes per factory set:
+    - STORM: workers paused while all jobs register, then released
+      against a deep broker — the drain-to-batch path coalesces evals
+      into shared device dispatches (server/worker.py dequeue_many +
+      scheduler/batcher.py overlay dispatch).
+    - LONE: sequential single-eval registrations on an idle broker —
+      with dense factories configured, latency-aware routing
+      (dense_min_batch) must send these to the host path, so the p99
+      should match the CPU column's.
+    """
     from nomad_tpu import mock
+    from nomad_tpu.scheduler.batcher import get_batcher
     from nomad_tpu.server import Server, ServerConfig
     from nomad_tpu.structs import consts
 
-    n_nodes, n_jobs, allocs_per_job = 200, 60, 4
+    n_nodes, n_jobs, allocs_per_job = 1000, 120, 4
+    lone_jobs = 12
+
+    def wait_evals(server, evals, deadline_s):
+        deadline = time.perf_counter() + deadline_s
+        while time.perf_counter() < deadline:
+            st = [server.fsm.state.eval_by_id(e) for e in evals]
+            if all(s is not None and s.status in
+                   (consts.EVAL_STATUS_COMPLETE,
+                    consts.EVAL_STATUS_FAILED) for s in st):
+                return
+            time.sleep(0.02)
+
+    def make_job(jid):
+        job = mock.job()
+        job.id = jid
+        job.type = "service"
+        job.task_groups[0].count = allocs_per_job
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].tasks[0].resources.cpu = 20
+        job.task_groups[0].tasks[0].resources.memory_mb = 16
+        return job
 
     def run(factories):
         server = Server(ServerConfig(
             num_schedulers=4, scheduler_factories=factories,
-            eval_nack_timeout=30.0))
+            eval_nack_timeout=60.0))
         server.start()
+        batcher = get_batcher()
         try:
             for _ in range(n_nodes):
                 node = mock.node()
                 node.compute_class()
                 server.log.apply("node_register", {"node": node})
-            jobs = []
-            for j in range(n_jobs):
-                job = mock.job()
-                job.id = f"e2e-{j}"
-                job.type = "service"
-                job.task_groups[0].count = allocs_per_job
-                job.task_groups[0].tasks[0].resources.networks = []
-                job.task_groups[0].tasks[0].resources.cpu = 20
-                job.task_groups[0].tasks[0].resources.memory_mb = 16
-                jobs.append(job)
-            start = time.perf_counter()
-            evals = [server.job_register(job)[0] for job in jobs]
+
+            # WARMUP (unmeasured): a small storm compiles the dispatch
+            # shapes (the B-bucketed overlay/full programs). A live
+            # server is long-running — placement shapes are compiled
+            # once per bucket and cached (utils/jaxcache persists them
+            # across processes), so the steady state is what to measure.
+            warm = [make_job(f"warm-{j}") for j in range(40)]
+            for w in server.workers:
+                w.set_pause(True)
+            wevals = [server.job_register(job)[0] for job in warm]
+            for w in server.workers:
+                w.set_pause(False)
+            wait_evals(server, wevals, 600)
+            for job in warm:
+                server.job_deregister(job.id)
+            # Settle: the dereg evals must drain before the timed storm.
             deadline = time.perf_counter() + 120
             while time.perf_counter() < deadline:
-                st = [server.fsm.state.eval_by_id(e) for e in evals]
-                if all(s is not None and s.status in
-                       (consts.EVAL_STATUS_COMPLETE,
-                        consts.EVAL_STATUS_FAILED) for s in st):
+                s = server.broker.stats()
+                if not s["total_ready"] and not s["total_unacked"]:
                     break
-                time.sleep(0.02)
-            elapsed = time.perf_counter() - start
+                time.sleep(0.05)
+
+            jobs = [make_job(f"e2e-{j}") for j in range(n_jobs)]
+            stats0 = batcher.stats()
+            # STORM: fill the broker while workers are parked, then
+            # release — the regime drain-to-batch exists for.
+            for w in server.workers:
+                w.set_pause(True)
+            evals = [server.job_register(job)[0] for job in jobs]
+            start = time.perf_counter()
+            for w in server.workers:
+                w.set_pause(False)
+            wait_evals(server, evals, 300)
+            storm_elapsed = time.perf_counter() - start
             placed = sum(len(server.fsm.state.allocs_by_job(j.id))
                          for j in jobs)
             success = placed / (n_jobs * allocs_per_job)
-            return n_jobs / elapsed, success
+
+            # LONE: idle broker, one eval at a time, per-eval latency.
+            lat = []
+            for j in range(lone_jobs):
+                job = make_job(f"lone-{j}")
+                t0 = time.perf_counter()
+                ev = server.job_register(job)[0]
+                wait_evals(server, [ev], 60)
+                lat.append(time.perf_counter() - t0)
+            stats1 = batcher.stats()
+            dstats = {k: stats1[k] - stats0[k] for k in stats1}
+            return (n_jobs / storm_elapsed, success,
+                    float(np.percentile(lat, 99)), dstats)
         finally:
             server.shutdown()
 
-    cpu_rate, cpu_success = run({})
-    tpu_rate, tpu_success = run({"service": "service-tpu",
-                                 "batch": "batch-tpu"})
+    cpu_rate, cpu_success, cpu_lone_p99, _ = run({})
+    tpu_rate, tpu_success, tpu_lone_p99, dstats = run(
+        {"service": "service-tpu", "batch": "batch-tpu"})
     assert abs(cpu_success - tpu_success) < 1e-9, (
         f"success-rate mismatch: cpu={cpu_success} tpu={tpu_success}")
+    occupancy = (dstats["batched_requests"] / dstats["dispatches"]
+                 if dstats.get("dispatches") else 0.0)
     return (f"end-to-end pipeline, {n_nodes} nodes x {n_jobs} jobs x "
             f"{allocs_per_job} allocs, 4 workers; plan-apply success "
-            f"cpu={cpu_success:.3f} tpu={tpu_success:.3f}"), \
-        cpu_rate, 0.0, tpu_rate, 0.0
+            f"cpu={cpu_success:.3f} tpu={tpu_success:.3f}; lone-eval p99 "
+            f"cpu={cpu_lone_p99 * 1000:.0f}ms tpu={tpu_lone_p99 * 1000:.0f}ms "
+            f"(routed to host); batcher: {dstats.get('dispatches', 0)} "
+            f"dispatches x {occupancy:.1f} evals avg, "
+            f"{dstats.get('overlay_dispatches', 0)} overlay, "
+            f"{dstats.get('base_uploads', 0)} base uploads"), \
+        cpu_rate, cpu_lone_p99, tpu_rate, tpu_lone_p99
 
 
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
-           6: config_6}
+           6: config_6, 7: config_5s}
 
 
 def run_config(n):

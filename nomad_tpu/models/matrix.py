@@ -41,7 +41,14 @@ ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
 # the [N,4] utilization matrices is O(N x allocs) host work per eval,
 # and the base only changes when the nodes or allocs tables do (the
 # incremental-update-keyed-on-raft-index plan from SURVEY.md §7).
+# _BASE_FAMILY tracks the newest base per (store, nodes-index, dc-set)
+# so a snapshot that only advanced the allocs table DELTA-updates the
+# previous base (recompute touched node rows only) instead of paying
+# the O(N x allocs) full rebuild — the live pipeline bumps the allocs
+# index on every plan apply, so full rebuilds would dominate at 10k+
+# nodes / 50k+ allocs.
 _BASE_CACHE: Dict[Tuple, "_ClusterBase"] = {}
+_BASE_FAMILY: Dict[Tuple, "_ClusterBase"] = {}
 _BASE_CACHE_MAX = 8
 _BASE_CACHE_LOCK = __import__("threading").Lock()
 _BASE_TOKENS = __import__("itertools").count(1)
@@ -50,12 +57,18 @@ _BASE_TOKENS = __import__("itertools").count(1)
 class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
-                 "alloc_groups", "token")
+                 "alloc_groups", "token", "allocs_index", "table_len")
 
-    def __init__(self, nodes, proposed_fn):
+    def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
+                 table_len: int = -1):
         # Identity token: evals whose matrices share one base can share
         # a single device upload (scheduler/batcher.py groups by it).
         self.token = next(_BASE_TOKENS)
+        self.allocs_index = allocs_index  # -1 = not delta-updatable
+        # Allocs-table size at build time: deletions (GC) are invisible
+        # to the modify_index scan, so a shrinking table forces a full
+        # rebuild (see delta_update).
+        self.table_len = table_len
         self.n_real = len(nodes)
         self.n = bucket_size(self.n_real)
         n = self.n
@@ -69,40 +82,89 @@ class _ClusterBase:
         # per node: [(job_id, task_group), ...] of live allocs, for the
         # cheap per-job overlay counts
         self.alloc_groups: List[List[Tuple[str, str]]] = []
-
-        dyn_range = consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
         for i, node in enumerate(nodes):
-            r = node.resources
-            self.capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
-            res = node.reserved
-            res_cpu = res.cpu if res else 0
-            res_mem = res.memory_mb if res else 0
-            res_disk = res.disk_mb if res else 0
-            res_iops = res.iops if res else 0
-            self.sched_capacity[i] = (
-                r.cpu - res_cpu, r.memory_mb - res_mem,
-                r.disk_mb - res_disk, r.iops - res_iops,
-            )
-            self.util[i] = (res_cpu, res_mem, res_disk, res_iops)
-            if r.networks:
-                self.bw_avail[i] = r.networks[0].mbits
-            ports_used = 0
-            if res:
-                for net in res.networks:
-                    self.bw_used[i] += net.mbits
-                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                        if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
-                            ports_used += 1
-            groups: List[Tuple[str, str]] = []
-            for alloc in proposed_fn(node.id):
-                cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
-                self.util[i] += (cpu, mem, disk, iops)
-                self.bw_used[i] += mbits
-                ports_used += aports
-                groups.append((alloc.job_id, alloc.task_group))
-            self.alloc_groups.append(groups)
-            self.ports_free[i] = dyn_range - ports_used
-            self.node_ok[i] = True
+            self.alloc_groups.append([])
+            self._fill_row(i, node, proposed_fn(node.id))
+
+    def _fill_row(self, i, node, allocs) -> None:
+        """(Re)compute one node's row from its object + live allocs."""
+        r = node.resources
+        self.capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+        res = node.reserved
+        res_cpu = res.cpu if res else 0
+        res_mem = res.memory_mb if res else 0
+        res_disk = res.disk_mb if res else 0
+        res_iops = res.iops if res else 0
+        self.sched_capacity[i] = (
+            r.cpu - res_cpu, r.memory_mb - res_mem,
+            r.disk_mb - res_disk, r.iops - res_iops,
+        )
+        self.util[i] = (res_cpu, res_mem, res_disk, res_iops)
+        self.bw_avail[i] = r.networks[0].mbits if r.networks else 0.0
+        self.bw_used[i] = 0.0
+        ports_used = 0
+        if res:
+            for net in res.networks:
+                self.bw_used[i] += net.mbits
+                for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                    if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
+                        ports_used += 1
+        groups: List[Tuple[str, str]] = []
+        for alloc in allocs:
+            cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
+            self.util[i] += (cpu, mem, disk, iops)
+            self.bw_used[i] += mbits
+            ports_used += aports
+            groups.append((alloc.job_id, alloc.task_group))
+        self.alloc_groups[i] = groups
+        self.ports_free[i] = (
+            consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT - ports_used)
+        self.node_ok[i] = True
+
+    def delta_update(self, nodes, state,
+                     new_allocs_index: int) -> Optional["_ClusterBase"]:
+        """A newer base for the same node set: only rows whose allocs
+        changed since our allocs_index are recomputed. Returns None when
+        a full rebuild is the better deal (too many touched rows) or
+        required for correctness (allocs were DELETED — GC removals
+        leave no modify_index trace, so their usage would stay baked
+        in), or self unchanged-but-rekeyed when no relevant alloc moved
+        (same token -> the device-cached upload is reused as-is)."""
+        if self.allocs_index < 0 or self.table_len < 0:
+            return None
+        allocs = state.allocs()
+        created = sum(1 for a in allocs if a.create_index > self.allocs_index)
+        if len(allocs) != self.table_len + created:
+            return None  # deletions happened; they are untraceable
+        changed_nodes = {
+            a.node_id for a in allocs
+            if a.modify_index > self.allocs_index
+        }
+        row_of = {node.id: i for i, node in enumerate(nodes)}
+        rows = [row_of[nid] for nid in changed_nodes if nid in row_of]
+        if not rows:
+            self.allocs_index = new_allocs_index
+            return self
+        if len(rows) > max(64, self.n_real // 4):
+            return None  # full rebuild is cheaper
+        new = _ClusterBase.__new__(_ClusterBase)
+        new.token = next(_BASE_TOKENS)
+        new.allocs_index = new_allocs_index
+        new.table_len = len(allocs)
+        new.n_real, new.n = self.n_real, self.n
+        new.capacity = self.capacity.copy()
+        new.sched_capacity = self.sched_capacity.copy()
+        new.util = self.util.copy()
+        new.bw_avail = self.bw_avail.copy()
+        new.bw_used = self.bw_used.copy()
+        new.ports_free = self.ports_free.copy()
+        new.node_ok = self.node_ok.copy()
+        new.alloc_groups = list(self.alloc_groups)
+        for i in rows:
+            new._fill_row(
+                i, nodes[i],
+                state.allocs_by_node_terminal(nodes[i].id, False))
+        return new
 
 
 def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
@@ -152,6 +214,7 @@ class ClusterMatrix:
         self.state = state
         self.job = job
         self.plan = plan
+        self._explicit_nodes = nodes is not None
         if nodes is None:
             from ..scheduler.util import ready_nodes_in_dcs
 
@@ -176,26 +239,53 @@ class ClusterMatrix:
     def _cached_base(self) -> "_ClusterBase":
         """The job-independent base, cached by (nodes index, allocs
         index, datacenters): snapshots sharing those see identical
-        clusters. A non-empty plan changes proposed allocs, so it
-        bypasses the cache."""
+        clusters. A snapshot that only advanced the allocs table
+        delta-updates the family's previous base (touched rows only)
+        instead of a full O(N x allocs) rebuild. A non-empty plan
+        changes proposed allocs, so it bypasses the cache."""
         cacheable = self.plan is None or self.plan.is_no_op()
-        key = None
+        key = family = prev = None
+        allocs_idx = -1
         if (cacheable and hasattr(self.state, "index")
                 and getattr(self.state, "store_id", "")):
-            key = (self.state.store_id,
-                   self.state.index("nodes"), self.state.index("allocs"),
-                   tuple(sorted(self.job.datacenters or [])),
-                   len(self.nodes))
+            dcs = tuple(sorted(self.job.datacenters or []))
+            # Caller-provided node lists (the system path's pinned
+            # subsets) need their identity in the key: two different
+            # subsets of equal size on one snapshot must not collide.
+            # The derived full-ready-set is determined by (nodes index,
+            # dcs), so a constant marker suffices there.
+            nodes_sig = (hash(tuple(n.id for n in self.nodes))
+                         if self._explicit_nodes else 0)
+            nodes_idx = self.state.index("nodes")
+            allocs_idx = self.state.index("allocs")
+            key = (self.state.store_id, nodes_idx, allocs_idx, dcs,
+                   len(self.nodes), nodes_sig)
+            family = (self.state.store_id, nodes_idx, dcs,
+                      len(self.nodes), nodes_sig)
             with _BASE_CACHE_LOCK:
                 cached = _BASE_CACHE.get(key)
+                if cached is None:
+                    prev = _BASE_FAMILY.get(family)
             if cached is not None:
                 return cached
-        base = _ClusterBase(self.nodes, self._proposed_allocs)
+        base = None
+        if prev is not None and 0 <= prev.allocs_index <= allocs_idx:
+            base = prev.delta_update(self.nodes, self.state, allocs_idx)
+        if base is None:
+            table_len = (self.state.alloc_count()
+                         if key is not None
+                         and hasattr(self.state, "alloc_count") else -1)
+            base = _ClusterBase(self.nodes, self._proposed_allocs,
+                                allocs_index=allocs_idx if key else -1,
+                                table_len=table_len)
         if key is not None:
             with _BASE_CACHE_LOCK:
                 while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
                     _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
                 _BASE_CACHE[key] = base
+                _BASE_FAMILY[family] = base
+                while len(_BASE_FAMILY) > _BASE_CACHE_MAX:
+                    _BASE_FAMILY.pop(next(iter(_BASE_FAMILY)))
         return base
 
     def _build(self) -> None:

@@ -82,11 +82,19 @@ class Asks(NamedTuple):
     tg_distinct_hosts: jnp.ndarray  # [G] bool
 
 
+import numpy as _np
+
+
 def make_node_state(
     capacity, sched_capacity, util, bw_avail, bw_used, ports_free,
     job_count, tg_count, feasible, node_ok,
 ) -> NodeState:
-    f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
+    """HOST-side (numpy) state. Deliberately NOT jnp: device residency
+    happens once, inside the single jitted dispatch — eager jnp.asarray
+    here would cost one host->device round-trip PER FIELD PER EVAL
+    (ruinous through a remote-device tunnel), and the batcher must be
+    able to np.stack request fields without pulling them back."""
+    f32 = functools.partial(_np.asarray, dtype=_np.float32)
     return NodeState(
         capacity=f32(capacity),
         sched_capacity=f32(sched_capacity),
@@ -94,25 +102,33 @@ def make_node_state(
         bw_avail=f32(bw_avail),
         bw_used=f32(bw_used),
         ports_free=f32(ports_free),
-        job_count=jnp.asarray(job_count, jnp.int32),
-        tg_count=jnp.asarray(tg_count, jnp.int32),
-        feasible=jnp.asarray(feasible, bool),
-        node_ok=jnp.asarray(node_ok, bool),
+        job_count=_np.asarray(job_count, _np.int32),
+        tg_count=_np.asarray(tg_count, _np.int32),
+        feasible=_np.asarray(feasible, bool),
+        node_ok=_np.asarray(node_ok, bool),
     )
 
 
 def make_asks(
     resources, bw, ports, tg_index, active, job_distinct_hosts, tg_distinct_hosts
 ) -> Asks:
+    """HOST-side (numpy) asks — see make_node_state on why."""
     return Asks(
-        resources=jnp.asarray(resources, jnp.float32),
-        bw=jnp.asarray(bw, jnp.float32),
-        ports=jnp.asarray(ports, jnp.float32),
-        tg_index=jnp.asarray(tg_index, jnp.int32),
-        active=jnp.asarray(active, bool),
-        job_distinct_hosts=jnp.asarray(job_distinct_hosts, bool),
-        tg_distinct_hosts=jnp.asarray(tg_distinct_hosts, bool),
+        resources=_np.asarray(resources, _np.float32),
+        bw=_np.asarray(bw, _np.float32),
+        ports=_np.asarray(ports, _np.float32),
+        tg_index=_np.asarray(tg_index, _np.int32),
+        active=_np.asarray(active, bool),
+        job_distinct_hosts=_np.asarray(job_distinct_hosts, bool),
+        tg_distinct_hosts=_np.asarray(tg_distinct_hosts, bool),
     )
+
+
+def host_prng_key(seed: int) -> "_np.ndarray":
+    """A threefry key as a HOST uint32[2] (what jax.random.PRNGKey
+    yields, without the eager device transfer); jax.random accepts the
+    raw layout inside jit."""
+    return _np.array([0, _np.uint32(seed & 0xFFFFFFFF)], _np.uint32)
 
 
 def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,

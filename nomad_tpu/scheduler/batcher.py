@@ -38,8 +38,19 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 MAX_BATCH = 64
-WINDOW_S = 0.003  # idle-batcher accumulation window
+# Idle-batcher accumulation window. Sized for the drain-to-batch storm:
+# a drained group's place() calls arrive staggered by the GIL-serialized
+# host phases (~2-4ms each), so a too-small window ships a near-empty
+# first dispatch. Interactive evals never wait this — latency-aware
+# routing sends lone evals to the host factory (server/worker.py).
+WINDOW_S = 0.02
+RESPAWN_WINDOW_S = 0.005  # post-dispatch window: catch GIL stragglers
 DEVICE_BASE_CACHE = 4  # cluster bases kept on device
+# In-flight dispatches allowed per shape: overlapping device calls
+# hides the per-dispatch round-trip (dominant through a remote-device
+# tunnel) behind the next batch's accumulation. XLA serializes the
+# programs on-device; overlap buys transfer/queueing concurrency.
+MAX_INFLIGHT = 3
 
 
 class _Request:
@@ -76,7 +87,7 @@ class PlacementBatcher:
         self.logger = logging.getLogger("nomad_tpu.batcher")
         self._lock = threading.Lock()
         self._queues: Dict[Tuple, List[_Request]] = {}
-        self._dispatcher_live: Dict[Tuple, bool] = {}
+        self._dispatchers: Dict[Tuple, int] = {}  # live dispatchers/shape
         self._device_bases: "OrderedDict[object, tuple]" = OrderedDict()  # token -> device arrays
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
@@ -95,18 +106,28 @@ class PlacementBatcher:
                 state.bw_avail, state.bw_used, state.ports_free,
                 state.node_ok)
         overlay = (state.job_count, state.tg_count, state.feasible)
+        token = getattr(state, "base_token", None)
+        # Token is part of the grouping key: same-token requests share
+        # one dispatch through the device-cached base (only the small
+        # per-job overlays cross host->device). Mixing tokens in one
+        # batch would force the stacked full-state path — at 5k+ nodes
+        # that is ~10x the bytes per dispatch, and through a remote
+        # tunnel it dominates the whole pipeline. Requests with
+        # different tokens form separate queues whose dispatches
+        # overlap (MAX_INFLIGHT is per key).
         shape_key = (
             np.shape(state.capacity), np.shape(asks.resources),
-            np.shape(state.feasible)[-1], config,
+            np.shape(state.feasible)[-1], config, token,
         )
-        token = getattr(state, "base_token", None)
         req = _Request(token, base, overlay, asks, rng_key)
         run_dispatch = False
         with self._lock:
             self._queues.setdefault(shape_key, []).append(req)
-            if not self._dispatcher_live.get(shape_key):
+            if self._dispatchers.get(shape_key, 0) == 0:
                 # First in: this thread becomes the batch's dispatcher.
-                self._dispatcher_live[shape_key] = True
+                # (Only idle shapes start here — while dispatchers are
+                # in flight, arrivals accumulate for their respawns.)
+                self._dispatchers[shape_key] = 1
                 run_dispatch = True
         if run_dispatch:
             self._dispatch(shape_key, config, wait_window=True)
@@ -197,21 +218,33 @@ class PlacementBatcher:
             req.choices = choices[i]
             req.scores = scores[i]
 
+    def _spawn_dispatcher(self, shape_key, config) -> None:
+        threading.Thread(
+            target=self._dispatch, args=(shape_key, config, False),
+            daemon=True, name="placement-batch").start()
+
     def _dispatch(self, shape_key, config, wait_window: bool) -> None:
         """Everything — including imports and the queue pop — runs
         under the error handler: a dispatcher that dies without setting
         its requests' events (e.g. a TPU runtime init failure) would
-        wedge every worker on that shape forever."""
+        wedge every worker on that shape forever.
+
+        The caller has already counted us in self._dispatchers; the
+        finally block counts us out and respawns if work remains."""
         batch: List[_Request] = []
+        popped = False
         try:
             import time as _time
 
             if wait_window and self.window > 0:
                 # Idle batcher: give concurrent workers a moment to
-                # pile on. Post-dispatch respawns skip this — whatever
-                # accumulated during the in-flight device call ships
-                # immediately (the adaptive part of the window).
+                # pile on. Post-dispatch respawns use a shorter window —
+                # most of their batch accumulated during the in-flight
+                # device call (the adaptive part); the short wait only
+                # catches stragglers mid-host-phase.
                 _time.sleep(self.window)
+            elif not wait_window and RESPAWN_WINDOW_S > 0:
+                _time.sleep(RESPAWN_WINDOW_S)
             with self._lock:
                 waiting = self._queues.pop(shape_key, [])
                 batch = waiting[: self.max_batch]
@@ -220,7 +253,18 @@ class PlacementBatcher:
                     # Overflow rides the next dispatch; dropping it
                     # would wedge those workers in event.wait().
                     self._queues[shape_key] = leftover
-                self._dispatcher_live[shape_key] = False
+                popped = True
+                # Overlap: if work is already waiting, start the next
+                # dispatcher NOW so its accumulation + transfer hides
+                # behind our device round-trip.
+                overlap = (
+                    bool(self._queues.get(shape_key))
+                    and self._dispatchers.get(shape_key, 0) < MAX_INFLIGHT
+                )
+                if overlap:
+                    self._dispatchers[shape_key] += 1
+            if overlap:
+                self._spawn_dispatcher(shape_key, config)
             if not batch:
                 return
             self._run_batch(batch, config)
@@ -228,32 +272,31 @@ class PlacementBatcher:
             self.batched_requests += len(batch)
         except BaseException as e:  # noqa: BLE001 - propagate per request
             with self._lock:
-                # Died before the pop: the queued requests are this
-                # dispatcher's responsibility — fail them too, and
-                # clear the live flag WE still hold. After the pop the
-                # flag was already released (a newer dispatcher may own
-                # it) — touching it then would let two run at once.
-                if not batch:
+                # Died before the pop: the queued requests were OUR
+                # responsibility (no overlap dispatcher was spawned for
+                # them) — fail them too rather than leave them wedged.
+                if not popped:
                     batch = self._queues.pop(shape_key, [])
-                    self._dispatcher_live[shape_key] = False
             for req in batch:
                 req.error = e
         finally:
             for req in batch:
                 req.event.set()
-            # Anything that arrived during our device call gets its own
-            # dispatcher (first of the leftovers may already have
-            # claimed it via place()).
+            # Count ourselves out; anything still queued with no live
+            # dispatcher gets a fresh one. Zero-count keys are removed —
+            # every new cluster-base token mints a new shape key, so a
+            # long-running server would otherwise accrete dead entries.
             with self._lock:
-                if self._queues.get(shape_key) and not self._dispatcher_live.get(shape_key):
-                    self._dispatcher_live[shape_key] = True
-                    spawn = True
+                remaining = self._dispatchers.get(shape_key, 1) - 1
+                spawn = bool(self._queues.get(shape_key)) and remaining == 0
+                if spawn:
+                    remaining = 1
+                if remaining > 0:
+                    self._dispatchers[shape_key] = remaining
                 else:
-                    spawn = False
+                    self._dispatchers.pop(shape_key, None)
             if spawn:
-                threading.Thread(
-                    target=self._dispatch, args=(shape_key, config, False),
-                    daemon=True, name="placement-batch").start()
+                self._spawn_dispatcher(shape_key, config)
 
     def stats(self) -> dict:
         return {

@@ -94,10 +94,8 @@ class BatchedTPUScheduler(GenericScheduler):
     """GenericScheduler whose bulk placement loop runs on the TPU."""
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
-        import jax
-
         from ..models.matrix import ClusterMatrix
-        from ..ops.binpack import PlacementConfig, make_asks
+        from ..ops.binpack import PlacementConfig, host_prng_key, make_asks
         from .batcher import get_batcher
         from .stack import (
             BATCH_JOB_ANTI_AFFINITY_PENALTY,
@@ -138,7 +136,10 @@ class BatchedTPUScheduler(GenericScheduler):
             else SERVICE_JOB_ANTI_AFFINITY_PENALTY
         )
         config = PlacementConfig(anti_affinity_penalty=penalty)
-        key = jax.random.PRNGKey(self.rng.getrandbits(31))
+        # Host-side key: a device PRNGKey here would cost a tunnel
+        # round-trip per eval and force the batcher to pull keys back
+        # for stacking.
+        key = host_prng_key(self.rng.getrandbits(31))
 
         # The drain-to-batch shim (BASELINE north star): concurrent
         # workers' same-shaped placement programs coalesce into one
@@ -218,8 +219,21 @@ class DenseSystemScheduler(SystemScheduler):
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
 
+        # Matrix only the PINNED nodes: system placements are fixed to
+        # their node up front (diffSystemAllocs), so feasibility/fit for
+        # the other N-P nodes would be wasted work — at 10k nodes with
+        # rack-scoped jobs that's a 200x smaller matrix per eval.
+        pinned_ids = []
+        seen = set()
+        for missing in place:
+            nid = missing.alloc.node_id
+            if nid not in seen:
+                seen.add(nid)
+                pinned_ids.append(nid)
+        by_id = {n.id: n for n in self.nodes}
+        pinned_nodes = [by_id[nid] for nid in pinned_ids if nid in by_id]
         matrix = ClusterMatrix(self.state, self.job, self.plan,
-                               nodes=self.nodes)
+                               nodes=pinned_nodes)
         matrix.nodes_by_dc = self.nodes_by_dc
         node_index = {n.id: i for i, n in enumerate(matrix.nodes)}
         tg_by_name = {tg.name: i for i, tg in enumerate(self.job.task_groups)}

@@ -184,6 +184,8 @@ class RaftNode:
         self.match_index: Dict[str, int] = {}
 
         self._last_heartbeat = time.monotonic()
+        # Stale enough that votes are granted normally at boot.
+        self._last_leader_contact = time.monotonic() - 3600.0
         self._election_deadline = self._next_election_deadline()
         # index -> (expected term, waiter); the commit must match the
         # term or the write was superseded by another leader.
@@ -369,12 +371,21 @@ class RaftNode:
     def handle_request_vote(self, args: dict) -> dict:
         with self._lock:
             term = args["term"]
-            if args["candidate_id"] not in set(self.peers) | {self.node_id}:
-                # Non-member candidate (a removed server timing out —
-                # the leader stops replicating to it at removal, so it
-                # never learns): deny WITHOUT adopting its term, or its
-                # election timeouts would depose the live leader
-                # (dissertation §4.2.2 disruption problem).
+            # Leader stickiness (dissertation §4.2.3, hashicorp/raft
+            # CheckQuorum): while we hear from a live leader, deny votes
+            # WITHOUT adopting the candidate's term. This is what stops
+            # a REMOVED server's election timeouts from deposing the
+            # leader (it never learns of its removal — replication to it
+            # stops at removal), while still letting any candidate win
+            # once the leader actually dies (membership-based denial
+            # would deadlock elections when the only up-to-date
+            # survivors are servers a lagging voter hasn't learned of).
+            if (
+                self.leader_id is not None
+                and args["candidate_id"] != self.leader_id
+                and time.monotonic() - self._last_leader_contact
+                < ELECTION_TIMEOUT_MIN
+            ):
                 return {"term": self.current_term, "vote_granted": False}
             if term < self.current_term:
                 return {"term": self.current_term, "vote_granted": False}
@@ -399,6 +410,7 @@ class RaftNode:
             if term > self.current_term or self.state != FOLLOWER:
                 self._become_follower(term)
             self.leader_id = args["leader_id"]
+            self._last_leader_contact = time.monotonic()
             self._election_deadline = self._next_election_deadline()
 
             prev_index = args["prev_log_index"]
@@ -454,6 +466,7 @@ class RaftNode:
             if term > self.current_term or self.state != FOLLOWER:
                 self._become_follower(term)
             self.leader_id = args["leader_id"]
+            self._last_leader_contact = time.monotonic()
             self._election_deadline = self._next_election_deadline()
             last_index = args["last_index"]
             if last_index <= self.log_offset:

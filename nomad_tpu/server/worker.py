@@ -169,28 +169,44 @@ class Worker:
                 self._process_eval(ev, token, factory)
             else:
                 metrics.add_sample(("worker", "eval_batch"), len(group))
+                # One MVCC snapshot for the whole drained batch: every
+                # member plans against the same cluster state, so their
+                # ClusterMatrix bases share one cache entry and one
+                # device upload (the batcher's overlay fast path needs
+                # matching base tokens). Per-eval snapshots would
+                # interleave with plan applies and fracture the batch
+                # into mixed-token dispatches. Optimistic concurrency
+                # makes this safe: the plan applier re-verifies every
+                # node and hands back RefreshIndex when stale
+                # (plan_apply.go:122-166).
+                snapshot = None
+                max_index = max(e.modify_index for e, _ in group)
+                if self._wait_for_index(max_index, timeout=5.0):
+                    snapshot = self.server.fsm.state.snapshot()
                 # Batch members run concurrently on the server's shared
                 # bounded pool (their place() calls coalesce in the
                 # batcher); the worker thread takes the first itself.
                 futures = [
                     self.server.eval_pool.submit(
-                        self._process_eval, e, t, factory)
+                        self._process_eval, e, t, factory, snapshot)
                     for e, t in group[1:]
                 ]
-                self._process_eval(ev, token, factory)
+                self._process_eval(ev, token, factory, snapshot)
                 for f in futures:
                     f.wait()
 
     def _process_eval(self, ev: Evaluation, token: str,
-                      factory: Optional[str] = None) -> None:
+                      factory: Optional[str] = None,
+                      snapshot=None) -> None:
         start = time.monotonic()
-        if not self._wait_for_index(ev.modify_index, timeout=5.0):
-            self._safe_nack(ev.id, token)
-            return
+        if snapshot is None:
+            if not self._wait_for_index(ev.modify_index, timeout=5.0):
+                self._safe_nack(ev.id, token)
+                return
         metrics.measure_since(("worker", "wait_for_index"), start)
         start = time.monotonic()
         try:
-            self._invoke_scheduler(ev, token, factory)
+            self._invoke_scheduler(ev, token, factory, snapshot)
         except Exception:
             self.logger.exception("eval %s failed", ev.id)
             self._safe_nack(ev.id, token)
@@ -221,8 +237,10 @@ class Worker:
         return True
 
     def _invoke_scheduler(self, ev: Evaluation, token: str,
-                          factory: Optional[str] = None) -> None:
-        snapshot = self.server.fsm.state.snapshot()
+                          factory: Optional[str] = None,
+                          snapshot=None) -> None:
+        if snapshot is None:
+            snapshot = self.server.fsm.state.snapshot()
         if factory is None:
             factory = self.server.config.factory_for(ev.type)
         session = EvalSession(self, ev, token)

@@ -177,6 +177,12 @@ class StateSnapshot:
     def allocs(self) -> List[Allocation]:
         return list(self._t["allocs"].values())
 
+    def alloc_count(self) -> int:
+        """O(1) allocs-table size (delta caches detect GC deletions by
+        comparing it; listing 50k allocs to count them would defeat the
+        point)."""
+        return len(self._t["allocs"])
+
     def allocs_by_job(self, job_id: str) -> List[Allocation]:
         ids = self._i["allocs_by_job"].get(job_id, ())
         return [self._t["allocs"][i] for i in ids]
@@ -270,6 +276,7 @@ class StateStore:
             "evals_by_job",
             "alloc_by_id",
             "allocs",
+            "alloc_count",
             "allocs_by_job",
             "allocs_by_node",
             "allocs_by_node_terminal",

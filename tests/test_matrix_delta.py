@@ -1,0 +1,178 @@
+"""Incremental cluster-base updates (models/matrix.py _BASE_FAMILY +
+_ClusterBase.delta_update): a snapshot that only advanced the allocs
+table recomputes touched node rows instead of a full O(N x allocs)
+rebuild, and the delta result must be bit-identical to a fresh build —
+the live pipeline's per-apply snapshot churn rides this path."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models.matrix import ClusterMatrix, _ClusterBase
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import consts
+
+
+def make_alloc(node, job, cpu=100, mem=128):
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    alloc.job_id = job.id
+    alloc.job = job
+    alloc.desired_status = consts.ALLOC_DESIRED_RUN
+    alloc.client_status = consts.ALLOC_CLIENT_RUNNING
+    for tr in alloc.task_resources.values():
+        tr.cpu = cpu
+        tr.memory_mb = mem
+        tr.networks = []
+    alloc.resources = None
+    return alloc
+
+
+@pytest.fixture
+def cluster():
+    store = StateStore()
+    job = mock.job()
+    job.task_groups[0].tasks[0].resources.networks = []
+    nodes = []
+    index = 0
+    for _ in range(16):
+        node = mock.node()
+        node.compute_class()
+        nodes.append(node)
+        index += 1
+        store.upsert_node(index, node)
+    allocs = [make_alloc(nodes[i % 16], job) for i in range(32)]
+    index += 1
+    store.upsert_allocs(index, allocs)
+    return store, job, nodes, allocs, index
+
+
+def assert_bases_equal(a, b):
+    for f in ("capacity", "sched_capacity", "util", "bw_avail",
+              "bw_used", "ports_free", "node_ok"):
+        np.testing.assert_array_equal(
+            getattr(a, f), getattr(b, f), err_msg=f)
+    assert a.alloc_groups == b.alloc_groups
+
+
+def test_delta_update_matches_full_rebuild(cluster):
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    tok1 = m1.base_token
+
+    # Stop some allocs and add new ones: the allocs index advances.
+    stopped = allocs[:5]
+    for a in stopped:
+        a.desired_status = consts.ALLOC_DESIRED_STOP
+        a.client_status = consts.ALLOC_CLIENT_COMPLETE
+    index += 1
+    store.upsert_allocs(index, stopped)
+    fresh = [make_alloc(nodes[3], job, cpu=250), make_alloc(nodes[7], job)]
+    index += 1
+    store.upsert_allocs(index, fresh)
+
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)  # delta path (family hit)
+    assert m2.base_token != tok1
+    # Oracle: a from-scratch base on the same snapshot.
+    oracle = _ClusterBase(
+        m2.nodes,
+        lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+
+
+def test_unchanged_allocs_reuse_token(cluster):
+    """An allocs-index bump that touches no node in this matrix's node
+    set keeps the SAME base token — the device-cached upload stays
+    valid with zero new transfers."""
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    tok1 = m1.base_token
+    # Touch an alloc on a node in another datacenter (outside this
+    # job's node set).
+    other = mock.node()
+    other.datacenter = "dc-elsewhere"
+    other.compute_class()
+    index += 1
+    store.upsert_node(index, other)
+    m_after_node = ClusterMatrix(store.snapshot(), job)
+    # nodes index moved: new family -> full rebuild is expected here.
+    far_job = mock.job()
+    far_job.id = "far"
+    index += 1
+    store.upsert_allocs(index, [make_alloc(other, far_job)])
+    m2 = ClusterMatrix(store.snapshot(), job)
+    assert m2.base_token == m_after_node.base_token
+
+
+def test_many_changed_rows_falls_back_to_full_rebuild(cluster):
+    store, job, nodes, allocs, index = cluster
+    ClusterMatrix(store.snapshot(), job)
+    # Touch every node (> n/4 rows): delta declines, full rebuild runs.
+    for a in allocs:
+        a.client_status = consts.ALLOC_CLIENT_COMPLETE
+        a.desired_status = consts.ALLOC_DESIRED_STOP
+    index += 1
+    store.upsert_allocs(index, allocs)
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+    # All allocs stopped: utilization back to reserved-only.
+    assert float(m2.util[: m2.n_real, 0].max()) <= max(
+        (n.reserved.cpu if n.reserved else 0) for n in m2.nodes)
+
+
+def test_gc_deletion_forces_full_rebuild(cluster):
+    """Deleted allocs leave no modify_index trace; the delta path must
+    detect the shrinking table and rebuild, or the deleted usage stays
+    baked into the base forever (GC via delete_evals pops allocs)."""
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    util_before = m1.util[: m1.n_real].sum()
+    victims = allocs[:4]
+    index += 1
+    store.delete_evals(index, [], [a.id for a in victims])
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+    assert m2.util[: m2.n_real].sum() < util_before
+
+
+def test_explicit_node_subsets_do_not_collide(cluster):
+    """Two equal-sized but different pinned-node subsets on one
+    snapshot (the dense system scheduler's shape) must get distinct
+    bases — round-3 bug: the cache keyed node identity by len()."""
+    store, job, nodes, allocs, index = cluster
+    snap = store.snapshot()
+    sub_a, sub_b = nodes[:4], nodes[4:8]
+    ma = ClusterMatrix(snap, job, nodes=sub_a)
+    mb = ClusterMatrix(snap, job, nodes=sub_b)
+    assert ma.base_token != mb.base_token
+    for m, subset in ((ma, sub_a), (mb, sub_b)):
+        oracle = _ClusterBase(
+            subset, lambda nid: snap.allocs_by_node_terminal(nid, False))
+        assert_bases_equal(m._cached_base(), oracle)
+    # Same subset again: cache hit, same token.
+    ma2 = ClusterMatrix(snap, job, nodes=sub_a)
+    assert ma2.base_token == ma.base_token
+
+
+def test_chained_deltas_stay_correct(cluster):
+    """Repeated small changes (the live pipeline's per-apply churn)
+    accumulate through chained delta updates without drift."""
+    store, job, nodes, allocs, index = cluster
+    rng_nodes = nodes
+    for step in range(6):
+        ClusterMatrix(store.snapshot(), job)
+        index += 1
+        store.upsert_allocs(index, [
+            make_alloc(rng_nodes[(step * 3) % 16], job, cpu=50 + step)])
+    snap = store.snapshot()
+    m = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m._cached_base(), oracle)
