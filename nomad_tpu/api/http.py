@@ -82,9 +82,13 @@ class HTTPServer:
     expose its fs/logs/stats endpoints — server-backed routes answer
     501 there."""
 
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 0, client=None):
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
+                 client=None, enable_debug: bool = False):
         self.server = server
         self.client = client
+        # Gates the /debug/* introspection routes (the reference gates
+        # pprof the same way, command/agent/http.go:135 enableDebug).
+        self.enable_debug = enable_debug
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -222,12 +226,18 @@ class HTTPServer:
             (r"^/v1/internal/eval/outstanding$", self._internal_eval_outstanding),
             (r"^/v1/internal/plan/submit$", self._internal_plan_submit),
             (r"^/v1/internal/heartbeat/reset$", self._internal_heartbeat_reset),
+            # Debug introspection, gated on enable_debug (the pprof
+            # analog: command/agent/http.go:135-138).
+            (r"^/debug/stacks$", self._debug_stacks),
+            (r"^/debug/profile$", self._debug_profile),
+            (r"^/debug/vars$", self._debug_vars),
         ]
         client_only_ok = {
             self._fs_ls, self._fs_stat, self._fs_cat, self._fs_readat,
             self._fs_logs, self._client_stats, self._client_alloc_stats,
             self._client_alloc_snapshot,
             self._agent_self, self._agent_servers,
+            self._debug_stacks, self._debug_profile, self._debug_vars,
         }
         for pattern, handler in route_handlers:
             m = re.match(pattern, path)
@@ -711,6 +721,84 @@ class HTTPServer:
 
     def _client_alloc_stats(self, method, query, body, alloc_id):
         return self._require_client().alloc_stats(alloc_id)
+
+    # ------------------------------------------------ debug (pprof analog)
+
+    def _require_debug(self) -> None:
+        if not self.enable_debug:
+            # 404 like the reference, which never registers the routes
+            # unless enable_debug is set — their existence should not be
+            # probeable on production agents.
+            raise HTTPError(404, "debug endpoints not enabled")
+
+    def _debug_stacks(self, method, query, body):
+        """Stack of every live thread (goroutine-dump analog)."""
+        self._require_debug()
+        import sys
+        import traceback
+
+        names = {t.ident: (t.name, t.daemon) for t in threading.enumerate()}
+        parts = []
+        for ident, frame in sorted(sys._current_frames().items()):
+            name, daemon = names.get(ident, ("?", False))
+            parts.append(
+                f"== thread {name} (ident {ident}"
+                f"{', daemon' if daemon else ''})\n"
+                + "".join(traceback.format_stack(frame))
+            )
+        return RawResponse("\n".join(parts).encode(), "text/plain")
+
+    def _debug_profile(self, method, query, body):
+        """Sampling wall-clock profile across ALL threads for ?seconds=N
+        (cpu-pprof analog): stacks sampled at ~100 Hz, aggregated by
+        call path, top paths by sample count."""
+        self._require_debug()
+        import sys
+        from collections import Counter
+
+        seconds = min(max(float(self._q(query, "seconds", "1")), 0.1), 30.0)
+        hz = 100
+        counts: Counter = Counter()
+        me = threading.get_ident()
+        deadline = time.monotonic() + seconds
+        n_samples = 0
+        while time.monotonic() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 24:
+                    code = f.f_code
+                    stack.append(
+                        f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}"
+                        f":{f.f_lineno})")
+                    f = f.f_back
+                counts[";".join(reversed(stack))] += 1
+            n_samples += 1
+            time.sleep(1.0 / hz)
+        lines = [f"# {n_samples} sampling rounds over {seconds:.1f}s @~{hz}Hz"]
+        for path, c in counts.most_common(50):
+            lines.append(f"{c}\t{path}")
+        return RawResponse("\n".join(lines).encode(), "text/plain")
+
+    def _debug_vars(self, method, query, body):
+        """Process-level runtime vars (expvar analog)."""
+        self._require_debug()
+        import gc
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "threads": len(threading.enumerate()),
+            "gc_counts": gc.get_count(),
+            "gc_objects": len(gc.get_objects()),
+            "max_rss_kb": ru.ru_maxrss,
+            "user_cpu_s": ru.ru_utime,
+            "system_cpu_s": ru.ru_stime,
+            "python": sys.version.split()[0],
+        }
 
     def _client_alloc_snapshot(self, method, query, body, alloc_id):
         """Tar archive of the alloc's migratable dirs: the source side

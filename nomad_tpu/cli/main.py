@@ -650,7 +650,8 @@ def cmd_agent(args) -> int:
                 data_dir=raft_dir)
         else:
             server.start()
-        http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http)
+        http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http,
+                          enable_debug=cfg.enable_debug)
         http.start()
         server_addr = http.addr
         # Gossip peers and federated regions must receive a routable
@@ -731,7 +732,8 @@ def cmd_agent(args) -> int:
             # still exposes its fs/logs/stats endpoints. Started before
             # the agent so the advertised port is known at registration.
             http = HTTPServer(None, host=cfg.bind_addr,
-                              port=cfg.ports.http)
+                              port=cfg.ports.http,
+                              enable_debug=cfg.enable_debug)
             http.start()
         # The node must register with a routable HTTP endpoint: peer
         # clients GET /v1/client/allocation/<id>/snapshot from it for

@@ -143,7 +143,13 @@ class _ClusterBase:
         row_of = {node.id: i for i, node in enumerate(nodes)}
         rows = [row_of[nid] for nid in changed_nodes if nid in row_of]
         if not rows:
+            # Nothing in OUR node set changed: rekey in place. table_len
+            # must advance too — allocs may have been created on nodes
+            # outside this family (other DCs, non-pinned nodes), and a
+            # stale length would trip the deletion check on the next
+            # delta, degrading every future update to a full rebuild.
             self.allocs_index = new_allocs_index
+            self.table_len = len(allocs)
             return self
         if len(rows) > max(64, self.n_real // 4):
             return None  # full rebuild is cheaper

@@ -380,8 +380,9 @@ class RaftNode:
             # once the leader actually dies (membership-based denial
             # would deadlock elections when the only up-to-date
             # survivors are servers a lagging voter hasn't learned of).
+            me_as_leader = self.state == LEADER
             if (
-                self.leader_id is not None
+                (self.leader_id is not None or me_as_leader)
                 and args["candidate_id"] != self.leader_id
                 and time.monotonic() - self._last_leader_contact
                 < ELECTION_TIMEOUT_MIN
@@ -648,6 +649,12 @@ class RaftNode:
                 return
             if self.state != LEADER:
                 return
+            # A same-term response from a member is cluster contact: it
+            # keeps the LEADER'S vote-stickiness window fresh, so a
+            # removed server's endless campaigns cannot depose a leader
+            # that is still replicating (followers get their window from
+            # receiving these appends; the leader gets it from the ACKs).
+            self._last_leader_contact = time.monotonic()
             if resp.get("success"):
                 if entries:
                     self.match_index[peer] = entries[-1].index

@@ -161,6 +161,38 @@ def test_explicit_node_subsets_do_not_collide(cluster):
     assert ma2.base_token == ma.base_token
 
 
+def test_off_set_creations_keep_delta_path_alive(cluster):
+    """Alloc creations OUTSIDE the family's node set must rekey without
+    poisoning table_len — a stale length tripped the deletion check and
+    degraded every later delta to a full rebuild."""
+    store, job, nodes, allocs, index = cluster
+    far = mock.node()
+    far.datacenter = "dc-elsewhere"
+    far.compute_class()
+    index += 1
+    store.upsert_node(index, far)
+    far_job = mock.job()
+    far_job.id = "far"
+    m = ClusterMatrix(store.snapshot(), job)
+    token = m.base_token
+    for step in range(5):
+        # Creation on the out-of-set node: rekey (token unchanged) ...
+        index += 1
+        store.upsert_allocs(index, [make_alloc(far, far_job)])
+        m = ClusterMatrix(store.snapshot(), job)
+        assert m.base_token == token, f"rekey broke at step {step}"
+    # ... and an in-set change afterwards still takes the DELTA path
+    # (correct base, new token) rather than a full rebuild with drift.
+    index += 1
+    store.upsert_allocs(index, [make_alloc(nodes[2], job, cpu=75)])
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    assert m2.base_token != token
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+
+
 def test_chained_deltas_stay_correct(cluster):
     """Repeated small changes (the live pipeline's per-apply churn)
     accumulate through chained delta updates without drift."""

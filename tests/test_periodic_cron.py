@@ -1,0 +1,129 @@
+"""Cron schedule + periodic dispatch tests (reference:
+nomad/periodic.go:135 PeriodicDispatch, periodic_test.go, and the
+cronexpr semantics structs.PeriodicConfig.Next relies on)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.periodic import derive_job
+from nomad_tpu.structs import PeriodicConfig, consts
+from nomad_tpu.utils.cron import CronSchedule
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def at(y, mo, d, h, mi):
+    return time.mktime((y, mo, d, h, mi, 0, 0, 0, -1))
+
+
+class TestCronSchedule:
+    def test_every_minute(self):
+        s = CronSchedule("* * * * *")
+        t = at(2026, 7, 30, 12, 0)
+        assert s.next_after(t) == at(2026, 7, 30, 12, 1)
+
+    def test_step_minutes(self):
+        s = CronSchedule("*/15 * * * *")
+        assert s.next_after(at(2026, 7, 30, 12, 1)) == at(2026, 7, 30, 12, 15)
+        assert s.next_after(at(2026, 7, 30, 12, 46)) == at(2026, 7, 30, 13, 0)
+
+    def test_fixed_daily_time(self):
+        s = CronSchedule("30 3 * * *")
+        assert s.next_after(at(2026, 7, 30, 4, 0)) == at(2026, 7, 31, 3, 30)
+        assert s.next_after(at(2026, 7, 30, 2, 0)) == at(2026, 7, 30, 3, 30)
+
+    def test_lists_and_ranges(self):
+        s = CronSchedule("0 9-11,14 * * *")
+        assert s.next_after(at(2026, 7, 30, 9, 30)) == at(2026, 7, 30, 10, 0)
+        assert s.next_after(at(2026, 7, 30, 12, 0)) == at(2026, 7, 30, 14, 0)
+
+    def test_day_of_week(self):
+        # 2026-07-30 is a Thursday; next Monday (dow 1) is 2026-08-03.
+        s = CronSchedule("0 0 * * 1")
+        assert s.next_after(at(2026, 7, 30, 1, 0)) == at(2026, 8, 3, 0, 0)
+
+    def test_dom_dow_either_matches(self):
+        # Standard cron: restricted dom AND dow -> either matches.
+        # July 31 (dom) OR next Monday Aug 3 — dom comes first.
+        s = CronSchedule("0 0 31 * 1")
+        assert s.next_after(at(2026, 7, 30, 1, 0)) == at(2026, 7, 31, 0, 0)
+
+    def test_month_rollover(self):
+        s = CronSchedule("0 0 1 9 *")  # Sept 1st
+        assert s.next_after(at(2026, 7, 30, 0, 0)) == at(2026, 9, 1, 0, 0)
+
+    def test_invalid_specs_rejected(self):
+        for bad in ("* * * *", "61 * * * *", "*/0 * * * *", "x * * * *"):
+            with pytest.raises(ValueError):
+                CronSchedule(bad)
+
+
+class TestPeriodicDispatch:
+    def periodic_job(self, spec="* * * * *"):
+        job = mock.job()
+        job.periodic = PeriodicConfig(enabled=True, spec=spec)
+        job.type = "batch"
+        return job
+
+    def test_derive_job_naming(self):
+        """Child ids are <parent>/periodic-<epoch> (periodic.go:400)."""
+        parent = self.periodic_job()
+        launch = at(2026, 7, 30, 12, 0)
+        child = derive_job(parent, launch)
+        assert child.id == f"{parent.id}/periodic-{int(launch)}"
+        assert child.parent_id == parent.id
+        assert child.periodic is None  # children are not periodic
+
+    def test_register_tracks_and_force_runs(self):
+        server = Server(ServerConfig(num_schedulers=0))
+        server.start()
+        try:
+            job = self.periodic_job(spec="0 0 1 1 *")  # far future
+            server.job_register(job)
+            assert any(j.id == job.id for j in server.periodic.tracked())
+            # Periodic parents get no immediate eval; force creates the
+            # child + its eval (Periodic.Force endpoint).
+            child_id = server.periodic.force_run(job.id)
+            assert child_id and child_id.startswith(f"{job.id}/periodic-")
+            child = server.fsm.state.job_by_id(child_id)
+            assert child is not None and child.parent_id == job.id
+            assert server.fsm.state.evals_by_job(child_id)
+        finally:
+            server.shutdown()
+
+    def test_deregister_untracks(self):
+        server = Server(ServerConfig(num_schedulers=0))
+        server.start()
+        try:
+            job = self.periodic_job(spec="0 0 1 1 *")
+            server.job_register(job)
+            server.job_deregister(job.id)
+            assert not any(j.id == job.id for j in server.periodic.tracked())
+        finally:
+            server.shutdown()
+
+    def test_leader_loss_stops_dispatch(self):
+        server = Server(ServerConfig(num_schedulers=0))
+        server.start()
+        try:
+            job = self.periodic_job(spec="0 0 1 1 *")
+            server.job_register(job)
+            server.revoke_leadership()
+            assert not server.periodic.tracked()
+            # Re-election restores tracking from state (leader.go
+            # restore semantics).
+            server.establish_leadership()
+            assert wait_until(lambda: any(
+                j.id == job.id for j in server.periodic.tracked()))
+        finally:
+            server.shutdown()

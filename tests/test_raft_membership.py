@@ -116,11 +116,17 @@ def test_remove_peer_shrinks_quorum():
         victim = next(n for n in nodes if n is not leader)
         leader.remove_peer(victim.node_id)
         assert victim.node_id not in leader.stats()["members"]
+        term_after_remove = leader.stats()["term"]
         # The removed node never hears about the config (the leader
         # stops replicating to it) — its election timeouts must NOT
-        # depose the live leader: members deny votes to non-members.
-        time.sleep(0.8)  # several election timeouts
+        # depose the live leader: leader-stickiness denies its votes on
+        # followers AND on the leader itself (whose window is kept
+        # fresh by append ACKs). A deposed-and-rewon leader would show
+        # up as term inflation even if is_leader() flickers back true.
+        time.sleep(1.0)  # several election timeouts
         assert leader.is_leader()
+        assert leader.stats()["term"] == term_after_remove, \
+            "removed server's campaigns inflated the term (deposed leader)"
         # Disconnect the removed node entirely: with a 2-member config
         # the surviving pair still commits (proves quorum shrank — in a
         # fixed 3-set, 2 nodes could still commit, so also check the
