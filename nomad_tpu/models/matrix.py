@@ -57,7 +57,8 @@ _BASE_TOKENS = __import__("itertools").count(1)
 class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
-                 "alloc_groups", "token", "allocs_index", "table_len")
+                 "alloc_groups", "token", "allocs_index", "table_len",
+                 "delta_parent")
 
     def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
                  table_len: int = -1):
@@ -69,6 +70,11 @@ class _ClusterBase:
         # to the modify_index scan, so a shrinking table forces a full
         # rebuild (see delta_update).
         self.table_len = table_len
+        # (parent_token, changed_rows) when this base was produced by
+        # delta_update: the batcher uses it to scatter-update the
+        # parent's device-cached arrays instead of re-uploading
+        # (ops/binpack.py apply_base_delta).
+        self.delta_parent = None
         self.n_real = len(nodes)
         self.n = bucket_size(self.n_real)
         n = self.n
@@ -157,6 +163,7 @@ class _ClusterBase:
         new.token = next(_BASE_TOKENS)
         new.allocs_index = new_allocs_index
         new.table_len = len(allocs)
+        new.delta_parent = (self.token, tuple(rows))
         new.n_real, new.n = self.n_real, self.n
         new.capacity = self.capacity.copy()
         new.sched_capacity = self.sched_capacity.copy()
@@ -300,6 +307,7 @@ class ClusterMatrix:
         # Share the immutable base arrays; the kernel never mutates its
         # inputs (functional scan carries copies).
         self.base_token = base.token
+        self.base_delta = base.delta_parent
         self.capacity = base.capacity
         self.sched_capacity = base.sched_capacity
         self.util = base.util

@@ -131,6 +131,25 @@ def host_prng_key(seed: int) -> "_np.ndarray":
     return _np.array([0, _np.uint32(seed & 0xFFFFFFFF)], _np.uint32)
 
 
+@jax.jit
+def apply_base_delta(util, bw_used, ports_free, rows,
+                     util_rows, bw_rows, ports_rows):
+    """Scatter-update the mutable arrays of a device-resident cluster
+    base with recomputed node rows. Plan applies touch a handful of
+    nodes; shipping those rows (a few hundred bytes) and updating on
+    device beats re-uploading the full [N,4] base per snapshot — the
+    device-side half of models/matrix.py's incremental delta path.
+    Padding duplicates the first changed row (same value, so the
+    duplicate-index scatter is benign); capacity/bandwidth-avail/
+    node_ok never change with allocs and keep the parent's device
+    arrays by reference."""
+    return (
+        util.at[rows].set(util_rows),
+        bw_used.at[rows].set(bw_rows),
+        ports_free.at[rows].set(ports_rows),
+    )
+
+
 def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,
                     job_dh, tg_dh_all, config: PlacementConfig, noise):
     """One placement's dense pass: feasibility mask + score over all N

@@ -54,16 +54,17 @@ MAX_INFLIGHT = 3
 
 
 class _Request:
-    __slots__ = ("token", "base", "overlay", "asks", "key", "event",
-                 "choices", "scores", "error")
+    __slots__ = ("token", "base", "overlay", "asks", "key", "delta",
+                 "event", "choices", "scores", "error")
 
-    def __init__(self, token, base, overlay, asks, key):
+    def __init__(self, token, base, overlay, asks, key, delta=None):
         self.token = token  # cluster-base identity, None = unshared
         self.base = base  # (capacity, sched_capacity, util, bw_avail,
         #                    bw_used, ports_free, node_ok)
         self.overlay = overlay  # (job_count, tg_count, feasible)
         self.asks = asks
         self.key = key
+        self.delta = delta  # (parent_token, changed_rows) or None
         self.event = threading.Event()
         self.choices = None
         self.scores = None
@@ -89,9 +90,14 @@ class PlacementBatcher:
         self._queues: Dict[Tuple, List[_Request]] = {}
         self._dispatchers: Dict[Tuple, int] = {}  # live dispatchers/shape
         self._device_bases: "OrderedDict[object, tuple]" = OrderedDict()  # token -> device arrays
+        # token -> Event while an upload/derivation is in progress:
+        # overlapped dispatchers on one token must not each pay the
+        # transfer this cache exists to avoid.
+        self._base_pending: Dict[object, threading.Event] = {}
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
         self.base_uploads = 0  # cluster-base host->device transfers
+        self.base_delta_updates = 0  # bases derived on-device from a parent
         self.overlay_dispatches = 0  # dispatches via the shared-base path
 
     def place(self, state, asks, rng_key, config):
@@ -119,7 +125,8 @@ class PlacementBatcher:
             np.shape(state.capacity), np.shape(asks.resources),
             np.shape(state.feasible)[-1], config, token,
         )
-        req = _Request(token, base, overlay, asks, rng_key)
+        req = _Request(token, base, overlay, asks, rng_key,
+                       delta=getattr(state, "base_delta", None))
         run_dispatch = False
         with self._lock:
             self._queues.setdefault(shape_key, []).append(req)
@@ -138,24 +145,74 @@ class PlacementBatcher:
 
     # ------------------------------------------------------------------
 
-    def _device_base(self, token, base):
-        """One host->device upload per cluster base, LRU-cached."""
+    def _device_base(self, token, base, delta=None):
+        """One host->device upload per cluster base, LRU-cached. When
+        the base was delta-derived from a parent that is still on
+        device, only the changed rows cross host->device and a scatter
+        program derives the new base there (ops/binpack.py
+        apply_base_delta) — a few hundred bytes instead of the full
+        [N,4]x7 matrices."""
+        while True:
+            with self._lock:
+                cached = self._device_bases.get(token)
+                if cached is not None:
+                    # True LRU: a hit refreshes recency, so alternating
+                    # hot snapshots don't thrash the eviction order.
+                    self._device_bases.move_to_end(token)
+                    return cached
+                pending = self._base_pending.get(token)
+                if pending is None:
+                    # We are the builder.
+                    done = threading.Event()
+                    self._base_pending[token] = done
+                    break
+            # Another dispatcher is building this base: wait for its
+            # cache insert instead of paying a duplicate transfer.
+            pending.wait(30.0)
+        try:
+            dev = self._build_device_base(token, base, delta)
+        finally:
+            with self._lock:
+                self._base_pending.pop(token, None)
+            done.set()
+        return dev
+
+    def _build_device_base(self, token, base, delta):
         import jax
 
-        with self._lock:
-            cached = self._device_bases.get(token)
-            if cached is not None:
-                # True LRU: a hit refreshes recency, so alternating hot
-                # snapshots don't thrash the eviction order.
-                self._device_bases.move_to_end(token)
-        if cached is not None:
-            return cached
-        dev = tuple(jax.device_put(np.asarray(x)) for x in base)
+        dev = None
+        if delta is not None:
+            parent_token, rows = delta
+            with self._lock:
+                parent = self._device_bases.get(parent_token)
+            if parent is not None and rows:
+                from ..ops.binpack import apply_base_delta
+
+                # Pad the row count to a power of two (every distinct
+                # length is a compile); padding repeats the FIRST
+                # CHANGED row, and a duplicate-index set writing the
+                # identical value is benign.
+                k = 1 << (len(rows) - 1).bit_length()
+                rows_p = np.full(k, rows[0], np.int32)
+                rows_p[: len(rows)] = rows
+                util2, bw2, ports2 = apply_base_delta(
+                    parent[2], parent[4], parent[5], rows_p,
+                    np.asarray(base[2])[rows_p],
+                    np.asarray(base[4])[rows_p],
+                    np.asarray(base[5])[rows_p],
+                )
+                # capacity/sched_capacity/bw_avail/node_ok never change
+                # with allocs: share the parent's device arrays.
+                dev = (parent[0], parent[1], util2, parent[3],
+                       bw2, ports2, parent[6])
+                self.base_delta_updates += 1
+        if dev is None:
+            dev = tuple(jax.device_put(np.asarray(x)) for x in base)
+            self.base_uploads += 1
         with self._lock:
             while len(self._device_bases) >= DEVICE_BASE_CACHE:
                 self._device_bases.popitem(last=False)
             self._device_bases[token] = dev
-        self.base_uploads += 1
         return dev
 
     def _run_batch(self, batch: List[_Request], config) -> None:
@@ -195,7 +252,7 @@ class PlacementBatcher:
         if token is not None and all(r.token == token for r in batch):
             # Shared-base fast path: base cached on device, only the
             # per-job overlays cross host->device this dispatch.
-            dev = self._device_base(token, batch[0].base)
+            dev = self._device_base(token, batch[0].base, batch[0].delta)
             state = NodeState(
                 capacity=dev[0], sched_capacity=dev[1], util=dev[2],
                 bw_avail=dev[3], bw_used=dev[4], ports_free=dev[5],
@@ -303,6 +360,7 @@ class PlacementBatcher:
             "dispatches": self.dispatches,
             "batched_requests": self.batched_requests,
             "base_uploads": self.base_uploads,
+            "base_delta_updates": self.base_delta_updates,
             "overlay_dispatches": self.overlay_dispatches,
         }
 

@@ -145,6 +145,48 @@ def test_mixed_tokens_fall_back_to_full_state_path():
         np.testing.assert_array_equal(ci, direct(si, build_asks(), ki)[0])
 
 
+def test_delta_derived_base_updates_on_device():
+    """A base delta-derived from a device-cached parent ships only the
+    changed rows; the scatter program produces results identical to a
+    full upload (ops/binpack.py apply_base_delta)."""
+    b = PlacementBatcher(window=0.001)
+    asks = build_asks()
+    s1 = build_state(token="parent", job_seed=0)
+    b.place(s1, asks, jax.random.PRNGKey(1), CONFIG)
+    assert b.base_uploads == 1 and b.base_delta_updates == 0
+
+    # Child snapshot: rows 3 and 17 changed (allocs landed there).
+    s2 = build_state(token="child", job_seed=0)
+    for f in ("capacity", "sched_capacity", "bw_avail", "node_ok"):
+        setattr(s2, f, getattr(s1, f))  # node-level arrays unchanged
+    s2.util = s1.util.copy()
+    s2.util[3] += [500, 256, 150, 0]
+    s2.util[17] += [1000, 512, 300, 0]
+    s2.bw_used = s1.bw_used.copy()
+    s2.bw_used[3] += 50.0
+    s2.ports_free = s1.ports_free.copy()
+    s2.ports_free[17] -= 2.0
+    s2.base_delta = ("parent", (3, 17))
+
+    key = jax.random.PRNGKey(2)
+    choices, scores = b.place(s2, asks, key, CONFIG)
+    assert b.base_uploads == 1, "delta path still did a full upload"
+    assert b.base_delta_updates == 1
+    dc, ds = direct(s2, asks, key)
+    np.testing.assert_array_equal(choices, dc)
+    np.testing.assert_allclose(scores, ds, rtol=1e-5)
+
+    # Parent evicted from the device cache -> delta falls back to a
+    # full upload rather than failing.
+    b2 = PlacementBatcher(window=0.001)
+    s3 = build_state(token="orphan", job_seed=1)
+    s3.base_delta = ("no-such-parent", (1, 2))
+    c3, _ = b2.place(s3, asks, jax.random.PRNGKey(3), CONFIG)
+    assert b2.base_uploads == 1 and b2.base_delta_updates == 0
+    np.testing.assert_array_equal(
+        c3, direct(s3, asks, jax.random.PRNGKey(3))[0])
+
+
 def test_device_base_cache_is_true_lru(monkeypatch):
     """Eviction follows recency, not insertion: A,B then A,C (cache=2)
     must evict B, so a final A costs no upload (round-2 FIFO thrashed:
