@@ -38,6 +38,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 MAX_BATCH = 64
+# Node count at which the device-cached base shards across a multi-chip
+# mesh (node axis over ICI, parallel/mesh.py): below this, per-chip
+# matrices are too small to beat the collective the sharded argmax
+# inserts. Single-device runs never shard.
+SHARD_MIN_NODES = 2048
 # Idle-batcher accumulation window. Sized for the drain-to-batch storm:
 # a drained group's place() calls arrive staggered by the GIL-serialized
 # host phases (~2-4ms each), so a too-small window ships a near-empty
@@ -94,6 +99,8 @@ class PlacementBatcher:
         # overlapped dispatchers on one token must not each pay the
         # transfer this cache exists to avoid.
         self._base_pending: Dict[object, threading.Event] = {}
+        self._mesh = None  # lazily built; False = single device
+        self.sharded_bases = 0  # bases resident sharded across the mesh
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
         self.base_uploads = 0  # cluster-base host->device transfers
@@ -177,6 +184,25 @@ class PlacementBatcher:
             done.set()
         return dev
 
+    def _base_mesh(self, n: int):
+        """nodes-axis mesh for big clusters on multi-device backends
+        (one mesh per process; None on a single chip or small N)."""
+        if n < SHARD_MIN_NODES:
+            return None
+        if self._mesh is None:
+            import jax
+
+            if jax.device_count() > 1:
+                from ..parallel.mesh import make_mesh
+
+                self._mesh = make_mesh(dp=1)
+            else:
+                self._mesh = False
+        mesh = self._mesh or None
+        if mesh is not None and n % mesh.shape["nodes"]:
+            return None  # bucketing should prevent this; stay safe
+        return mesh
+
     def _build_device_base(self, token, base, delta):
         import jax
 
@@ -205,11 +231,42 @@ class PlacementBatcher:
                 # with allocs: share the parent's device arrays.
                 dev = (parent[0], parent[1], util2, parent[3],
                        bw2, ports2, parent[6])
-                self.base_delta_updates += 1
+        delta_derived = dev is not None
+        sharded = False
         if dev is None:
-            dev = tuple(jax.device_put(np.asarray(x)) for x in base)
-            self.base_uploads += 1
+            mesh = self._base_mesh(np.shape(base[0])[0])
+            if mesh is not None:
+                # Big cluster on a multi-chip mesh: the base lives
+                # sharded over the node axis (ICI); GSPMD propagates the
+                # sharding through the dispatch, lowering the masked
+                # argmax to a cross-chip reduction. Specs come from
+                # parallel/mesh.py so the cached base's layout can't
+                # drift from what the sharded dispatch expects.
+                from jax.sharding import NamedSharding
+
+                from ..parallel.mesh import _node_state_specs
+
+                specs = _node_state_specs(batched=False)
+                base_specs = (specs.capacity, specs.sched_capacity,
+                              specs.util, specs.bw_avail, specs.bw_used,
+                              specs.ports_free, specs.node_ok)
+                dev = tuple(
+                    jax.device_put(np.asarray(x), NamedSharding(mesh, s))
+                    for x, s in zip(base, base_specs)
+                )
+                sharded = True
+            else:
+                dev = tuple(jax.device_put(np.asarray(x)) for x in base)
         with self._lock:
+            # Counters under the lock: builders of DIFFERENT tokens run
+            # concurrently (the pending guard is per token) and += is
+            # not atomic across a GIL switch.
+            if delta_derived:
+                self.base_delta_updates += 1
+            else:
+                self.base_uploads += 1
+                if sharded:
+                    self.sharded_bases += 1
             while len(self._device_bases) >= DEVICE_BASE_CACHE:
                 self._device_bases.popitem(last=False)
             self._device_bases[token] = dev
@@ -362,6 +419,7 @@ class PlacementBatcher:
             "base_uploads": self.base_uploads,
             "base_delta_updates": self.base_delta_updates,
             "overlay_dispatches": self.overlay_dispatches,
+            "sharded_bases": self.sharded_bases,
         }
 
 

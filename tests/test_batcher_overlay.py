@@ -187,6 +187,56 @@ def test_delta_derived_base_updates_on_device():
         c3, direct(s3, asks, jax.random.PRNGKey(3))[0])
 
 
+def test_large_cluster_base_shards_across_mesh():
+    """At SHARD_MIN_NODES+ on a multi-device backend (the virtual
+    8-CPU mesh from conftest), the device-cached base shards over the
+    node axis and dispatch results still match the unsharded oracle —
+    the live-path integration of parallel/mesh.py."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("single-device backend")
+    n = batcher_mod.SHARD_MIN_NODES
+    b = PlacementBatcher(window=0.001)
+    asks = build_asks()
+    s1 = build_state(n=n, token="bigA", job_seed=0)
+    key = jax.random.PRNGKey(7)
+    choices, scores = b.place(s1, asks, key, CONFIG)
+    assert b.sharded_bases == 1
+    dev = b._device_bases["bigA"]
+    assert len(dev[0].sharding.device_set) == jax.device_count()
+    dc, ds = direct(s1, asks, key)
+    np.testing.assert_array_equal(choices, dc)
+    np.testing.assert_allclose(scores, ds, rtol=1e-5)
+
+    # Device-side delta on a SHARDED parent: scatter runs under GSPMD,
+    # result matches the oracle, no full re-upload.
+    s2 = build_state(n=n, token="bigB", job_seed=0)
+    for f in ("capacity", "sched_capacity", "bw_avail", "node_ok"):
+        setattr(s2, f, getattr(s1, f))
+    s2.util = s1.util.copy()
+    s2.util[1234] += [500, 256, 150, 0]
+    s2.bw_used = s1.bw_used.copy()
+    s2.ports_free = s1.ports_free.copy()
+    s2.base_delta = ("bigA", (1234,))
+    uploads_before = b.base_uploads
+    key2 = jax.random.PRNGKey(8)
+    c2, _ = b.place(s2, asks, key2, CONFIG)
+    assert b.base_uploads == uploads_before
+    assert b.base_delta_updates == 1
+    np.testing.assert_array_equal(c2, direct(s2, asks, key2)[0])
+
+
+def test_small_cluster_base_stays_unsharded():
+    import jax
+
+    b = PlacementBatcher(window=0.001)
+    asks = build_asks()
+    s = build_state(n=128, token="small", job_seed=0)
+    b.place(s, asks, jax.random.PRNGKey(1), CONFIG)
+    assert b.sharded_bases == 0
+
+
 def test_device_base_cache_is_true_lru(monkeypatch):
     """Eviction follows recency, not insertion: A,B then A,C (cache=2)
     must evict B, so a final A costs no upload (round-2 FIFO thrashed:
