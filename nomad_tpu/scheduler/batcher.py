@@ -100,7 +100,9 @@ class PlacementBatcher:
         # transfer this cache exists to avoid.
         self._base_pending: Dict[object, threading.Event] = {}
         self._mesh = None  # lazily built; False = single device
-        self.sharded_bases = 0  # bases resident sharded across the mesh
+        # Bases made device-resident SHARDED across the mesh — full
+        # uploads and delta-derivations from a sharded parent alike.
+        self.sharded_bases = 0
         self.dispatches = 0  # observability: device calls issued
         self.batched_requests = 0  # requests served
         self.base_uploads = 0  # cluster-base host->device transfers
@@ -232,7 +234,8 @@ class PlacementBatcher:
                 dev = (parent[0], parent[1], util2, parent[3],
                        bw2, ports2, parent[6])
         delta_derived = dev is not None
-        sharded = False
+        # Delta children of a sharded parent are themselves sharded.
+        sharded = delta_derived and len(dev[0].sharding.device_set) > 1
         if dev is None:
             mesh = self._base_mesh(np.shape(base[0])[0])
             if mesh is not None:
@@ -244,15 +247,11 @@ class PlacementBatcher:
                 # drift from what the sharded dispatch expects.
                 from jax.sharding import NamedSharding
 
-                from ..parallel.mesh import _node_state_specs
+                from ..parallel.mesh import base_specs
 
-                specs = _node_state_specs(batched=False)
-                base_specs = (specs.capacity, specs.sched_capacity,
-                              specs.util, specs.bw_avail, specs.bw_used,
-                              specs.ports_free, specs.node_ok)
                 dev = tuple(
                     jax.device_put(np.asarray(x), NamedSharding(mesh, s))
-                    for x, s in zip(base, base_specs)
+                    for x, s in zip(base, base_specs())
                 )
                 sharded = True
             else:
@@ -265,8 +264,8 @@ class PlacementBatcher:
                 self.base_delta_updates += 1
             else:
                 self.base_uploads += 1
-                if sharded:
-                    self.sharded_bases += 1
+            if sharded:
+                self.sharded_bases += 1
             while len(self._device_bases) >= DEVICE_BASE_CACHE:
                 self._device_bases.popitem(last=False)
             self._device_bases[token] = dev

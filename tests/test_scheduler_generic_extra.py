@@ -1,0 +1,207 @@
+"""Additional GenericScheduler golden scenarios mirrored from
+scheduler/generic_sched_test.go rows not yet covered directly:
+disk constraints, rolling updates with stagger follow-ups, drained+down
+nodes, blocked-eval-on-finished-job, batch re-run, and drain honoring
+the update strategy."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import UpdateStrategy, consts, new_eval
+from nomad_tpu.utils.ids import generate_uuid
+
+# Every scenario runs on the host pipeline AND the dense (TPU) factory:
+# identical control flow is the parity contract (scheduler/tpu.py).
+service = pytest.fixture(params=["service", "service-tpu"])(
+    lambda request: request.param)
+batch = pytest.fixture(params=["batch", "batch-tpu"])(
+    lambda request: request.param)
+
+
+def seed_nodes(h, count):
+    nodes = []
+    for _ in range(count):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return nodes
+
+
+def alloc_for(job, node, index):
+    tg = job.task_groups[0]
+    a = mock.alloc()
+    a.id = generate_uuid()
+    a.job = job
+    a.job_id = job.id
+    a.node_id = node.id
+    a.task_group = tg.name
+    a.name = f"{job.name}.{tg.name}[{index}]"
+    a.resources = tg.tasks[0].resources.copy()
+    a.task_resources = {tg.tasks[0].name: tg.tasks[0].resources.copy()}
+    return a
+
+
+def place_running(h, job, nodes):
+    """Seed one running alloc per count on the given nodes. The STORED
+    job backs the allocs (upsert canonicalizes; a stale object would
+    read as a destructive update)."""
+    stored = h.state.job_by_id(job.id)
+    allocs = []
+    for i in range(stored.task_groups[0].count):
+        a = alloc_for(stored, nodes[i % len(nodes)], i)
+        a.client_status = consts.ALLOC_CLIENT_RUNNING
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+    return allocs
+
+
+def test_job_register_disk_constraints(service):
+    """TestServiceSched_JobRegister_DiskConstraints: an ephemeral disk
+    bigger than any node blocks the whole job."""
+    h = Harness(seed=3)
+    nodes = seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].ephemeral_disk.size_mb = (
+        nodes[0].resources.disk_mb * 10)
+    h.state.upsert_job(h.next_index(), job)
+    h.process(service, new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert not h.state.allocs_by_job(job.id)
+    assert len(h.create_evals) == 1
+    assert h.create_evals[0].status == consts.EVAL_STATUS_BLOCKED
+    update = h.evals[0]
+    assert update.failed_tg_allocs
+    metrics = update.failed_tg_allocs[job.task_groups[0].name]
+    # Nodes were feasible but exhausted on resources.
+    assert metrics.nodes_evaluated > 0
+
+
+def test_job_modify_rolling_creates_follow_up_eval(service):
+    """TestServiceSched_JobModify_Rolling: with update{stagger,
+    max_parallel}, one pass replaces at most max_parallel allocs and
+    creates a wait-staggered follow-up eval."""
+    h = Harness(seed=4)
+    nodes = seed_nodes(h, 10)
+    job = mock.job()
+    job.task_groups[0].count = 10
+    h.state.upsert_job(h.next_index(), job)
+    place_running(h, job, nodes)
+
+    job2 = mock.job()
+    job2.id = job.id
+    job2.name = job.name
+    job2.task_groups[0].count = 10
+    job2.update = UpdateStrategy(stagger=30.0, max_parallel=3)
+    job2.task_groups[0].tasks[0].env = {"V": "2"}  # destructive change
+    h.state.upsert_job(h.next_index(), job2)
+
+    h.process(service, new_eval(job2, consts.EVAL_TRIGGER_JOB_REGISTER))
+    plan = h.plans[0]
+    evictions = sum(len(v) for v in plan.node_update.values())
+    placements = sum(len(v) for v in plan.node_allocation.values())
+    assert evictions == 3  # bounded by max_parallel
+    assert placements == 3
+    # Follow-up rolling eval with the stagger as wait.
+    follow = [e for e in h.create_evals
+              if e.triggered_by == consts.EVAL_TRIGGER_ROLLING_UPDATE]
+    assert len(follow) == 1
+    assert follow[0].wait == 30.0
+    assert follow[0].job_id == job.id
+
+
+def test_node_drain_down_lost_not_migrated(service):
+    """TestServiceSched_NodeDrain_Down: a node that is BOTH draining and
+    down loses its allocs (client can't stop them gracefully); the
+    replacements land elsewhere and the lost allocs are marked lost."""
+    h = Harness(seed=5)
+    nodes = seed_nodes(h, 6)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    place_running(h, job, nodes[:1])  # both allocs on node 0
+
+    nodes[0].drain = True
+    nodes[0].status = consts.NODE_STATUS_DOWN
+    h.state.upsert_node(h.next_index(), nodes[0])
+
+    h.process(service, new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    plan = h.plans[0]
+    stops = [a for v in plan.node_update.values() for a in v]
+    assert len(stops) == 2
+    assert all(a.client_status == consts.ALLOC_CLIENT_LOST for a in stops)
+    out = [a for a in h.state.allocs_by_job(job.id)
+           if a.desired_status == consts.ALLOC_DESIRED_RUN
+           and a.node_id != nodes[0].id]
+    assert len(out) == 2
+
+
+def test_node_drain_honors_update_strategy(service):
+    """TestServiceSched_NodeDrain_UpdateStrategy: migrations off a
+    drained node are paced by update.max_parallel with a follow-up
+    rolling eval."""
+    h = Harness(seed=6)
+    nodes = seed_nodes(h, 8)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.update = UpdateStrategy(stagger=30.0, max_parallel=2)
+    h.state.upsert_job(h.next_index(), job)
+    place_running(h, job, nodes[:1])  # all on node 0
+
+    nodes[0].drain = True
+    h.state.upsert_node(h.next_index(), nodes[0])
+
+    h.process(service, new_eval(job, consts.EVAL_TRIGGER_NODE_UPDATE))
+    plan = h.plans[0]
+    stops = sum(len(v) for v in plan.node_update.values())
+    assert stops == 2  # paced by max_parallel
+    follow = [e for e in h.create_evals
+              if e.triggered_by == consts.EVAL_TRIGGER_ROLLING_UPDATE]
+    assert len(follow) == 1
+
+
+def test_blocked_eval_on_satisfied_job_is_noop(service):
+    """TestServiceSched_EvaluateBlockedEval_Finished: a blocked eval for
+    a job that is already fully placed completes without a plan and
+    without re-blocking."""
+    h = Harness(seed=7)
+    nodes = seed_nodes(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    place_running(h, job, nodes)
+
+    blocked = new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER)
+    blocked.status = consts.EVAL_STATUS_BLOCKED
+    h.process(service, blocked)
+    assert not h.plans  # nothing to do
+    assert not h.reblock_evals
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    assert h.evals[0].queued_allocations.get(job.task_groups[0].name, 0) == 0
+
+
+def test_batch_rerun_of_finished_job_places_nothing(batch):
+    """TestBatchSched_ReRun_SuccessfullyFinishedAlloc: re-evaluating a
+    batch job whose allocs completed successfully must not run them
+    again."""
+    h = Harness(seed=8)
+    nodes = seed_nodes(h, 4)
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    from nomad_tpu.structs import TaskState
+
+    stored = h.state.job_by_id(job.id)
+    allocs = []
+    for i in range(2):
+        a = alloc_for(stored, nodes[i], i)
+        a.client_status = consts.ALLOC_CLIENT_COMPLETE
+        a.task_states = {"web": TaskState(
+            state=consts.TASK_STATE_DEAD, failed=False)}
+        allocs.append(a)
+    h.state.upsert_allocs(h.next_index(), allocs)
+
+    h.process(batch, new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert not h.plans
+    h.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
+    assert len(h.state.allocs_by_job(job.id)) == 2  # unchanged
