@@ -371,31 +371,37 @@ class RaftNode:
     def handle_request_vote(self, args: dict) -> dict:
         with self._lock:
             term = args["term"]
-            # Leader stickiness (dissertation §4.2.3, hashicorp/raft
-            # CheckQuorum): while we hear from a live leader, deny votes
-            # WITHOUT adopting the candidate's term. This is what stops
-            # a REMOVED server's election timeouts from deposing the
-            # leader (it never learns of its removal — replication to it
-            # stops at removal), while still letting any candidate win
-            # once the leader actually dies (membership-based denial
-            # would deadlock elections when the only up-to-date
-            # survivors are servers a lagging voter hasn't learned of).
-            me_as_leader = self.state == LEADER
-            if (
-                (self.leader_id is not None or me_as_leader)
-                and args["candidate_id"] != self.leader_id
-                and time.monotonic() - self._last_leader_contact
-                < ELECTION_TIMEOUT_MIN
-            ):
-                return {"term": self.current_term, "vote_granted": False}
-            if term < self.current_term:
-                return {"term": self.current_term, "vote_granted": False}
-            if term > self.current_term:
-                self._become_follower(term)
             up_to_date = (args["last_log_term"], args["last_log_index"]) >= (
                 self._last_log_term(),
                 self._last_log_index(),
             )
+            if args.get("prevote"):
+                # PreVote (dissertation §9.6, etcd PreVote): a candidate
+                # first asks whether an election is even warranted —
+                # NOTHING here mutates state, so a disruptive candidate
+                # (a REMOVED server that never learned of its removal,
+                # or a rejoining partitioned node) cannot inflate terms
+                # and depose a healthy leader unless a majority agrees
+                # the leader is gone. Grant iff the candidate's log
+                # qualifies AND we have not heard from a live leader
+                # within the minimum election timeout (the leader itself
+                # counts ACK receipt as contact).
+                leaderish = self.leader_id is not None or self.state == LEADER
+                heard_recently = (
+                    time.monotonic() - self._last_leader_contact
+                    < ELECTION_TIMEOUT_MIN
+                )
+                granted = (
+                    term >= self.current_term
+                    and up_to_date
+                    and not (leaderish and heard_recently
+                             and args["candidate_id"] != self.leader_id)
+                )
+                return {"term": self.current_term, "vote_granted": granted}
+            if term < self.current_term:
+                return {"term": self.current_term, "vote_granted": False}
+            if term > self.current_term:
+                self._become_follower(term)
             if self.voted_for in (None, args["candidate_id"]) and up_to_date:
                 self.voted_for = args["candidate_id"]
                 self._persist_meta()  # durable before the vote leaves
@@ -518,7 +524,21 @@ class RaftNode:
                     continue
                 if time.monotonic() < self._election_deadline:
                     continue
-                # timeout: stand for election
+                # Timeout: probe with a PreVote round BEFORE touching
+                # any state — only a majority agreeing the leader is
+                # gone justifies a term bump (disruption-free elections).
+                self._election_deadline = self._next_election_deadline()
+                probe_term = self.current_term + 1
+                last_idx, last_term = self._last_log_index(), self._last_log_term()
+            try:
+                if not self._prevote(probe_term, last_idx, last_term):
+                    continue
+            except Exception:  # noqa: BLE001 - the timer must survive
+                self.logger.exception("prevote failed")
+                continue
+            with self._lock:
+                if self.state == LEADER or self.removed:
+                    continue
                 self.state = CANDIDATE
                 self.current_term += 1
                 self.voted_for = self.node_id
@@ -530,6 +550,25 @@ class RaftNode:
                 self._campaign(term, last_idx, last_term)
             except Exception:  # noqa: BLE001 - the timer must survive
                 self.logger.exception("campaign failed")
+
+    def _prevote(self, term: int, last_idx: int, last_term: int) -> bool:
+        """True when a majority would vote for us at `term` — no state
+        anywhere changes during the probe."""
+        votes = 1  # we would vote for ourselves
+        args = {
+            "term": term,
+            "candidate_id": self.node_id,
+            "last_log_index": last_idx,
+            "last_log_term": last_term,
+            "prevote": True,
+        }
+        for peer in self.peers:
+            resp = self.transport.request_vote(peer, args)
+            if resp and resp.get("vote_granted"):
+                votes += 1
+            if votes * 2 > len(self.peers) + 1:
+                return True
+        return votes * 2 > len(self.peers) + 1
 
     def _campaign(self, term: int, last_idx: int, last_term: int) -> None:
         votes = 1
@@ -650,10 +689,11 @@ class RaftNode:
             if self.state != LEADER:
                 return
             # A same-term response from a member is cluster contact: it
-            # keeps the LEADER'S vote-stickiness window fresh, so a
-            # removed server's endless campaigns cannot depose a leader
-            # that is still replicating (followers get their window from
-            # receiving these appends; the leader gets it from the ACKs).
+            # keeps the LEADER'S recent-leader window fresh for PreVote
+            # denial, so a removed server's endless campaigns cannot
+            # depose a leader that is still replicating (followers get
+            # their window from receiving these appends; the leader
+            # gets it from the ACKs).
             self._last_leader_contact = time.monotonic()
             if resp.get("success"):
                 if entries:

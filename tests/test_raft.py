@@ -218,11 +218,14 @@ def test_cluster_leader_failover_restores_services():
         # kill the leader
         transport.disconnect(leader.node_id)
         remaining = [s for s in servers if s is not leader]
+        # Generous timeouts: under full-suite load the election +
+        # leader-service restoration can take several seconds of wall
+        # clock that are milliseconds on an idle host.
         assert wait_until(
-            lambda: any(s.is_leader() for s in remaining), timeout=6.0
+            lambda: any(s.is_leader() for s in remaining), timeout=20.0
         )
         new_leader = next(s for s in remaining if s.is_leader())
-        assert wait_until(lambda: new_leader.broker.enabled(), timeout=5.0)
+        assert wait_until(lambda: new_leader.broker.enabled(), timeout=15.0)
 
         # the new leader can schedule: register another job through it
         client2 = MockClient(new_leader)
@@ -234,7 +237,7 @@ def test_cluster_leader_failover_restores_services():
             assert wait_until(
                 lambda: (e := new_leader.fsm.state.eval_by_id(eval_id)) is not None
                 and e.status == consts.EVAL_STATUS_COMPLETE,
-                timeout=8.0,
+                timeout=20.0,
             )
             assert len(new_leader.fsm.state.allocs_by_job(job2.id)) == 1
         finally:

@@ -119,10 +119,11 @@ def test_remove_peer_shrinks_quorum():
         term_after_remove = leader.stats()["term"]
         # The removed node never hears about the config (the leader
         # stops replicating to it) — its election timeouts must NOT
-        # depose the live leader: leader-stickiness denies its votes on
-        # followers AND on the leader itself (whose window is kept
-        # fresh by append ACKs). A deposed-and-rewon leader would show
-        # up as term inflation even if is_leader() flickers back true.
+        # depose the live leader: PreVote denies its probes while any
+        # member heard from the leader recently (the leader's own
+        # window is kept fresh by append ACKs), so its term never
+        # bumps anyone. A deposed-and-rewon leader would show up as
+        # term inflation even if is_leader() flickers back true.
         time.sleep(1.0)  # several election timeouts
         assert leader.is_leader()
         assert leader.stats()["term"] == term_after_remove, \

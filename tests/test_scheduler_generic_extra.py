@@ -9,7 +9,10 @@ import pytest
 from nomad_tpu import mock
 from nomad_tpu.scheduler.testing import Harness
 from nomad_tpu.structs import UpdateStrategy, consts, new_eval
-from nomad_tpu.utils.ids import generate_uuid
+
+# Shared fixtures/helpers live with the base scenario file so the alloc
+# shape can't drift between the two golden suites.
+from test_scheduler_generic import alloc_for, seed_nodes  # noqa: E402
 
 # Every scenario runs on the host pipeline AND the dense (TPU) factory:
 # identical control flow is the parity contract (scheduler/tpu.py).
@@ -17,29 +20,6 @@ service = pytest.fixture(params=["service", "service-tpu"])(
     lambda request: request.param)
 batch = pytest.fixture(params=["batch", "batch-tpu"])(
     lambda request: request.param)
-
-
-def seed_nodes(h, count):
-    nodes = []
-    for _ in range(count):
-        n = mock.node()
-        h.state.upsert_node(h.next_index(), n)
-        nodes.append(n)
-    return nodes
-
-
-def alloc_for(job, node, index):
-    tg = job.task_groups[0]
-    a = mock.alloc()
-    a.id = generate_uuid()
-    a.job = job
-    a.job_id = job.id
-    a.node_id = node.id
-    a.task_group = tg.name
-    a.name = f"{job.name}.{tg.name}[{index}]"
-    a.resources = tg.tasks[0].resources.copy()
-    a.task_resources = {tg.tasks[0].name: tg.tasks[0].resources.copy()}
-    return a
 
 
 def place_running(h, job, nodes):
