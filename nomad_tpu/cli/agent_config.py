@@ -29,6 +29,14 @@ class ServerBlock:
     heartbeat_grace: str = ""
     retry_join: List[str] = field(default_factory=list)
     start_join: List[str] = field(default_factory=list)
+    # Per-type factory overrides, e.g. { "service" = "service-tpu" } —
+    # finer-grained than the all-or-nothing -tpu flag.
+    scheduler_factories: Dict[str, str] = field(default_factory=dict)
+    # Drain-to-batch tuning (server/config.py): max evals drained per
+    # broker visit for dense factories, and the group size below which
+    # latency-aware routing sends evals to the host pipeline.
+    eval_batch_size: Optional[int] = None
+    dense_min_batch: Optional[int] = None
 
 
 @dataclass
@@ -160,6 +168,7 @@ _SCHEMA: Dict[str, Any] = {
     "server.num_schedulers": int, "server.enabled_schedulers": _str_list,
     "server.node_gc_threshold": str, "server.heartbeat_grace": str,
     "server.retry_join": _str_list, "server.start_join": _str_list,
+    "server.eval_batch_size": int, "server.dense_min_batch": int,
     "client.enabled": bool, "client.state_dir": str,
     "client.alloc_dir": str, "client.node_class": str,
     "client.servers": _str_list, "client.network_speed": int,
@@ -170,7 +179,8 @@ _SCHEMA: Dict[str, Any] = {
     "consul.client_service_name": str, "consul.auto_advertise": bool,
     "vault.enabled": bool, "vault.address": str, "vault.token": str,
 }
-_MAP_KEYS = {"client.options", "client.meta", "client.reserved"}
+_MAP_KEYS = {"client.options", "client.meta", "client.reserved",
+             "server.scheduler_factories"}
 _BLOCKS = {"ports", "server", "client", "telemetry", "consul", "vault"}
 
 

@@ -592,10 +592,15 @@ def cmd_agent(args) -> int:
         pass  # not on the main thread (tests)
 
     scheduler_factories = {}
+    if cfg.server.scheduler_factories:
+        scheduler_factories = dict(cfg.server.scheduler_factories)
     if args.tpu:
-        scheduler_factories = {"service": "service-tpu",
-                               "batch": "batch-tpu",
-                               "system": "system-tpu"}
+        # CLI flags win over config files (the module's documented
+        # precedence): -tpu overlays the dense factories on whatever
+        # the HCL mapped.
+        scheduler_factories.update({"service": "service-tpu",
+                                    "batch": "batch-tpu",
+                                    "system": "system-tpu"})
 
     # Unique gossip identity per agent: two same-region agents with the
     # same member name would clobber each other in the serf pool.
@@ -621,6 +626,10 @@ def cmd_agent(args) -> int:
             server_cfg.heartbeat_grace = heartbeat_grace
         if node_gc_threshold is not None:
             server_cfg.node_gc_threshold = node_gc_threshold
+        if cfg.server.eval_batch_size is not None:
+            server_cfg.eval_batch_size = cfg.server.eval_batch_size
+        if cfg.server.dense_min_batch is not None:
+            server_cfg.dense_min_batch = cfg.server.dense_min_batch
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
         if cfg.vault.address:

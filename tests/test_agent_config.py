@@ -236,3 +236,33 @@ def test_duplicate_block_rejected(tmp_path):
     path.write_text('server { enabled = true }\nserver { enabled = false }\n')
     with pytest.raises(ValueError, match="duplicate 'server' block"):
         parse_config_file(str(path))
+
+
+def test_scheduler_factories_and_batching_knobs(tmp_path):
+    """Operators tune the dense backend from HCL: per-type factory map
+    plus drain-to-batch sizes (server/config.py knobs)."""
+    p = tmp_path / "a.hcl"
+    p.write_text('''
+server {
+  enabled = true
+  scheduler_factories {
+    service = "service-tpu"
+    batch = "batch"
+  }
+  eval_batch_size = 32
+  dense_min_batch = 4
+}
+''')
+    cfg = load_config(str(p))
+    assert cfg.server.scheduler_factories == {
+        "service": "service-tpu", "batch": "batch"}
+    assert cfg.server.eval_batch_size == 32
+    assert cfg.server.dense_min_batch == 4
+
+    # Later files override per entry (maps union, b wins).
+    q = tmp_path / "b.hcl"
+    q.write_text('server { scheduler_factories { batch = "batch-tpu" } }')
+    from nomad_tpu.cli.agent_config import merge_config
+    merged = merge_config(cfg, load_config(str(q)))
+    assert merged.server.scheduler_factories == {
+        "service": "service-tpu", "batch": "batch-tpu"}
