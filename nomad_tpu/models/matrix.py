@@ -33,8 +33,11 @@ from ..structs import (
 )
 from ..structs.resources import Resources
 
-# Node-count buckets: VPU-lane-friendly multiples of 128.
-BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768]
+# Node-count buckets: VPU-lane-friendly multiples of 128. Denser steps
+# above 8k: pure powers of two made a 10k-node cluster pad to 16384
+# (+63% on every transfer and scan row).
+BUCKETS = [128, 256, 512, 1024, 2048, 4096, 6144, 8192, 10240, 12288,
+           16384, 20480, 24576, 32768]
 ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
 
 # Job-independent cluster base, cached across evaluations: rebuilding
@@ -58,7 +61,8 @@ class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
                  "alloc_groups", "token", "allocs_index", "table_len",
-                 "delta_parent")
+                 "delta_parent", "class_ids", "class_reps",
+                 "_positions", "_positions_lock")
 
     def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
                  table_len: int = -1):
@@ -88,9 +92,53 @@ class _ClusterBase:
         # per node: [(job_id, task_group), ...] of live allocs, for the
         # cheap per-job overlay counts
         self.alloc_groups: List[List[Tuple[str, str]]] = []
+        self._init_class_index(nodes)
+        self._positions = None  # job_id -> {tg: row indices}, lazy
+        self._positions_lock = __import__("threading").Lock()
         for i, node in enumerate(nodes):
             self.alloc_groups.append([])
             self._fill_row(i, node, proposed_fn(node.id))
+
+    def _init_class_index(self, nodes) -> None:
+        """Node -> computed-class index, so feasibility evaluates once
+        per CLASS on a representative node and numpy-expands to all N
+        (the dense analog of FeasibilityWrapper's memo,
+        scheduler/feasible.go:457). Node-level, alloc-independent:
+        delta clones share it by reference."""
+        self.class_ids = np.full(self.n, -1, np.int32)
+        self.class_reps: List[int] = []
+        index: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            cls = node.computed_class
+            if not cls:
+                continue
+            ci = index.get(cls)
+            if ci is None:
+                ci = len(self.class_reps)
+                index[cls] = ci
+                self.class_reps.append(i)
+            self.class_ids[i] = ci
+
+    def job_positions(self, job_id: str) -> Dict[str, np.ndarray]:
+        """{task_group: node-row indices (with repeats)} for one job's
+        live allocs. The index over alloc_groups builds lazily ONCE per
+        base (O(total allocs)) and every eval in a drained batch then
+        pays O(its own job's allocs) instead of an O(N x allocs) python
+        scan — the per-eval overlay cost that dominated the live dense
+        path at 10k nodes / 50k allocs."""
+        with self._positions_lock:
+            if self._positions is None:
+                positions: Dict[str, Dict[str, List[int]]] = {}
+                for i, groups in enumerate(self.alloc_groups):
+                    for jid, tg in groups:
+                        positions.setdefault(jid, {}).setdefault(
+                            tg, []).append(i)
+                self._positions = {
+                    jid: {tg: np.asarray(rows, np.int64)
+                          for tg, rows in per.items()}
+                    for jid, per in positions.items()
+                }
+            return self._positions.get(job_id, {})
 
     def _fill_row(self, i, node, allocs) -> None:
         """(Re)compute one node's row from its object + live allocs."""
@@ -165,6 +213,10 @@ class _ClusterBase:
         new.table_len = len(allocs)
         new.delta_parent = (self.token, tuple(rows))
         new.n_real, new.n = self.n_real, self.n
+        # Node-level class index is alloc-independent: share it.
+        new.class_ids, new.class_reps = self.class_ids, self.class_reps
+        new._positions_lock = __import__("threading").Lock()
+        new._positions = None  # patched below when the parent built one
         new.capacity = self.capacity.copy()
         new.sched_capacity = self.sched_capacity.copy()
         new.util = self.util.copy()
@@ -173,11 +225,58 @@ class _ClusterBase:
         new.ports_free = self.ports_free.copy()
         new.node_ok = self.node_ok.copy()
         new.alloc_groups = list(self.alloc_groups)
+        old_groups = {i: self.alloc_groups[i] for i in rows}
         for i in rows:
             new._fill_row(
                 i, nodes[i],
                 state.allocs_by_node_terminal(nodes[i].id, False))
+        new._patch_positions(self, rows, old_groups)
         return new
+
+    def _patch_positions(self, parent: "_ClusterBase", rows,
+                         old_groups) -> None:
+        """Carry the parent's job-positions index forward, re-deriving
+        only the jobs present in the changed rows — rebuilding the full
+        index is an O(total allocs) python scan per delta base, dozens
+        of times per live storm."""
+        with parent._positions_lock:
+            base_positions = parent._positions
+        if base_positions is None:
+            return  # parent never built one; stay lazy
+        affected = set()
+        for i in rows:
+            for jid, _tg in old_groups[i]:
+                affected.add(jid)
+            for jid, _tg in self.alloc_groups[i]:
+                affected.add(jid)
+        patched = dict(base_positions)
+        rowset = np.asarray(sorted(rows), np.int64)
+        for jid in affected:
+            per = {tg: arr for tg, arr in
+                   (base_positions.get(jid) or {}).items()}
+            # Strip the changed rows' old memberships...
+            for tg in list(per):
+                keep = per[tg][~np.isin(per[tg], rowset)]
+                if keep.size:
+                    per[tg] = keep
+                else:
+                    del per[tg]
+            # ... and add their current ones.
+            adds: Dict[str, List[int]] = {}
+            for i in rows:
+                for jid2, tg in self.alloc_groups[i]:
+                    if jid2 == jid:
+                        adds.setdefault(tg, []).append(i)
+            for tg, idxs in adds.items():
+                prev = per.get(tg)
+                arr = np.asarray(idxs, np.int64)
+                per[tg] = (np.concatenate([prev, arr])
+                           if prev is not None else arr)
+            if per:
+                patched[jid] = per
+            else:
+                patched.pop(jid, None)
+        self._positions = patched
 
 
 def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
@@ -316,25 +415,28 @@ class ClusterMatrix:
         self.ports_free = base.ports_free
         self.node_ok = base.node_ok
 
-        # Job-specific overlay: this job's per-node alloc counts.
+        # Job-specific overlay: this job's per-node alloc counts, from
+        # the base's lazy positions index (O(this job's allocs)).
         job_count = np.zeros(n, np.int32)
         tg_count = np.zeros((n, g), np.int32)
         gi_by_name = {tg.name: gi for gi, tg in enumerate(self.groups)}
-        for i, groups in enumerate(base.alloc_groups):
-            for job_id, task_group in groups:
-                if job_id == self.job.id:
-                    job_count[i] += 1
-                    gi = gi_by_name.get(task_group)
-                    if gi is not None:
-                        tg_count[i, gi] += 1
+        for task_group, rows in base.job_positions(self.job.id).items():
+            np.add.at(job_count, rows, 1)
+            gi = gi_by_name.get(task_group)
+            if gi is not None:
+                np.add.at(tg_count[:, gi], rows, 1)
         self.job_count = job_count
         self.tg_count = tg_count
-        self.feasible = self._build_feasibility()
+        self.feasible = self._build_feasibility(base)
 
-    def _build_feasibility(self) -> np.ndarray:
+    def _build_feasibility(self, base) -> np.ndarray:
         """[N, G] constraint mask. Non-escaped job/TG constraints are
-        evaluated once per computed class; escaped ones per node."""
+        evaluated ONCE PER COMPUTED CLASS on a representative node and
+        numpy-expanded to all N (a python loop over 10k nodes per eval
+        was the other half of the live overlay cost); escaped
+        constraints and classless nodes fall back to per-node checks."""
         n, g = self.n, self.g
+        n_real = self.n_real
         feasible = np.zeros((n, g), bool)
         ctx = EvalContext(self.state, Plan())
 
@@ -343,6 +445,7 @@ class ClusterMatrix:
         job_static = [c for c in job_cons if c not in job_escaped]
 
         per_group = []
+        any_esc = bool(job_escaped)
         for tg in self.groups:
             cons = list(tg.constraints)
             drivers = set()
@@ -351,40 +454,53 @@ class ClusterMatrix:
                 drivers.add(task.driver)
             esc = escaped_constraints(cons)
             static = [c for c in cons if c not in esc]
+            any_esc = any_esc or bool(esc)
             per_group.append((static, esc, drivers))
 
-        class_cache: Dict[Tuple[str, int], bool] = {}
-        job_class_cache: Dict[str, bool] = {}
         job_checker = ConstraintChecker(ctx, job_static)
         cons_checker = ConstraintChecker(ctx)
         driver_checker = DriverChecker(ctx)
         esc_checker = ConstraintChecker(ctx)
 
-        for i, node in enumerate(self.nodes):
-            cls = node.computed_class
-            job_ok = job_class_cache.get(cls) if cls else None
-            if job_ok is None:
-                job_ok = job_checker.feasible(node)
-                if cls:
-                    job_class_cache[cls] = job_ok
-            if job_ok and job_escaped:
-                esc_checker.set_constraints(job_escaped)
-                job_ok = esc_checker.feasible(node)
-            if not job_ok:
-                continue
-            for gi, (static, esc, drivers) in enumerate(per_group):
-                key = (cls, gi)
-                ok = class_cache.get(key) if cls else None
-                if ok is None:
-                    driver_checker.set_drivers(drivers)
-                    cons_checker.set_constraints(static)
-                    ok = driver_checker.feasible(node) and cons_checker.feasible(node)
-                    if cls:
-                        class_cache[key] = ok
-                if ok and esc:
-                    esc_checker.set_constraints(esc)
-                    ok = esc_checker.feasible(node)
-                feasible[i, gi] = ok
+        def static_row(node) -> np.ndarray:
+            row = np.zeros(g, bool)
+            if not job_checker.feasible(node):
+                return row
+            for gi, (static, _esc, drivers) in enumerate(per_group):
+                driver_checker.set_drivers(drivers)
+                cons_checker.set_constraints(static)
+                row[gi] = (driver_checker.feasible(node)
+                           and cons_checker.feasible(node))
+            return row
+
+        # One evaluation per class, expanded by numpy take.
+        if base.class_reps:
+            verdicts = np.stack([
+                static_row(self.nodes[rep]) for rep in base.class_reps
+            ])
+            ids = base.class_ids[:n_real]
+            classed = ids >= 0
+            feasible[:n_real][classed] = verdicts[ids[classed]]
+        # Classless nodes: individual evaluation (flatnonzero — a python
+        # scan over 10k rows that are all classed would cost more than
+        # the class pass saved).
+        for i in np.flatnonzero(base.class_ids[:n_real] < 0):
+            feasible[i] = static_row(self.nodes[i])
+        # Escaped constraints reference unique per-node attrs: they can
+        # never ride the class verdict (node_class.go:70) — walk only
+        # the still-candidate rows.
+        if any_esc:
+            for i in np.flatnonzero(feasible[:n_real].any(axis=1)):
+                node = self.nodes[i]
+                if job_escaped:
+                    esc_checker.set_constraints(job_escaped)
+                    if not esc_checker.feasible(node):
+                        feasible[i] = False
+                        continue
+                for gi, (_static, esc, _drivers) in enumerate(per_group):
+                    if esc and feasible[i, gi]:
+                        esc_checker.set_constraints(esc)
+                        feasible[i, gi] = esc_checker.feasible(node)
         return feasible
 
     # ------------------------------------------------------------------

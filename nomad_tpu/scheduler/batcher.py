@@ -50,7 +50,10 @@ SHARD_MIN_NODES = 2048
 # routing sends lone evals to the host factory (server/worker.py).
 WINDOW_S = 0.02
 RESPAWN_WINDOW_S = 0.005  # post-dispatch window: catch GIL stragglers
-DEVICE_BASE_CACHE = 4  # cluster bases kept on device
+# Cluster bases kept on device. Sized for the live storm's token churn:
+# ~4 workers' wave snapshots plus the delta parents they derive from —
+# evicting a parent forces the next delta into a full re-upload.
+DEVICE_BASE_CACHE = 8
 # In-flight dispatches allowed per shape: overlapping device calls
 # hides the per-dispatch round-trip (dominant through a remote-device
 # tunnel) behind the next batch's accumulation. XLA serializes the

@@ -124,6 +124,15 @@ class BatchedTPUScheduler(GenericScheduler):
         bulk = remaining
         if not bulk:
             return
+        if len(bulk) <= 3:
+            # Too few placements to amortize a dispatch — typical for
+            # the retry after a partially-rejected plan (1-3 conflicted
+            # allocs replanned on a FRESH snapshot, so the dense path
+            # would also pay a new matrix + base token). The host
+            # iterators place a handful in low-ms with identical
+            # semantics.
+            super()._compute_placements(bulk)
+            return
 
         matrix = ClusterMatrix(self.state, self.job, self.plan)
         tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}

@@ -130,10 +130,10 @@ def test_tpu_scheduler_uses_batcher():
         n.compute_class()
         h.state.upsert_node(h.next_index(), n)
     job = mock.job()
-    job.task_groups[0].count = 2
+    job.task_groups[0].count = 4  # >3: below that the host fallback runs
     h.state.upsert_job(h.next_index(), job)
     h.process("service-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
-    assert len(h.state.allocs_by_job(job.id)) == 2
+    assert len(h.state.allocs_by_job(job.id)) == 4
     assert batcher.batched_requests > before
 
 

@@ -82,7 +82,7 @@ def test_eval_storm_routes_to_dense_path():
         jobs = []
         for _ in range(6):
             job = mock.job()
-            job.task_groups[0].count = 2
+            job.task_groups[0].count = 5  # >3 so the dense path engages
             server.job_register(job)
             jobs.append(job)
         assert wait_until(lambda: server.broker.ready_count() >= 6)
@@ -90,7 +90,7 @@ def test_eval_storm_routes_to_dense_path():
             w.set_pause(False)
         assert wait_until(
             lambda: all(
-                len(server.fsm.state.allocs_by_job(j.id)) == 2 for j in jobs),
+                len(server.fsm.state.allocs_by_job(j.id)) == 5 for j in jobs),
             timeout=60.0,
         )
         # The drained batch went dense: batcher served its requests.
@@ -107,10 +107,10 @@ def test_dense_min_batch_one_forces_dense():
         batcher = get_batcher()
         before = batcher.batched_requests
         job = mock.job()
-        job.task_groups[0].count = 2
+        job.task_groups[0].count = 6  # >3: small-K host fallback skipped
         server.job_register(job)
         assert wait_until(
-            lambda: len(server.fsm.state.allocs_by_job(job.id)) == 2,
+            lambda: len(server.fsm.state.allocs_by_job(job.id)) == 6,
             timeout=60.0,
         )
         assert batcher.batched_requests > before

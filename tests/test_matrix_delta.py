@@ -124,6 +124,42 @@ def test_many_changed_rows_falls_back_to_full_rebuild(cluster):
         (n.reserved.cpu if n.reserved else 0) for n in m2.nodes)
 
 
+def test_delta_patches_positions_index(cluster):
+    """A delta base carries the parent's job-positions index forward,
+    patching only jobs in the changed rows; the result must equal a
+    from-scratch index (same multiset of rows per job/task-group)."""
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    parent = m1._cached_base()
+    parent.job_positions(job.id)  # force the parent index to exist
+
+    stopped = allocs[:3]
+    for a in stopped:
+        a.desired_status = consts.ALLOC_DESIRED_STOP
+        a.client_status = consts.ALLOC_CLIENT_COMPLETE
+    index += 1
+    store.upsert_allocs(index, stopped)
+    other = mock.job()
+    other.id = "other-job"
+    index += 1
+    store.upsert_allocs(index, [make_alloc(nodes[5], other)])
+
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    base2 = m2._cached_base()
+    assert base2.delta_parent is not None  # took the delta path
+    # Patched index was installed without a lazy rebuild.
+    assert base2._positions is not None
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    for jid in (job.id, other.id, "no-such-job"):
+        got = {tg: sorted(arr.tolist())
+               for tg, arr in base2.job_positions(jid).items()}
+        want = {tg: sorted(arr.tolist())
+                for tg, arr in oracle.job_positions(jid).items()}
+        assert got == want, jid
+
+
 def test_gc_deletion_forces_full_rebuild(cluster):
     """Deleted allocs leave no modify_index trace; the delta path must
     detect the shrinking table and rebuild, or the deleted usage stays
