@@ -9,6 +9,7 @@ X-Nomad-Index.
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 import time
@@ -86,6 +87,7 @@ class HTTPServer:
                  client=None, enable_debug: bool = False):
         self.server = server
         self.client = client
+        self.logger = logging.getLogger("nomad_tpu.http")
         # Gates the /debug/* introspection routes (the reference gates
         # pprof the same way, command/agent/http.go:135 enableDebug).
         self.enable_debug = enable_debug
@@ -134,9 +136,25 @@ class HTTPServer:
                 if stream is None:
                     self.wfile.write(data)
                 else:
-                    w = _ChunkedWriter(self.wfile)
-                    stream(w)
-                    w.finish()
+                    # Headers are already on the wire: if the stream
+                    # callable dies mid-body (snapshot tar read error,
+                    # log file rotated away) the chunked response is
+                    # unterminated and the connection must not be
+                    # reused — bound the damage to THIS connection.
+                    try:
+                        w = _ChunkedWriter(self.wfile)
+                        stream(w)
+                        w.finish()
+                    except ConnectionError:
+                        # Client hung up mid-stream (normal for a
+                        # log-follow Ctrl-C) — not a server error.
+                        api.logger.debug(
+                            "stream client disconnected: %s", self.path)
+                        self.close_connection = True
+                    except Exception:  # noqa: BLE001
+                        api.logger.exception(
+                            "stream response truncated: %s", self.path)
+                        self.close_connection = True
 
             do_GET = do_PUT = do_POST = do_DELETE = _dispatch
 

@@ -184,15 +184,21 @@ class _ClusterBase:
         leave no modify_index trace, so their usage would stay baked
         in), or self unchanged-but-rekeyed when no relevant alloc moved
         (same token -> the device-cached upload is reused as-is)."""
-        if self.allocs_index < 0 or self.table_len < 0:
+        # Snapshot the watermark pair ONCE: this base may be shared
+        # across worker threads, and a concurrent rekey mid-scan would
+        # make us compare a mixed-era (table_len, allocs_index) pair.
+        with _BASE_CACHE_LOCK:
+            base_allocs_index = self.allocs_index
+            base_table_len = self.table_len
+        if base_allocs_index < 0 or base_table_len < 0:
             return None
         allocs = state.allocs()
-        created = sum(1 for a in allocs if a.create_index > self.allocs_index)
-        if len(allocs) != self.table_len + created:
+        created = sum(1 for a in allocs if a.create_index > base_allocs_index)
+        if len(allocs) != base_table_len + created:
             return None  # deletions happened; they are untraceable
         changed_nodes = {
             a.node_id for a in allocs
-            if a.modify_index > self.allocs_index
+            if a.modify_index > base_allocs_index
         }
         row_of = {node.id: i for i, node in enumerate(nodes)}
         rows = [row_of[nid] for nid in changed_nodes if nid in row_of]
@@ -202,8 +208,12 @@ class _ClusterBase:
             # outside this family (other DCs, non-pinned nodes), and a
             # stale length would trip the deletion check on the next
             # delta, degrading every future update to a full rebuild.
-            self.allocs_index = new_allocs_index
-            self.table_len = len(allocs)
+            # Compare-and-advance under the lock: a concurrent delta from
+            # a NEWER snapshot must never have its watermark regressed.
+            with _BASE_CACHE_LOCK:
+                if new_allocs_index > self.allocs_index:
+                    self.allocs_index = new_allocs_index
+                    self.table_len = len(allocs)
             return self
         if len(rows) > max(64, self.n_real // 4):
             return None  # full rebuild is cheaper

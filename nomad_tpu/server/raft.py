@@ -182,6 +182,9 @@ class RaftNode:
         # leader volatile state
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
+        # Index of the noop barrier appended on election; config changes
+        # are refused until it commits (see _change_config).
+        self._term_start_index = 0
 
         self._last_heartbeat = time.monotonic()
         # Stale enough that votes are granted normally at boot.
@@ -604,6 +607,7 @@ class RaftNode:
                 noop = LogEntry(term, self._last_log_index() + 1,
                                 NOOP_TYPE, None)
                 self.log.append(noop)
+                self._term_start_index = noop.index
                 if self.storage is not None:
                     self.storage.append_entry(noop)
                 nxt = self._last_log_index() + 1
@@ -776,14 +780,45 @@ class RaftNode:
         No-op if not a member."""
         self._change_config(remove=peer_id)
 
+    def _wait_term_barrier(self, timeout: float = 2.0) -> None:
+        """Block until an entry of the CURRENT term (the election noop)
+        is committed. With append-time-active single-server changes, a
+        config change before that barrier is the classic membership
+        safety bug: an old leader holding an uncommitted add-peer config
+        and a new leader appending remove-peer before its barrier
+        commits can form disjoint quorums and commit divergent entries.
+        PreVote narrows but does not close the window under partition —
+        this gate closes it. Raises if the barrier doesn't land in time
+        (the membership reconcile sweep retries)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self.state != LEADER:
+                    raise NotLeaderError(self.leader_id)
+                if self.commit_index >= self._term_start_index:
+                    return
+            if time.monotonic() > deadline:
+                raise ValueError(
+                    "leadership not established: election barrier not "
+                    "committed yet")
+            self._broadcast_heartbeat()
+            time.sleep(0.02)
+
     def _change_config(self, add: Optional[str] = None,
                        remove: Optional[str] = None) -> None:
+        self._wait_term_barrier()
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
             if remove == self.node_id:
                 raise ValueError(
                     "cannot remove the leader; transfer leadership first")
+            if self.commit_index < self._term_start_index:
+                # Re-elected between the barrier wait and here: the NEW
+                # term's barrier is pending again; let the caller retry.
+                raise ValueError(
+                    "leadership not established: election barrier not "
+                    "committed yet")
             if self._uncommitted_config_locked():
                 raise ValueError("configuration change already in progress")
             members = set(self.peers) | {self.node_id}

@@ -223,9 +223,11 @@ class HTTPVaultProvider(VaultProvider):
                               {"accessor": acc})
             except VaultError as e:
                 # Unknown accessor = already revoked/expired: idempotent
-                # like the reference's RevokeTokens; other failures are
-                # collected so one bad accessor doesn't strand the rest.
-                if "invalid accessor" in str(e).lower() or " 400 " in str(e):
+                # like the reference's RevokeTokens; every OTHER failure
+                # (including other 400s — malformed request, backend
+                # errors) is collected so it is reported, and so one bad
+                # accessor doesn't strand the rest.
+                if "invalid accessor" in str(e).lower():
                     continue
                 errors.append(str(e))
         if errors:
