@@ -7,13 +7,13 @@ including blocking-query support (QueryOptions:20).
 from __future__ import annotations
 
 import json
-import urllib.error
+import threading
 import urllib.parse
-import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..structs import Allocation, Evaluation, Job, Node
 from ..utils.codec import from_dict, to_dict
+from ..utils.httppool import HTTPPool, PoolError
 
 
 class APIError(Exception):
@@ -23,9 +23,18 @@ class APIError(Exception):
 
 
 class Client:
-    def __init__(self, address: str, timeout: float = 305.0, region: str = ""):
-        self.address = address.rstrip("/")
+    def __init__(self, address: str, timeout: float = 305.0, region: str = "",
+                 ssl_context=None):
         self.timeout = timeout
+        self._ssl_context = ssl_context
+        self._address = ""
+        self._addr_lock = threading.Lock()
+        self.pool: Optional[HTTPPool] = None
+        # Keep-alive pool (pool.go:144): sequential requests — above
+        # all the blocking-query wakeup loop — reuse one socket instead
+        # of a TCP handshake per call. Assigning .address (the client
+        # agent's rpc-failover path does this live) swaps the pool.
+        self.address = address
         # Target region: forwarded server-side when it differs from the
         # contacted agent's region (api.go QueryOptions.Region).
         self.region = region
@@ -40,14 +49,28 @@ class Client:
 
     # ------------------------------------------------------------------
 
-    def _request(
-        self,
-        method: str,
-        path: str,
-        body: Any = None,
-        params: Optional[Dict[str, str]] = None,
-    ) -> Tuple[Any, int]:
-        url = self.address + path
+    @property
+    def address(self) -> str:
+        return self._address
+
+    @address.setter
+    def address(self, value: str) -> None:
+        value = value.rstrip("/")
+        # Locked: concurrent failovers (heartbeat loop + alloc watcher
+        # both call _rpc_failed) must never leave _address naming one
+        # server while the pool dials another — the early-return guard
+        # would then pin the client to the wrong server forever.
+        with self._addr_lock:
+            if value == self._address and self.pool is not None:
+                return
+            old = self.pool
+            self._address = value
+            self.pool = HTTPPool(value, timeout=self.timeout,
+                                 ssl_context=self._ssl_context)
+        if old is not None:
+            old.close()
+
+    def _path_with_params(self, path: str, params) -> str:
         if self.region:
             if isinstance(params, list):
                 if not any(k == "region" for k, _ in params):
@@ -56,47 +79,50 @@ class Client:
                 params = dict(params or {})
                 params.setdefault("region", self.region)
         if params:
-            url += "?" + urllib.parse.urlencode(params)
+            path += "?" + urllib.parse.urlencode(params)
+        return path
+
+    def _raw_request(self, method: str, path: str, body: Any = None,
+                     params=None) -> Tuple[bytes, Dict[str, str]]:
+        path = self._path_with_params(path, params)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
+        # Blocking queries can legitimately hold the line for the full
+        # `wait`; wait= is in the path but the pool needs the socket
+        # timeout to outlast it, which self.timeout (305s default) does.
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = json.loads(resp.read() or b"null")
-                index = int(resp.headers.get("X-Nomad-Index") or 0)
-                return payload, index
-        except urllib.error.HTTPError as e:
+            status, headers, payload = self.pool.request(
+                method, path, body=data,
+                headers={"Content-Type": "application/json"})
+        except PoolError as e:
+            raise APIError(
+                0, f"failed to reach agent at {self.address}: {e}"
+            ) from None
+        if status >= 400:
             try:
-                message = json.loads(e.read()).get("error", str(e))
+                message = json.loads(payload).get("error", "")
             except Exception:  # noqa: BLE001
-                message = str(e)
-            raise APIError(e.code, message) from None
-        except urllib.error.URLError as e:
-            raise APIError(0, f"failed to reach agent at {self.address}: {e.reason}") from None
+                message = payload.decode(errors="replace")
+            raise APIError(status, message or f"HTTP {status}")
+        return payload, headers
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Tuple[Any, int]:
+        payload, headers = self._raw_request(method, path, body, params)
+        index = int(headers.get("X-Nomad-Index") or 0)
+        return json.loads(payload or b"null"), index
 
     def get(self, path: str, params: Optional[Dict] = None) -> Tuple[Any, int]:
         return self._request("GET", path, params=params)
 
     def get_raw(self, path: str, params: Optional[Dict] = None) -> bytes:
         """GET returning raw bytes (fs cat/readat endpoints)."""
-        url = self.address + path
-        if self.region:
-            params = dict(params or {})
-            params.setdefault("region", self.region)
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, method="GET")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            try:
-                message = json.loads(e.read()).get("error", str(e))
-            except Exception:  # noqa: BLE001
-                message = str(e)
-            raise APIError(e.code, message) from None
-        except urllib.error.URLError as e:
-            raise APIError(0, f"failed to reach agent at {self.address}: {e.reason}") from None
+        payload, _ = self._raw_request("GET", path, params=params)
+        return payload
 
     def put(self, path: str, body: Any = None, params: Optional[Dict] = None):
         return self._request("PUT", path, body=body, params=params)

@@ -93,8 +93,19 @@ class HTTPServer:
         self.enable_debug = enable_debug
         api = self
 
+        # Accepted-TCP-connection count: with keep-alive clients this
+        # should track concurrent clients, not total requests (the
+        # pool.go:144 property the SDK pool restores).
+        self.connections_accepted = 0
+        self._conn_count_lock = threading.Lock()
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                with api._conn_count_lock:
+                    api.connections_accepted += 1
+                super().setup()
 
             def log_message(self, fmt, *args):
                 pass
@@ -158,8 +169,15 @@ class HTTPServer:
 
             do_GET = do_PUT = do_POST = do_DELETE = _dispatch
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # socketserver's default listen backlog is 5: a burst of
+            # clients (re)connecting — agent restart, failover — would
+            # see connect timeouts. 10k-node clusters reconnect in
+            # herds; give the accept queue real depth.
+            request_queue_size = 512
+
+        self._httpd = _Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self.addr = f"http://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
@@ -237,6 +255,8 @@ class HTTPServer:
             # follower->leader forwarding targets (rpc.go:178 forward);
             # served by the leader for remote followers' workers/timers
             (r"^/v1/internal/eval/dequeue$", self._internal_eval_dequeue),
+            (r"^/v1/internal/eval/dequeue-many$",
+             self._internal_eval_dequeue_many),
             (r"^/v1/internal/eval/ack$", self._internal_eval_ack),
             (r"^/v1/internal/eval/nack$", self._internal_eval_nack),
             (r"^/v1/internal/eval/pause-nack$", self._internal_eval_pause),
@@ -515,6 +535,17 @@ class HTTPServer:
             body.get("schedulers") or [], timeout)
         return {"eval": to_dict(ev) if ev is not None else None,
                 "token": token}
+
+    def _internal_eval_dequeue_many(self, method, query, body):
+        """Non-blocking drain for a FOLLOWER worker's batch: without
+        this, only leader-local workers could form device batches and
+        the dense backend's throughput story would hold for one server
+        only (the reference's point is N workers x all servers)."""
+        self._require_leader()
+        pairs = self.server.broker.dequeue_many(
+            body.get("schedulers") or [], int(body.get("max_n", 0)))
+        return {"evals": [
+            {"eval": to_dict(ev), "token": token} for ev, token in pairs]}
 
     def _internal_eval_ack(self, method, query, body):
         self._require_leader()

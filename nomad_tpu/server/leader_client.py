@@ -10,12 +10,11 @@ everything else, on internal /v1/internal/* routes the leader serves.
 from __future__ import annotations
 
 import json
-import urllib.error
-import urllib.request
 from typing import List, Optional, Tuple
 
 from ..structs import Evaluation, Plan, PlanResult
 from ..utils.codec import from_dict, to_dict
+from ..utils.httppool import PoolError, shared_pool
 
 
 class LeaderUnavailableError(Exception):
@@ -23,30 +22,35 @@ class LeaderUnavailableError(Exception):
 
 
 class RemoteLeader:
-    """Leader-only operations executed on a remote leader."""
+    """Leader-only operations executed on a remote leader.
+
+    Rides the process-wide keep-alive pool (pool.go:144): a follower's
+    workers dequeue/ack/submit against the leader on a handful of
+    persistent sockets instead of a TCP handshake per RPC."""
 
     def __init__(self, addr: str, timeout: float = 10.0):
         self.addr = addr.rstrip("/")
         self.timeout = timeout
+        # The dequeue long-poll passes per-call timeouts above
+        # self.timeout; size the pool's ceiling for those.
+        self._pool = shared_pool(self.addr, timeout=120.0)
 
     def _call(self, path: str, body: dict, timeout: Optional[float] = None):
-        req = urllib.request.Request(
-            self.addr + path, data=json.dumps(body).encode(), method="PUT",
-            headers={"Content-Type": "application/json"},
-        )
         try:
-            with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout
-            ) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            try:
-                message = json.loads(e.read()).get("error", str(e))
-            except Exception:  # noqa: BLE001
-                message = str(e)
-            raise LeaderUnavailableError(message) from None
-        except (urllib.error.URLError, OSError) as e:
+            status, _headers, payload = self._pool.request(
+                "PUT", path, body=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                timeout=timeout or self.timeout,
+            )
+        except PoolError as e:
             raise LeaderUnavailableError(str(e)) from None
+        if status >= 400:
+            try:
+                message = json.loads(payload).get("error", "")
+            except Exception:  # noqa: BLE001
+                message = payload.decode(errors="replace")
+            raise LeaderUnavailableError(message or f"HTTP {status}")
+        return json.loads(payload or b"null")
 
     # ------------------------------------------------------------ evals
 
@@ -59,6 +63,18 @@ class RemoteLeader:
         )
         ev = from_dict(Evaluation, out.get("eval")) if out.get("eval") else None
         return ev, out.get("token", "")
+
+    def eval_dequeue_many(
+        self, schedulers: List[str], max_n: int
+    ) -> List[Tuple[Evaluation, str]]:
+        out = self._call(
+            "/v1/internal/eval/dequeue-many",
+            {"schedulers": schedulers, "max_n": max_n},
+        )
+        return [
+            (from_dict(Evaluation, item["eval"]), item.get("token", ""))
+            for item in out.get("evals") or []
+        ]
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         self._call("/v1/internal/eval/ack",

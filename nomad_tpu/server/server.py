@@ -893,13 +893,22 @@ class Server:
         self, schedulers: List[str], max_n: int
     ) -> List[Tuple[Evaluation, str]]:
         """Non-blocking drain of additional ready evals (dense-backend
-        batch path; see broker.dequeue_many). Remote-leader forwarding
-        is intentionally omitted: batching only pays on the worker's
-        local broker, a follower just processes singly."""
-        leader = self._leader_server()
-        if leader is None or max_n <= 0:
+        batch path; see broker.dequeue_many). Followers forward to the
+        leader over the keep-alive pool so their workers form device
+        batches too — the dense backend's throughput must hold for N
+        workers x all servers, not just leader-local ones."""
+        if max_n <= 0:
             return []
-        return leader.broker.dequeue_many(schedulers, max_n)
+        leader = self._leader_server()
+        if leader is not None:
+            return leader.broker.dequeue_many(schedulers, max_n)
+        remote = self._remote_leader()
+        if remote is not None:
+            try:
+                return remote.eval_dequeue_many(schedulers, max_n)
+            except Exception:  # noqa: BLE001 - leader flap: batch later
+                pass
+        return []
 
     def eval_ack(self, eval_id: str, token: str) -> None:
         leader = self._leader_server()
