@@ -372,7 +372,9 @@ def config_5s():
         dense_rate, dense_p99
 
 
-def config_6():
+def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
+                   allocs_per_node=0, networks=False,
+                   distinct_hosts=False, warm_jobs=40):
     """End-to-end control plane: the REAL server pipeline (broker ->
     workers -> drain-to-batch -> scheduler -> plan queue -> pipelined
     applier -> FSM) with CPU vs TPU factories on identical clusters.
@@ -388,14 +390,15 @@ def config_6():
       with dense factories configured, latency-aware routing
       (dense_min_batch) must send these to the host path, so the p99
       should match the CPU column's.
-    """
+
+    Returns per-factory rates plus the TPU run's batcher-stat delta
+    (incl. the per-dispatch host/transfer/RTT breakdown)."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.batcher import get_batcher
     from nomad_tpu.server import Server, ServerConfig
-    from nomad_tpu.structs import consts
+    from nomad_tpu.structs import Constraint, consts
 
-    n_nodes, n_jobs, allocs_per_job = 1000, 120, 4
-    lone_jobs = 12
+    rng = random.Random(11)
 
     def wait_evals(server, evals, deadline_s):
         deadline = time.perf_counter() + deadline_s
@@ -412,9 +415,14 @@ def config_6():
         job.id = jid
         job.type = "service"
         job.task_groups[0].count = allocs_per_job
-        job.task_groups[0].tasks[0].resources.networks = []
-        job.task_groups[0].tasks[0].resources.cpu = 20
-        job.task_groups[0].tasks[0].resources.memory_mb = 16
+        tg = job.task_groups[0]
+        if not networks:
+            tg.tasks[0].resources.networks = []
+        if distinct_hosts:
+            tg.constraints.append(
+                Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS))
+        tg.tasks[0].resources.cpu = 20
+        tg.tasks[0].resources.memory_mb = 16
         return job
 
     def run(factories):
@@ -424,17 +432,40 @@ def config_6():
         server.start()
         batcher = get_batcher()
         try:
+            filler = None
+            if allocs_per_node:
+                filler = mock.job()
+                filler.id = "filler"
+                filler.type = "service"
+                filler.task_groups[0].tasks[0].resources.networks = []
             for _ in range(n_nodes):
                 node = mock.node()
                 node.compute_class()
                 server.log.apply("node_register", {"node": node})
+                if allocs_per_node:
+                    fills = []
+                    for _ in range(allocs_per_node):
+                        alloc = mock.alloc()
+                        alloc.node_id = node.id
+                        alloc.job_id = filler.id
+                        alloc.job = filler
+                        alloc.desired_status = consts.ALLOC_DESIRED_RUN
+                        alloc.client_status = consts.ALLOC_CLIENT_RUNNING
+                        for tr in alloc.task_resources.values():
+                            tr.cpu = rng.choice([50, 100])
+                            tr.memory_mb = rng.choice([64, 128])
+                            tr.networks = []
+                        alloc.resources = None
+                        fills.append(alloc)
+                    server.log.apply(
+                        "alloc_update", {"allocs": fills})
 
             # WARMUP (unmeasured): a small storm compiles the dispatch
             # shapes (the B-bucketed overlay/full programs). A live
             # server is long-running — placement shapes are compiled
             # once per bucket and cached (utils/jaxcache persists them
             # across processes), so the steady state is what to measure.
-            warm = [make_job(f"warm-{j}") for j in range(40)]
+            warm = [make_job(f"warm-{j}") for j in range(warm_jobs)]
             for w in server.workers:
                 w.set_pause(True)
             wevals = [server.job_register(job)[0] for job in warm]
@@ -487,6 +518,53 @@ def config_6():
         {"service": "service-tpu", "batch": "batch-tpu"})
     assert abs(cpu_success - tpu_success) < 1e-9, (
         f"success-rate mismatch: cpu={cpu_success} tpu={tpu_success}")
+    return (cpu_rate, cpu_success, cpu_lone_p99,
+            tpu_rate, tpu_success, tpu_lone_p99, dstats)
+
+
+def _trivial_rtt_us() -> float:
+    """Round-trip of a near-empty jitted program: through a remote
+    device tunnel this measures pure transport RTT — the floor any
+    dispatch pays regardless of payload or compute."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(x):
+        return x + 1
+
+    probe(jnp.float32(0)).block_until_ready()  # compile
+    samples = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        probe(jnp.float32(i)).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples) * 1e6)
+
+
+def _breakdown_str(dstats) -> str:
+    """Per-dispatch cost breakdown: host stacking / h->d payload /
+    issue / device round-trip, plus the transport floor."""
+    n = max(dstats.get("dispatches", 0), 1)
+    return (
+        f"per-dispatch: stack {dstats.get('stack_us', 0) / n:.0f}us, "
+        f"payload {dstats.get('payload_bytes', 0) / n / 1024:.0f}KB, "
+        f"issue {dstats.get('issue_us', 0) / n:.0f}us, "
+        f"sync {dstats.get('sync_us', 0) / n:.0f}us; "
+        f"uploads {dstats.get('base_uploads', 0)} full "
+        f"({dstats.get('upload_bytes', 0) / 1024:.0f}KB total) + "
+        f"{dstats.get('base_delta_updates', 0)} delta, "
+        f"{dstats.get('upload_us', 0) / 1000:.0f}ms; "
+        f"trivial-RTT floor {_trivial_rtt_us():.0f}us"
+    )
+
+
+def config_6():
+    """Live pipeline at storm scale: 1k nodes x 120 service jobs."""
+    n_nodes, n_jobs, allocs_per_job = 1000, 120, 4
+    (cpu_rate, cpu_success, cpu_lone_p99,
+     tpu_rate, tpu_success, tpu_lone_p99, dstats) = _live_pipeline(
+        n_nodes, n_jobs, allocs_per_job)
     occupancy = (dstats["batched_requests"] / dstats["dispatches"]
                  if dstats.get("dispatches") else 0.0)
     return (f"end-to-end pipeline, {n_nodes} nodes x {n_jobs} jobs x "
@@ -495,13 +573,38 @@ def config_6():
             f"cpu={cpu_lone_p99 * 1000:.0f}ms tpu={tpu_lone_p99 * 1000:.0f}ms "
             f"(routed to host); batcher: {dstats.get('dispatches', 0)} "
             f"dispatches x {occupancy:.1f} evals avg, "
-            f"{dstats.get('overlay_dispatches', 0)} overlay, "
-            f"{dstats.get('base_uploads', 0)} base uploads"), \
+            f"{dstats.get('compact_dispatches', 0)} compact of "
+            f"{dstats.get('overlay_dispatches', 0)} overlay; "
+            + _breakdown_str(dstats)), \
+        cpu_rate, cpu_lone_p99, tpu_rate, tpu_lone_p99
+
+
+def config_8():
+    """North-star LIVE regime (BASELINE.md config 6 notes): 10k nodes,
+    50k existing allocs, ports + distinct_hosts, through the REAL
+    control plane."""
+    n_nodes, n_jobs, allocs_per_job = 10_000, 60, 8
+    (cpu_rate, cpu_success, cpu_lone_p99,
+     tpu_rate, tpu_success, tpu_lone_p99, dstats) = _live_pipeline(
+        n_nodes, n_jobs, allocs_per_job, lone_jobs=6, allocs_per_node=5,
+        networks=True, distinct_hosts=True, warm_jobs=16)
+    occupancy = (dstats["batched_requests"] / dstats["dispatches"]
+                 if dstats.get("dispatches") else 0.0)
+    return (f"north-star live pipeline, {n_nodes} nodes, "
+            f"{n_nodes * 5} allocs, ports+distinct_hosts, {n_jobs} jobs x "
+            f"{allocs_per_job}, 4 workers; success cpu={cpu_success:.3f} "
+            f"tpu={tpu_success:.3f}; lone-eval p99 "
+            f"cpu={cpu_lone_p99 * 1000:.0f}ms tpu={tpu_lone_p99 * 1000:.0f}ms; "
+            f"batcher: {dstats.get('dispatches', 0)} dispatches x "
+            f"{occupancy:.1f} evals avg, "
+            f"{dstats.get('compact_dispatches', 0)} compact of "
+            f"{dstats.get('overlay_dispatches', 0)} overlay; "
+            + _breakdown_str(dstats)), \
         cpu_rate, cpu_lone_p99, tpu_rate, tpu_lone_p99
 
 
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
-           6: config_6, 7: config_5s}
+           6: config_6, 7: config_5s, 8: config_8}
 
 
 def run_config(n):

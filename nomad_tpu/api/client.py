@@ -113,7 +113,14 @@ class Client:
         params: Optional[Dict[str, str]] = None,
     ) -> Tuple[Any, int]:
         payload, headers = self._raw_request(method, path, body, params)
-        index = int(headers.get("X-Nomad-Index") or 0)
+        # Case-insensitive: proxies/HTTP2 gateways lowercase header
+        # names, and a missed index would turn every blocking query
+        # into a silent busy-poll.
+        index = 0
+        for k, v in headers.items():
+            if k.lower() == "x-nomad-index":
+                index = int(v or 0)
+                break
         return json.loads(payload or b"null"), index
 
     def get(self, path: str, params: Optional[Dict] = None) -> Tuple[Any, int]:

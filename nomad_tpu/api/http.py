@@ -101,6 +101,12 @@ class HTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Idle keep-alive connections must not pin handler threads
+            # forever: readline times out, handle_one_request closes
+            # the connection. Above MAX_BLOCKING_WAIT so a parked
+            # long-poll (which blocks in the handler, not in readline)
+            # is never cut short.
+            timeout = MAX_BLOCKING_WAIT + 30.0
 
             def setup(self):
                 with api._conn_count_lock:

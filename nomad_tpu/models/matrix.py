@@ -39,6 +39,12 @@ from ..structs.resources import Resources
 BUCKETS = [128, 256, 512, 1024, 2048, 4096, 6144, 8192, 10240, 12288,
            16384, 20480, 24576, 32768]
 ASK_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024]
+# Compact-overlay padding buckets (each distinct size is one compile):
+# class count, feasibility-patch rows, job alloc positions. Overlays
+# larger than the top bucket fall back to the dense [N,G] overlay.
+CLASS_BUCKETS = [8, 32, 128]
+PATCH_BUCKETS = [16, 64, 256]
+JOBPOS_BUCKETS = [16, 64, 256, 1024]
 
 # Job-independent cluster base, cached across evaluations: rebuilding
 # the [N,4] utilization matrices is O(N x allocs) host work per eval,
@@ -95,9 +101,7 @@ class _ClusterBase:
         self._init_class_index(nodes)
         self._positions = None  # job_id -> {tg: row indices}, lazy
         self._positions_lock = __import__("threading").Lock()
-        for i, node in enumerate(nodes):
-            self.alloc_groups.append([])
-            self._fill_row(i, node, proposed_fn(node.id))
+        self._fill_all(nodes, proposed_fn)
 
     def _init_class_index(self, nodes) -> None:
         """Node -> computed-class index, so feasibility evaluates once
@@ -105,19 +109,9 @@ class _ClusterBase:
         (the dense analog of FeasibilityWrapper's memo,
         scheduler/feasible.go:457). Node-level, alloc-independent:
         delta clones share it by reference."""
+        ids, self.class_reps = compute_class_index(nodes)
         self.class_ids = np.full(self.n, -1, np.int32)
-        self.class_reps: List[int] = []
-        index: Dict[str, int] = {}
-        for i, node in enumerate(nodes):
-            cls = node.computed_class
-            if not cls:
-                continue
-            ci = index.get(cls)
-            if ci is None:
-                ci = len(self.class_reps)
-                index[cls] = ci
-                self.class_reps.append(i)
-            self.class_ids[i] = ci
+        self.class_ids[: len(nodes)] = ids
 
     def job_positions(self, job_id: str) -> Dict[str, np.ndarray]:
         """{task_group: node-row indices (with repeats)} for one job's
@@ -140,8 +134,10 @@ class _ClusterBase:
                 }
             return self._positions.get(job_id, {})
 
-    def _fill_row(self, i, node, allocs) -> None:
-        """(Re)compute one node's row from its object + live allocs."""
+    def _fill_static(self, i, node) -> Tuple[float, float, int]:
+        """Node-only (alloc-independent) fields of one row. Returns
+        (reserved bw, reserved dynamic-port count) for the caller to
+        combine with alloc usage."""
         r = node.resources
         self.capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
         res = node.reserved
@@ -155,25 +151,72 @@ class _ClusterBase:
         )
         self.util[i] = (res_cpu, res_mem, res_disk, res_iops)
         self.bw_avail[i] = r.networks[0].mbits if r.networks else 0.0
-        self.bw_used[i] = 0.0
+        res_bw = 0.0
         ports_used = 0
         if res:
             for net in res.networks:
-                self.bw_used[i] += net.mbits
+                res_bw += net.mbits
                 for p in list(net.reserved_ports) + list(net.dynamic_ports):
                     if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
                         ports_used += 1
+        self.bw_used[i] = res_bw
+        return res_bw, ports_used
+
+    def _fill_row(self, i, node, allocs) -> None:
+        """(Re)compute one node's row from its object + live allocs
+        (the delta-update path; full builds go through _fill_all)."""
+        _res_bw, ports_used = self._fill_static(i, node)
+        # Accumulate in python floats: one numpy scalar op per ALLOC
+        # (util[i] += tuple) was the dominant cost of row fills.
+        cpu = mem = disk = iops = bw = 0.0
         groups: List[Tuple[str, str]] = []
         for alloc in allocs:
-            cpu, mem, disk, iops, mbits, aports = _alloc_usage(alloc)
-            self.util[i] += (cpu, mem, disk, iops)
-            self.bw_used[i] += mbits
+            c, m, d, io, mbits, aports = _alloc_usage(alloc)
+            cpu += c
+            mem += m
+            disk += d
+            iops += io
+            bw += mbits
             ports_used += aports
             groups.append((alloc.job_id, alloc.task_group))
+        if allocs:
+            self.util[i] += (cpu, mem, disk, iops)
+            self.bw_used[i] += bw
         self.alloc_groups[i] = groups
         self.ports_free[i] = (
             consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT - ports_used)
         self.node_ok[i] = True
+
+    def _fill_all(self, nodes, proposed_fn) -> None:
+        """Full build, vectorized over allocs: statics per node (a
+        python loop over N cheap attribute reads), then ONE bulk
+        scatter-add of every alloc's memoized usage — the per-row
+        python/numpy churn here dominated the per-eval matrix cost in
+        system storms (BASELINE config 5/7)."""
+        n_real = self.n_real
+        rows: List[int] = []
+        usages: List[Tuple] = []
+        static_ports = np.zeros(n_real, np.float32)
+        for i, node in enumerate(nodes):
+            _res_bw, ports_used = self._fill_static(i, node)
+            static_ports[i] = ports_used
+            groups: List[Tuple[str, str]] = []
+            for alloc in proposed_fn(node.id):
+                rows.append(i)
+                usages.append(_alloc_usage(alloc))
+                groups.append((alloc.job_id, alloc.task_group))
+            self.alloc_groups.append(groups)
+        alloc_ports = np.zeros(n_real, np.float32)
+        if rows:
+            ridx = np.asarray(rows, np.intp)
+            ua = np.asarray(usages, np.float32)
+            np.add.at(self.util[:n_real], ridx, ua[:, :4])
+            np.add.at(self.bw_used[:n_real], ridx, ua[:, 4])
+            np.add.at(alloc_ports, ridx, ua[:, 5])
+        self.ports_free[:n_real] = (
+            consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
+            - static_ports - alloc_ports)
+        self.node_ok[:n_real] = True
 
     def delta_update(self, nodes, state,
                      new_allocs_index: int) -> Optional["_ClusterBase"]:
@@ -289,6 +332,130 @@ class _ClusterBase:
         self._positions = patched
 
 
+def compute_class_index(nodes) -> Tuple[np.ndarray, List[int]]:
+    """Node -> computed-class index: ids[i] is the class number of
+    nodes[i] (-1 = classless), class_reps[c] a representative row."""
+    ids = np.full(len(nodes), -1, np.int32)
+    reps: List[int] = []
+    index: Dict[str, int] = {}
+    for i, node in enumerate(nodes):
+        cls = node.computed_class
+        if not cls:
+            continue
+        ci = index.get(cls)
+        if ci is None:
+            ci = len(reps)
+            index[cls] = ci
+            reps.append(i)
+        ids[i] = ci
+    return ids, reps
+
+
+# Ready-node class index cached per snapshot node set: every system
+# eval of a storm sees the same ready nodes, and the O(N) class walk
+# per eval would otherwise dominate the vectorized diff.
+_CLASS_INDEX_CACHE: Dict[Tuple, Tuple[np.ndarray, List[int]]] = {}
+_CLASS_INDEX_MAX = 4
+
+
+def ready_class_index(state, nodes, dcs) -> Tuple[np.ndarray, List[int]]:
+    key = None
+    if hasattr(state, "index") and getattr(state, "store_id", ""):
+        key = (state.store_id, state.index("nodes"),
+               tuple(sorted(dcs or [])), len(nodes))
+        with _BASE_CACHE_LOCK:
+            cached = _CLASS_INDEX_CACHE.get(key)
+        if cached is not None:
+            return cached
+    out = compute_class_index(nodes)
+    if key is not None:
+        with _BASE_CACHE_LOCK:
+            while len(_CLASS_INDEX_CACHE) >= _CLASS_INDEX_MAX:
+                _CLASS_INDEX_CACHE.pop(next(iter(_CLASS_INDEX_CACHE)))
+            _CLASS_INDEX_CACHE[key] = out
+    return out
+
+
+def node_feasibility(state, job, groups, nodes, class_ids, class_reps,
+                     return_verdicts: bool = False):
+    """[len(nodes), G] constraint mask. Non-escaped job/TG constraints
+    are evaluated ONCE PER COMPUTED CLASS on a representative node and
+    numpy-expanded; escaped constraints and classless nodes fall back
+    to per-node checks (node_class.go:70).
+
+    With return_verdicts, returns (feasible, verdicts [C, G] or None):
+    the per-class verdicts are the compact form the device-side overlay
+    expansion consumes (ops/binpack.py CompactOverlay)."""
+    n_real = len(nodes)
+    g = len(groups)
+    feasible = np.zeros((n_real, g), bool)
+    ctx = EvalContext(state, Plan())
+
+    job_cons = job.constraints
+    job_escaped = escaped_constraints(job_cons)
+    job_static = [c for c in job_cons if c not in job_escaped]
+
+    per_group = []
+    any_esc = bool(job_escaped)
+    for tg in groups:
+        cons = list(tg.constraints)
+        drivers = set()
+        for task in tg.tasks:
+            cons.extend(task.constraints)
+            drivers.add(task.driver)
+        esc = escaped_constraints(cons)
+        static = [c for c in cons if c not in esc]
+        any_esc = any_esc or bool(esc)
+        per_group.append((static, esc, drivers))
+
+    job_checker = ConstraintChecker(ctx, job_static)
+    cons_checker = ConstraintChecker(ctx)
+    driver_checker = DriverChecker(ctx)
+    esc_checker = ConstraintChecker(ctx)
+
+    def static_row(node) -> np.ndarray:
+        row = np.zeros(g, bool)
+        if not job_checker.feasible(node):
+            return row
+        for gi, (static, _esc, drivers) in enumerate(per_group):
+            driver_checker.set_drivers(drivers)
+            cons_checker.set_constraints(static)
+            row[gi] = (driver_checker.feasible(node)
+                       and cons_checker.feasible(node))
+        return row
+
+    # One evaluation per class, expanded by numpy take.
+    verdicts = None
+    if class_reps:
+        verdicts = np.stack([static_row(nodes[rep]) for rep in class_reps])
+        ids = class_ids[:n_real]
+        classed = ids >= 0
+        feasible[classed] = verdicts[ids[classed]]
+    # Classless nodes: individual evaluation (flatnonzero — a python
+    # scan over 10k rows that are all classed would cost more than
+    # the class pass saved).
+    for i in np.flatnonzero(class_ids[:n_real] < 0):
+        feasible[i] = static_row(nodes[i])
+    # Escaped constraints reference unique per-node attrs: they can
+    # never ride the class verdict (node_class.go:70) — walk only
+    # the still-candidate rows.
+    if any_esc:
+        for i in np.flatnonzero(feasible.any(axis=1)):
+            node = nodes[i]
+            if job_escaped:
+                esc_checker.set_constraints(job_escaped)
+                if not esc_checker.feasible(node):
+                    feasible[i] = False
+                    continue
+            for gi, (_static, esc, _drivers) in enumerate(per_group):
+                if esc and feasible[i, gi]:
+                    esc_checker.set_constraints(esc)
+                    feasible[i, gi] = esc_checker.feasible(node)
+    if return_verdicts:
+        return feasible, verdicts
+    return feasible
+
+
 def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
     i = bisect.bisect_left(buckets, max(n, 1))
     if i == len(buckets):
@@ -300,7 +467,17 @@ def bucket_size(n: int, buckets: List[int] = BUCKETS) -> int:
 
 def _alloc_usage(alloc: Allocation) -> Tuple[float, float, float, float, float, int]:
     """(cpu, mem, disk, iops, mbits, dyn_ports_in_range) consumed by one
-    alloc — same accounting as AllocsFit (structs/funcs.go:72-94)."""
+    alloc — same accounting as AllocsFit (structs/funcs.go:72-94).
+
+    Memoized on the alloc object: an alloc's usage never changes after
+    creation (store writes replace the object), and every base rebuild
+    across a storm re-reads the same allocs — the attribute-walk here
+    was the top cost of the per-eval matrix build. Allocation.copy()
+    drops the memo (a copy's resources may be rewritten, e.g. in-place
+    updates)."""
+    cached = alloc.__dict__.get("_dense_usage")
+    if cached is not None:
+        return cached
     cpu = mem = disk = iops = 0.0
     mbits = 0.0
     ports = 0
@@ -325,7 +502,9 @@ def _alloc_usage(alloc: Allocation) -> Tuple[float, float, float, float, float, 
             for p in list(n0.reserved_ports) + list(n0.dynamic_ports):
                 if consts.MIN_DYNAMIC_PORT <= p.value < consts.MAX_DYNAMIC_PORT:
                     ports += 1
-    return cpu, mem, disk, iops, mbits, ports
+    usage = (cpu, mem, disk, iops, mbits, ports)
+    alloc._dense_usage = usage
+    return usage
 
 
 class ClusterMatrix:
@@ -424,6 +603,9 @@ class ClusterMatrix:
         self.bw_used = base.bw_used
         self.ports_free = base.ports_free
         self.node_ok = base.node_ok
+        # Padded [N] class index: rides the device base upload so the
+        # compact overlay's verdict expansion happens on device.
+        self.class_ids = base.class_ids
 
         # Job-specific overlay: this job's per-node alloc counts, from
         # the base's lazy positions index (O(this job's allocs)).
@@ -437,81 +619,79 @@ class ClusterMatrix:
                 np.add.at(tg_count[:, gi], rows, 1)
         self.job_count = job_count
         self.tg_count = tg_count
-        self.feasible = self._build_feasibility(base)
+        self.feasible, verdicts = self._build_feasibility(base)
+        self._build_compact_overlay(base, verdicts)
 
-    def _build_feasibility(self, base) -> np.ndarray:
-        """[N, G] constraint mask. Non-escaped job/TG constraints are
-        evaluated ONCE PER COMPUTED CLASS on a representative node and
-        numpy-expanded to all N (a python loop over 10k nodes per eval
-        was the other half of the live overlay cost); escaped
-        constraints and classless nodes fall back to per-node checks."""
-        n, g = self.n, self.g
-        n_real = self.n_real
-        feasible = np.zeros((n, g), bool)
-        ctx = EvalContext(self.state, Plan())
+    def _build_compact_overlay(self, base, verdicts) -> None:
+        """The pre-expansion overlay (ops/binpack.py CompactOverlay):
+        per-class verdicts + a sparse patch for rows the class verdict
+        can't represent, and this job's alloc row positions — a few KB
+        per eval instead of the ~100KB x G dense overlay at 10k nodes.
+        None (dense fallback) when the base isn't device-cacheable or
+        any component overflows its top padding bucket."""
+        self.compact_overlay = None
+        if self.base_token is None or verdicts is None:
+            return
+        n_real, g = self.n_real, self.g
+        ids = base.class_ids[:n_real]
+        if len(base.class_reps) > CLASS_BUCKETS[-1]:
+            return
+        # Patch rows: wherever the real mask differs from the class
+        # expansion (classless nodes, escaped constraints).
+        expected = np.zeros((n_real, g), bool)
+        classed = ids >= 0
+        expected[classed] = verdicts[ids[classed]]
+        feas_real = self.feasible[:n_real]
+        patch_rows = np.flatnonzero((feas_real != expected).any(axis=1))
+        if len(patch_rows) > PATCH_BUCKETS[-1]:
+            return
+        # This job's alloc positions, flattened with their TG indices.
+        gi_by_name = {tg.name: gi for gi, tg in enumerate(self.groups)}
+        rows_parts: List[np.ndarray] = []
+        tg_parts: List[np.ndarray] = []
+        n_pos = 0
+        for task_group, rows in base.job_positions(self.job.id).items():
+            gi = gi_by_name.get(task_group)
+            if gi is None:
+                continue
+            rows_parts.append(rows)
+            tg_parts.append(np.full(len(rows), gi, np.int64))
+            n_pos += len(rows)
+        if n_pos > JOBPOS_BUCKETS[-1]:
+            return
+        c_pad = bucket_size(max(len(base.class_reps), 1), CLASS_BUCKETS)
+        p_pad = bucket_size(len(patch_rows), PATCH_BUCKETS) \
+            if len(patch_rows) else PATCH_BUCKETS[0]
+        j_pad = bucket_size(n_pos, JOBPOS_BUCKETS) \
+            if n_pos else JOBPOS_BUCKETS[0]
+        verd = np.zeros((c_pad, g), bool)
+        verd[: len(verdicts)] = verdicts
+        # Pad with self.n: out of range, dropped by the device scatter.
+        p_rows = np.full(p_pad, self.n, np.int32)
+        p_rows[: len(patch_rows)] = patch_rows
+        p_vals = np.zeros((p_pad, g), bool)
+        p_vals[: len(patch_rows)] = feas_real[patch_rows]
+        j_rows = np.full(j_pad, self.n, np.int32)
+        j_tgs = np.zeros(j_pad, np.int32)
+        if n_pos:
+            j_rows[:n_pos] = np.concatenate(rows_parts)
+            j_tgs[:n_pos] = np.concatenate(tg_parts)
+        from ..ops.binpack import CompactOverlay
 
-        job_cons = self.job.constraints
-        job_escaped = escaped_constraints(job_cons)
-        job_static = [c for c in job_cons if c not in job_escaped]
+        self.compact_overlay = CompactOverlay(
+            verdicts=verd, patch_rows=p_rows, patch_vals=p_vals,
+            job_rows=j_rows, job_tgs=j_tgs)
 
-        per_group = []
-        any_esc = bool(job_escaped)
-        for tg in self.groups:
-            cons = list(tg.constraints)
-            drivers = set()
-            for task in tg.tasks:
-                cons.extend(task.constraints)
-                drivers.add(task.driver)
-            esc = escaped_constraints(cons)
-            static = [c for c in cons if c not in esc]
-            any_esc = any_esc or bool(esc)
-            per_group.append((static, esc, drivers))
-
-        job_checker = ConstraintChecker(ctx, job_static)
-        cons_checker = ConstraintChecker(ctx)
-        driver_checker = DriverChecker(ctx)
-        esc_checker = ConstraintChecker(ctx)
-
-        def static_row(node) -> np.ndarray:
-            row = np.zeros(g, bool)
-            if not job_checker.feasible(node):
-                return row
-            for gi, (static, _esc, drivers) in enumerate(per_group):
-                driver_checker.set_drivers(drivers)
-                cons_checker.set_constraints(static)
-                row[gi] = (driver_checker.feasible(node)
-                           and cons_checker.feasible(node))
-            return row
-
-        # One evaluation per class, expanded by numpy take.
-        if base.class_reps:
-            verdicts = np.stack([
-                static_row(self.nodes[rep]) for rep in base.class_reps
-            ])
-            ids = base.class_ids[:n_real]
-            classed = ids >= 0
-            feasible[:n_real][classed] = verdicts[ids[classed]]
-        # Classless nodes: individual evaluation (flatnonzero — a python
-        # scan over 10k rows that are all classed would cost more than
-        # the class pass saved).
-        for i in np.flatnonzero(base.class_ids[:n_real] < 0):
-            feasible[i] = static_row(self.nodes[i])
-        # Escaped constraints reference unique per-node attrs: they can
-        # never ride the class verdict (node_class.go:70) — walk only
-        # the still-candidate rows.
-        if any_esc:
-            for i in np.flatnonzero(feasible[:n_real].any(axis=1)):
-                node = self.nodes[i]
-                if job_escaped:
-                    esc_checker.set_constraints(job_escaped)
-                    if not esc_checker.feasible(node):
-                        feasible[i] = False
-                        continue
-                for gi, (_static, esc, _drivers) in enumerate(per_group):
-                    if esc and feasible[i, gi]:
-                        esc_checker.set_constraints(esc)
-                        feasible[i, gi] = esc_checker.feasible(node)
-        return feasible
+    def _build_feasibility(self, base):
+        """([N, G] padded mask, per-class verdicts or None); see
+        node_feasibility."""
+        feasible = np.zeros((self.n, self.g), bool)
+        real, verdicts = node_feasibility(
+            self.state, self.job, self.groups, self.nodes,
+            base.class_ids[: self.n_real], base.class_reps,
+            return_verdicts=True)
+        feasible[: self.n_real] = real
+        return feasible, verdicts
 
     # ------------------------------------------------------------------
 

@@ -83,11 +83,12 @@ def _node_state_specs(batched: bool) -> NodeState:
 def base_specs() -> Tuple:
     """PartitionSpecs for the batcher's cluster-base tuple, IN ITS
     ORDER: (capacity, sched_capacity, util, bw_avail, bw_used,
-    ports_free, node_ok). Lives here so the pairing between field and
-    spec cannot drift from the dispatch-side shardings above."""
+    ports_free, node_ok, class_ids). Lives here so the pairing between
+    field and spec cannot drift from the dispatch-side shardings
+    above."""
     s = _node_state_specs(batched=False)
     return (s.capacity, s.sched_capacity, s.util, s.bw_avail,
-            s.bw_used, s.ports_free, s.node_ok)
+            s.bw_used, s.ports_free, s.node_ok, P(NODE_AXIS))
 
 
 def _asks_specs(batched: bool) -> Asks:

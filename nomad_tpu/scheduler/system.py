@@ -149,6 +149,15 @@ class SystemScheduler:
 
         return True
 
+    def _diff_system(self, tainted, allocs, terminal_allocs):
+        """Diff hook. Returns (DiffResult, prefiltered) where
+        prefiltered maps tg name -> [count, first_node] of place
+        candidates a subclass already ruled out by constraint (the
+        dense scheduler gates the place set up front; here nothing is
+        pre-filtered — the placement loop filters one at a time)."""
+        return diff_system_allocs(
+            self.job, self.nodes, tainted, allocs, terminal_allocs), {}
+
     def _compute_job_allocs(self) -> None:
         allocs = self.state.allocs_by_job(self.eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
@@ -157,9 +166,8 @@ class SystemScheduler:
 
         allocs, terminal_allocs = filter_terminal_allocs(allocs)
 
-        diff = diff_system_allocs(
-            self.job, self.nodes, tainted, allocs, terminal_allocs
-        )
+        diff, prefiltered = self._diff_system(
+            tainted, allocs, terminal_allocs)
         self.logger.debug("eval %s job %s: %s", self.eval.id, self.eval.job_id, diff)
 
         for e in diff.stop:
@@ -191,7 +199,10 @@ class SystemScheduler:
             self.ctx, diff, diff.update, ALLOC_UPDATING, limit
         )
 
-        if not diff.place:
+        # Zero every TG's queue only when there were NO candidates at
+        # all: a fully-prefiltered eval must instead look like "every
+        # placement was filtered" (same records the host loop leaves).
+        if not diff.place and not prefiltered:
             if self.job is not None:
                 for tg in self.job.task_groups:
                     self.queued_allocs[tg.name] = 0
@@ -202,7 +213,32 @@ class SystemScheduler:
                 self.queued_allocs.get(tup.task_group.name, 0) + 1
             )
 
-        self._compute_placements(diff.place)
+        if diff.place:
+            self._compute_placements(diff.place)
+        if prefiltered:
+            self._merge_prefiltered(prefiltered)
+
+    def _merge_prefiltered(self, prefiltered) -> None:
+        """Fold diff-gated (constraint-infeasible) candidates into the
+        same records the placement loop produces by filtering them one
+        at a time: the queued key exists with its feasible-only net
+        value, and failed_tg_allocs carries the filtered tally."""
+        for name, (count, first_node) in prefiltered.items():
+            if count <= 0:
+                continue
+            self.queued_allocs.setdefault(name, 0)
+            if self.failed_tg_allocs is None:
+                self.failed_tg_allocs = {}
+            existing = self.failed_tg_allocs.get(name)
+            if existing is not None:
+                existing.coalesced_failures += count
+                continue
+            metrics = AllocMetric()
+            metrics.nodes_available = self.nodes_by_dc
+            metrics.evaluate_node()
+            metrics.filter_node(first_node, "constraint")
+            metrics.coalesced_failures = count - 1
+            self.failed_tg_allocs[name] = metrics
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         node_by_id = {n.id: n for n in self.nodes}

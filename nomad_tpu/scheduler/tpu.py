@@ -215,15 +215,133 @@ class BatchedTPUScheduler(GenericScheduler):
                     bool(matrix.feasible[i, gi]), name, node.computed_class
                 )
 
+def dense_diff_system_allocs(state, job, nodes, tainted, allocs,
+                             terminal_allocs):
+    """diff_system_allocs (scheduler/util.go:62) with the place set
+    feasibility-gated up front: the host version materializes one
+    AllocTuple (and a stub Allocation) per required slot on EVERY ready
+    node, then the placement loop filters the infeasible ones one
+    python iteration at a time — at 10k nodes with rack-scoped system
+    jobs that is ~9k tuples built and discarded per eval. Here the
+    class-vectorized constraint mask picks the candidate rows first and
+    only those materialize; the infeasible remainder is returned as
+    per-task-group counts for the caller's metric/queued bookkeeping.
+
+    Returns (DiffResult, prefiltered) where prefiltered maps
+    tg name -> [count, first_infeasible_node]."""
+    from ..models.matrix import node_feasibility, ready_class_index
+    from .util import DiffResult, diff_allocs, materialize_task_groups
+
+    groups = job.task_groups
+    class_ids, class_reps = ready_class_index(state, nodes, job.datacenters)
+    feasible = node_feasibility(state, job, groups, nodes,
+                                class_ids, class_reps)
+    gi_by_name = {tg.name: gi for gi, tg in enumerate(groups)}
+    required = materialize_task_groups(job)
+    result = DiffResult()
+    prefiltered: Dict[str, list] = {}
+
+    def gate_place(tuples, row):
+        """Feasibility-gate one node's place tuples."""
+        kept = []
+        for tup in tuples:
+            if feasible[row, gi_by_name[tup.task_group.name]]:
+                kept.append(tup)
+            else:
+                ent = prefiltered.get(tup.task_group.name)
+                if ent is None:
+                    prefiltered[tup.task_group.name] = [1, nodes[row]]
+                else:
+                    ent[0] += 1
+        return kept
+
+    node_row = {n.id: i for i, n in enumerate(nodes)}
+    # Nodes holding this job's allocs: the faithful per-node diff
+    # (stop/lost/update/ignore need the alloc-level comparisons).
+    node_allocs: Dict[str, List[Allocation]] = {}
+    for alloc in allocs:
+        node_allocs.setdefault(alloc.node_id, []).append(alloc)
+    for node_id, nallocs in node_allocs.items():
+        diff = diff_allocs(job, tainted, required, nallocs, terminal_allocs)
+        if node_id in tainted:
+            diff.place = []
+        else:
+            row = node_row.get(node_id)
+            for tup in diff.place:
+                if tup.alloc is None or tup.alloc.node_id != node_id:
+                    tup.alloc = Allocation(node_id=node_id)
+            diff.place = (gate_place(diff.place, row)
+                          if row is not None else diff.place)
+        # A tainted node invalidates the job there: migrations -> stops.
+        diff.stop.extend(diff.migrate)
+        diff.migrate = []
+        result.append(diff)
+
+    # Nodes WITHOUT allocs place every required slot; candidates and
+    # the infeasible tally come from array ops, python only touches
+    # the (usually few) feasible rows.
+    has_alloc = np.zeros(len(nodes), bool)
+    for node_id in node_allocs:
+        row = node_row.get(node_id)
+        if row is not None:
+            has_alloc[row] = True
+    candidates = ~has_alloc
+    if tainted:
+        for node_id in tainted:
+            row = node_row.get(node_id)
+            if row is not None:
+                candidates[row] = False
+    cand_feasible = candidates[:, None] & feasible
+    any_rows = np.flatnonzero(cand_feasible.any(axis=1))
+    for i in any_rows:
+        node_id = nodes[i].id
+        for name, tg in required.items():
+            if not cand_feasible[i, gi_by_name[tg.name]]:
+                continue
+            talloc = terminal_allocs.get(name)
+            if talloc is None or talloc.node_id != node_id:
+                talloc = Allocation(node_id=node_id)
+            result.place.append(AllocTuple(name, tg, talloc))
+    # Infeasible tallies + first offender per TG, without materializing.
+    slots_per_tg = {tg.name: 0 for tg in groups}
+    for _name, tg in required.items():
+        slots_per_tg[tg.name] += 1
+    for tg in groups:
+        gi = gi_by_name[tg.name]
+        bad = candidates & ~feasible[:, gi]
+        n_bad = int(bad.sum()) * slots_per_tg[tg.name]
+        if not n_bad:
+            continue
+        ent = prefiltered.get(tg.name)
+        if ent is None:
+            prefiltered[tg.name] = [n_bad, nodes[int(np.argmax(bad))]]
+        else:
+            ent[0] += n_bad
+    return result, prefiltered
+
+
 class DenseSystemScheduler(SystemScheduler):
-    """SystemScheduler whose placement loop is one vectorized pass.
+    """SystemScheduler whose diff and placement loops are vectorized
+    passes.
 
     The host loop (system_sched.go:255) builds a one-node iterator
     stack per pinned placement; here the whole placement set is checked
     against a single ClusterMatrix: constraint feasibility comes from
     the [N, G] mask, resource fit is a vectorized AllocsFit over the
     pinned rows, and in-eval utilization accumulates per task group so
-    multi-TG system jobs see their own earlier placements."""
+    multi-TG system jobs see their own earlier placements. The diff is
+    feasibility-gated up front (dense_diff_system_allocs), so the
+    pinned matrix and the plan only ever see candidate nodes."""
+
+    def _diff_system(self, tainted, allocs, terminal_allocs):
+        """Feasibility-gated diff (see dense_diff_system_allocs). A
+        deregistered job (job=None: every alloc diffs into stop) takes
+        the host diff — there is nothing to gate without constraints."""
+        if self.job is None:
+            return super()._diff_system(tainted, allocs, terminal_allocs)
+        return dense_diff_system_allocs(
+            self.state, self.job, self.nodes, tainted, allocs,
+            terminal_allocs)
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
@@ -288,18 +406,25 @@ class DenseSystemScheduler(SystemScheduler):
             np.add.at(ports_free, acc, -ask_ports)
 
         net_indexes: Dict[str, NetworkIndex] = {}
+        # Successful pinned placements all carry the identical metric
+        # record (one node evaluated, same availability): share ONE
+        # object across the plan — the store's upsert copies it per
+        # alloc, so sharing here is invisible downstream, and a system
+        # storm materializes ~N of these per eval.
+        success_metrics: Optional[AllocMetric] = None
 
         for j, missing in enumerate(place):
             name = missing.task_group.name
             node = matrix.nodes[rows[j]]
-            # Per-placement metrics, like the host path where every
-            # stack.select starts fresh (stack.go Select → ctx reset);
-            # the pinned node is the one node evaluated.
-            metrics = AllocMetric()
-            metrics.nodes_available = self.nodes_by_dc
-            metrics.evaluate_node()
 
             if not fits[j]:
+                # Failure paths mutate their metric record, so those
+                # stay per-placement, like the host path where every
+                # stack.select starts fresh (stack.go Select → ctx
+                # reset); the pinned node is the one node evaluated.
+                metrics = AllocMetric()
+                metrics.nodes_available = self.nodes_by_dc
+                metrics.evaluate_node()
                 if not feasible[j]:
                     # Constraint mismatch: the alloc was never really
                     # "queued" on this node (host path's nodes_filtered
@@ -332,8 +457,12 @@ class DenseSystemScheduler(SystemScheduler):
                 super()._compute_placements([missing])
                 continue
 
+            if success_metrics is None:
+                success_metrics = AllocMetric()
+                success_metrics.nodes_available = self.nodes_by_dc
+                success_metrics.evaluate_node()
             self.plan.append_alloc(_build_allocation(
-                self, missing, node, task_resources, metrics))
+                self, missing, node, task_resources, success_metrics))
 
     def _offer_networks_on(self, missing: AllocTuple, node, net_indexes,
                            matrix):

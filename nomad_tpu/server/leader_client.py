@@ -14,7 +14,7 @@ from typing import List, Optional, Tuple
 
 from ..structs import Evaluation, Plan, PlanResult
 from ..utils.codec import from_dict, to_dict
-from ..utils.httppool import PoolError, shared_pool
+from ..utils.httppool import HTTPPool, PoolError
 
 
 class LeaderUnavailableError(Exception):
@@ -24,16 +24,19 @@ class LeaderUnavailableError(Exception):
 class RemoteLeader:
     """Leader-only operations executed on a remote leader.
 
-    Rides the process-wide keep-alive pool (pool.go:144): a follower's
-    workers dequeue/ack/submit against the leader on a handful of
-    persistent sockets instead of a TCP handshake per RPC."""
+    Rides a keep-alive pool (pool.go:144): a follower's workers
+    dequeue/ack/submit against the leader on a handful of persistent
+    sockets instead of a TCP handshake per RPC. The pool is
+    per-instance (the server caches one RemoteLeader per leader addr):
+    a process-wide pool keyed by address could hand a NEW leader's
+    client a socket opened to a previous process on a reused port."""
 
     def __init__(self, addr: str, timeout: float = 10.0):
         self.addr = addr.rstrip("/")
         self.timeout = timeout
         # The dequeue long-poll passes per-call timeouts above
         # self.timeout; size the pool's ceiling for those.
-        self._pool = shared_pool(self.addr, timeout=120.0)
+        self._pool = HTTPPool(self.addr, timeout=120.0)
 
     def _call(self, path: str, body: dict, timeout: Optional[float] = None):
         try:

@@ -64,8 +64,10 @@ class AllocMetric:
     def copy(self) -> "AllocMetric":
         # Field-wise (values are scalars/flat dicts): metrics are copied
         # once per upserted alloc, so the deepcopy machinery showed up
-        # in the plan-apply profile.
-        new = copy.copy(self)
+        # in the plan-apply profile. (__new__ + __dict__.update is ~4x
+        # cheaper than copy.copy's reduce protocol.)
+        new = AllocMetric.__new__(AllocMetric)
+        new.__dict__.update(self.__dict__)
         new.nodes_available = dict(self.nodes_available)
         new.class_filtered = dict(self.class_filtered)
         new.constraint_filtered = dict(self.constraint_filtered)
@@ -127,7 +129,8 @@ class Allocation:
         # embedded job is immutable-by-convention (the store's MVCC
         # semantics: every job write stores a fresh object, readers
         # never mutate it in place) so the reference is shared.
-        new = copy.copy(self)
+        new = Allocation.__new__(Allocation)
+        new.__dict__.update(self.__dict__)
         new.resources = self.resources.copy() if self.resources else None
         new.shared_resources = (
             self.shared_resources.copy() if self.shared_resources else None)
@@ -139,6 +142,9 @@ class Allocation:
                          events=[copy.copy(e) for e in ts.events])
             for k, ts in self.task_states.items()
         }
+        # The dense matrix's usage memo must not survive into a copy
+        # whose resources may be rewritten (in-place updates).
+        new.__dict__.pop("_dense_usage", None)
         return new
 
     def index(self) -> int:

@@ -280,8 +280,13 @@ class JobSummary:
     def copy(self) -> "JobSummary":
         # Flat dataclass of counters — field-wise copy keeps the
         # per-alloc summary update out of the deepcopy machinery.
-        new = copy.copy(self)
-        new.summary = {k: copy.copy(v) for k, v in self.summary.items()}
+        new = JobSummary.__new__(JobSummary)
+        new.__dict__.update(self.__dict__)
+        new.summary = {}
+        for k, v in self.summary.items():
+            tgs = TaskGroupSummary.__new__(TaskGroupSummary)
+            tgs.__dict__.update(v.__dict__)
+            new.summary[k] = tgs
         return new
 
 

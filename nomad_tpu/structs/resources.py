@@ -63,7 +63,8 @@ class Resources:
     DEFAULT_IOPS = 0
 
     def copy(self) -> "Resources":
-        new = copy.copy(self)
+        new = Resources.__new__(Resources)
+        new.__dict__.update(self.__dict__)
         new.networks = [n.copy() for n in self.networks]
         return new
 

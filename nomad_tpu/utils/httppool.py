@@ -80,9 +80,12 @@ class HTTPPool:
                         # read; readable means the peer closed (EOF) or
                         # broke framing. Detecting it HERE matters for
                         # non-idempotent requests, which are never
-                        # retried after their bytes go out.
-                        r, _, _ = select.select([conn.sock], [], [], 0)
-                        if r:
+                        # retried after their bytes go out. poll, not
+                        # select: select() rejects fds >= FD_SETSIZE
+                        # (1024), which a busy agent exceeds.
+                        poller = select.poll()
+                        poller.register(conn.sock, select.POLLIN)
+                        if poller.poll(0):
                             conn.close()
                             continue
                 except OSError:
@@ -161,20 +164,3 @@ class HTTPPool:
             return resp.status, resp_headers, payload
 
 
-_SHARED_LOCK = threading.Lock()
-_SHARED: Dict[Tuple[str, float, Optional[int]], HTTPPool] = {}
-
-
-def shared_pool(address: str, timeout: float = 305.0,
-                ssl_context: Optional[ssl.SSLContext] = None) -> HTTPPool:
-    """Process-wide pool per (address, timeout): every SDK client,
-    follower->leader forwarder, and consul syncer in this process that
-    targets the same agent shares sockets (the reference shares its
-    ConnPool per Server for the same reason, pool.go:144)."""
-    key = (address.rstrip("/"), timeout, id(ssl_context) if ssl_context else None)
-    with _SHARED_LOCK:
-        pool = _SHARED.get(key)
-        if pool is None:
-            pool = HTTPPool(address, timeout=timeout, ssl_context=ssl_context)
-            _SHARED[key] = pool
-        return pool

@@ -260,3 +260,62 @@ def test_device_base_cache_is_true_lru(monkeypatch):
     assert b.base_uploads == 3
     place_tok("B", 5)  # B was evicted: one more upload
     assert b.base_uploads == 4
+
+
+def test_compact_overlay_matches_dense_through_live_batcher():
+    """End-to-end: a real ClusterMatrix (which builds a compact
+    overlay) dispatched through the batcher must engage the
+    device-side expansion path and place identically to the dense
+    overlay path."""
+    from nomad_tpu import mock
+    from nomad_tpu.models.matrix import ClusterMatrix
+    from nomad_tpu.ops.binpack import host_prng_key
+    from nomad_tpu.state import StateStore
+
+    store = StateStore()
+    idx = 0
+    for i in range(130):
+        n = mock.node()
+        if i % 9 == 0:
+            n.node_class = ""  # classless rows exercise the patch
+        n.compute_class()
+        idx += 1
+        store.upsert_node(idx, n)
+    job = mock.job()
+    job.task_groups[0].tasks[0].resources.networks = []
+    idx += 1
+    store.upsert_job(idx, job)
+    nodes = store.nodes()
+    allocs = []
+    for i in range(11):  # existing allocs exercise job_rows
+        a = mock.alloc()
+        a.job_id, a.job, a.node_id = job.id, job, nodes[i * 3].id
+        a.task_group = job.task_groups[0].name
+        for tr in a.task_resources.values():
+            tr.networks = []
+        allocs.append(a)
+    idx += 1
+    store.upsert_allocs(idx, allocs)
+    snap = store.snapshot()
+
+    matrix = ClusterMatrix(snap, job)
+    assert matrix.compact_overlay is not None
+    asks = make_asks(*matrix.build_asks([0] * 8))
+
+    b = PlacementBatcher(window=0.0)
+    choices, scores = b.place(matrix, asks, host_prng_key(7), CONFIG)
+    assert b.stats()["compact_dispatches"] == 1
+    assert b.stats()["overlay_dispatches"] == 1
+
+    # Dense path: same matrix with the compact overlay stripped.
+    matrix2 = ClusterMatrix(snap, job)
+    matrix2.compact_overlay = None
+    b2 = PlacementBatcher(window=0.0)
+    choices2, scores2 = b2.place(matrix2, asks, host_prng_key(7), CONFIG)
+    assert b2.stats()["compact_dispatches"] == 0
+    assert np.array_equal(np.asarray(choices), np.asarray(choices2))
+    assert np.allclose(np.asarray(scores), np.asarray(scores2))
+    # The breakdown timers must be recording.
+    st = b.stats()
+    assert st["issue_us"] >= 0 and st["sync_us"] >= 0
+    assert st["payload_bytes"] > 0 and st["upload_bytes"] > 0

@@ -155,3 +155,22 @@ def test_dense_system_parity_with_host_path(seed):
             "status": h.evals[0].status,
         }
     assert results["system"] == results["system-tpu"]
+
+
+def test_dense_system_deregister_stops_all():
+    """job=None (deregistered) must take the ungated host diff: every
+    alloc stops. Regression: the gated diff crashed on job=None."""
+    h = Harness(seed=26)
+    seed_nodes(h, 3)
+    job = strip_networks(mock.system_job())
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system-tpu", new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+    h.state.delete_job(h.next_index(), job.id)
+
+    h2 = Harness(state=h.state, seed=27)
+    h2._next_index = h._next_index
+    h2.process("system-tpu",
+               new_eval(job, consts.EVAL_TRIGGER_JOB_DEREGISTER))
+    stops = [a for lst in h2.plans[0].node_update.values() for a in lst]
+    assert len(stops) == 3
+    h2.assert_eval_status(consts.EVAL_STATUS_COMPLETE)
