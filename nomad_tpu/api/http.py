@@ -84,10 +84,16 @@ class HTTPServer:
     501 there."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
-                 client=None, enable_debug: bool = False):
+                 client=None, enable_debug: bool = False,
+                 ssl_context=None):
         self.server = server
         self.client = client
         self.logger = logging.getLogger("nomad_tpu.http")
+        # TLS termination (agent tls block; reference EnableHTTP,
+        # nomad/structs/config/tls.go). The handshake happens in the
+        # per-connection handler thread (Handler.setup), never in the
+        # accept loop.
+        self.ssl_context = ssl_context
         # Gates the /debug/* introspection routes (the reference gates
         # pprof the same way, command/agent/http.go:135 enableDebug).
         self.enable_debug = enable_debug
@@ -111,6 +117,17 @@ class HTTPServer:
             def setup(self):
                 with api._conn_count_lock:
                     api.connections_accepted += 1
+                if api.ssl_context is not None:
+                    # Bound the handshake: Handler.timeout only lands
+                    # in super().setup(), and an unbounded wrap lets a
+                    # connect-and-say-nothing client pin this thread.
+                    # A failed handshake (plaintext probe, bad cert)
+                    # raises here; _Server.handle_error swallows it
+                    # quietly and socketserver closes the connection.
+                    self.request.settimeout(self.timeout)
+                    self.request = api.ssl_context.wrap_socket(
+                        self.request, server_side=True)
+                    self.connection = self.request
                 super().setup()
 
             def log_message(self, fmt, *args):
@@ -183,9 +200,27 @@ class HTTPServer:
             # herds; give the accept queue real depth.
             request_queue_size = 512
 
+            def handle_error(self, request, client_address):
+                # TLS handshake failures (plaintext probes, health
+                # checkers hitting the https port, cert mismatches) are
+                # the CLIENT's problem — don't traceback-spam stderr
+                # per probe the way the default handler does.
+                import ssl as _ssl
+                import sys as _sys
+
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (_ssl.SSLError, ConnectionError,
+                                    TimeoutError, OSError)):
+                    api.logger.debug(
+                        "connection error from %s: %s", client_address,
+                        exc)
+                    return
+                super().handle_error(request, client_address)
+
         self._httpd = _Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
-        self.addr = f"http://{host}:{self.port}"
+        scheme = "https" if ssl_context is not None else "http"
+        self.addr = f"{scheme}://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -433,7 +468,11 @@ class HTTPServer:
         state = self.server.fsm.state
         secret = query.get("secret", [""])[0]
         node = state.node_by_id(node_id)
-        if secret and (node is None or node.secret_id != secret):
+        # MANDATORY whenever the node carries a secret
+        # (node_endpoint.go:585-607 Node.GetClientAllocs): the old
+        # `if secret` guard let a caller watch any node's allocs by
+        # simply omitting the parameter.
+        if node is not None and node.secret_id and node.secret_id != secret:
             raise HTTPError(403, "node secret ID does not match")
         return self._blocking(
             query,

@@ -84,6 +84,22 @@ class VaultBlock:
 
 
 @dataclass
+class TLSBlock:
+    """Reference: config.go TLSConfig / nomad/structs/config/tls.go —
+    one CA + node cert/key pair covers both wire protocols (the raft
+    transport terminates mTLS, the HTTP API terminates server TLS)."""
+
+    enabled: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    # Reference's EnableRPC/EnableHTTP split: either channel can stay
+    # plaintext during a rolling TLS rollout.
+    rpc: bool = True
+    http: bool = True
+
+
+@dataclass
 class AgentConfig:
     region: str = "global"
     datacenter: str = "dc1"
@@ -100,6 +116,7 @@ class AgentConfig:
     telemetry: TelemetryBlock = field(default_factory=TelemetryBlock)
     consul: ConsulBlock = field(default_factory=ConsulBlock)
     vault: VaultBlock = field(default_factory=VaultBlock)
+    tls: TLSBlock = field(default_factory=TLSBlock)
     # Dotted paths explicitly assigned (by a config file, dev preset, or
     # flag). Merge copies exactly these from the override — so a file
     # CAN set a field back to its default ("explicitly set to the
@@ -178,10 +195,13 @@ _SCHEMA: Dict[str, Any] = {
     "consul.address": str, "consul.server_service_name": str,
     "consul.client_service_name": str, "consul.auto_advertise": bool,
     "vault.enabled": bool, "vault.address": str, "vault.token": str,
+    "tls.enabled": bool, "tls.ca_file": str, "tls.cert_file": str,
+    "tls.key_file": str, "tls.rpc": bool, "tls.http": bool,
 }
 _MAP_KEYS = {"client.options", "client.meta", "client.reserved",
              "server.scheduler_factories"}
-_BLOCKS = {"ports", "server", "client", "telemetry", "consul", "vault"}
+_BLOCKS = {"ports", "server", "client", "telemetry", "consul", "vault",
+           "tls"}
 
 
 def config_from_dict(data: Dict[str, Any]) -> AgentConfig:

@@ -601,10 +601,25 @@ def cmd_agent(args) -> int:
         scheduler_factories.update({"service": "service-tpu",
                                     "batch": "batch-tpu",
                                     "system": "system-tpu"})
+    if cfg.server.enabled and any(
+            f.endswith("-tpu") for f in scheduler_factories.values()):
+        # Eager jax import at agent boot: with dense factories
+        # configured this SERVER will need the device backend, and a
+        # broken device environment should fail loudly here — at
+        # startup, on the operator's console — rather than as per-eval
+        # scheduler errors in the middle of the first placement storm.
+        # Client-only agents never schedule and skip the cost.
+        import jax  # noqa: F401
 
     # Unique gossip identity per agent: two same-region agents with the
     # same member name would clobber each other in the serf pool.
     node_name = cfg.name or f"{_socket.gethostname()}-{cfg.ports.http}"
+
+    # TLS contexts from the agent tls block: fail at boot with a clear
+    # message, not mid-election (rpc.go:23-30 rpcTLS discipline).
+    from ..utils.tlsutil import contexts_from_block
+
+    tls_rpc_ctx, tls_http_ctx, tls_client_ctx = contexts_from_block(cfg.tls)
 
     server = http = raft_transport = None
     server_addr = None
@@ -645,7 +660,10 @@ def cmd_agent(args) -> int:
         if multi_server:
             from ..server.transport import TCPTransport, fsm_payload_decoder
 
-            raft_transport = TCPTransport(fsm_payload_decoder)
+            raft_transport = TCPTransport(
+                fsm_payload_decoder,
+                ssl_server_ctx=tls_rpc_ctx,
+                ssl_client_ctx=tls_client_ctx if tls_rpc_ctx else None)
             raft_bind = raft_transport.serve(cfg.bind_addr, cfg.ports.rpc)
             raft_port = int(raft_bind.rsplit(":", 1)[1])
             adv_raft = f"{_advertise_addr(cfg)}:{raft_port}"
@@ -660,12 +678,14 @@ def cmd_agent(args) -> int:
         else:
             server.start()
         http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http,
-                          enable_debug=cfg.enable_debug)
+                          enable_debug=cfg.enable_debug,
+                          ssl_context=tls_http_ctx)
         http.start()
         server_addr = http.addr
         # Gossip peers and federated regions must receive a routable
         # address, not a wildcard bind (server.go setupSerf tags).
-        advertised_http = f"http://{_advertise_addr(cfg)}:{http.port}"
+        scheme = "https" if tls_http_ctx is not None else "http"
+        advertised_http = f"{scheme}://{_advertise_addr(cfg)}:{http.port}"
         serf_addr = server.setup_serf(host=cfg.bind_addr,
                                       port=cfg.ports.serf,
                                       http_addr=advertised_http,
@@ -701,7 +721,12 @@ def cmd_agent(args) -> int:
         servers = list(cfg.client.servers)
         if server_addr and server_addr not in servers:
             servers.insert(0, server_addr)
-        servers = [s if "://" in s else f"http://{s}" for s in servers]
+        # Keyed on the HTTP context, not the client one: an rpc-only
+        # TLS rollout (tls { rpc=true http=false }) leaves the HTTP API
+        # plaintext, and bare addresses must keep dialing http://.
+        default_scheme = "https" if tls_http_ctx is not None else "http"
+        servers = [s if "://" in s else f"{default_scheme}://{s}"
+                   for s in servers]
         client_cfg = ClientConfig(
             servers=servers,
             region=cfg.region, datacenter=cfg.datacenter,
@@ -713,6 +738,7 @@ def cmd_agent(args) -> int:
             consul_addr=cfg.consul.address,
             consul_service=cfg.consul.server_service_name,
             network_speed=cfg.client.network_speed,
+            ssl_context=tls_client_ctx,
         )
         if cfg.client.reserved:
             from ..structs import Resources
@@ -742,13 +768,16 @@ def cmd_agent(args) -> int:
             # the agent so the advertised port is known at registration.
             http = HTTPServer(None, host=cfg.bind_addr,
                               port=cfg.ports.http,
-                              enable_debug=cfg.enable_debug)
+                              enable_debug=cfg.enable_debug,
+                              ssl_context=tls_http_ctx)
             http.start()
         # The node must register with a routable HTTP endpoint: peer
         # clients GET /v1/client/allocation/<id>/snapshot from it for
         # sticky-disk migration (client.go:1441 migrateRemoteAllocDir);
         # an empty http_addr makes every remote migration a no-op.
-        client_cfg.http_addr = f"http://{_advertise_addr(cfg)}:{http.port}"
+        client_cfg.http_addr = (
+            f"{'https' if tls_http_ctx is not None else 'http'}://"
+            f"{_advertise_addr(cfg)}:{http.port}")
         try:
             client_agent = ClientAgent(client_cfg)
             client_agent.start()

@@ -48,7 +48,8 @@ class ClientAgent:
             self._consul_discover()
         if not len(self.servers):
             raise ValueError("no servers configured or discovered")
-        self.api = APIClient(self.servers.get(), timeout=330.0)
+        self.api = APIClient(self.servers.get(), timeout=330.0,
+                             ssl_context=config.ssl_context)
         self.vault_client = None
         self.syncer = None
         if self.consul is not None:

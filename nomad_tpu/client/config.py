@@ -41,6 +41,9 @@ class ClientConfig:
     # Override the fingerprinted network link speed in mbits
     # (client config network_speed).
     network_speed: int = 0
+    # TLS client context for https:// server addresses (agent tls
+    # block; presents the node cert and verifies the server chain).
+    ssl_context: Optional[object] = None
     # This agent's advertised HTTP endpoint ("http://host:port"),
     # published on the node so peers can pull sticky-disk snapshots
     # from it (client.go:1481 migrates via the old node's HTTPAddr).

@@ -99,6 +99,16 @@ class _Request:
         )
 
 
+def _pad_rows(rows) -> np.ndarray:
+    """Pad a changed-row index list to a power of two (every distinct
+    length is a compile); padding repeats the FIRST changed row, and a
+    duplicate-index scatter writing the identical value is benign."""
+    k = 1 << (len(rows) - 1).bit_length()
+    rows_p = np.full(k, rows[0], np.int32)
+    rows_p[: len(rows)] = rows
+    return rows_p
+
+
 class PlacementBatcher:
     """Coalesces placement_program calls across scheduler threads."""
 
@@ -264,14 +274,8 @@ class PlacementBatcher:
             if parent is not None and rows:
                 from ..ops.binpack import apply_base_delta
 
-                # Pad the row count to a power of two (every distinct
-                # length is a compile); padding repeats the FIRST
-                # CHANGED row, and a duplicate-index set writing the
-                # identical value is benign.
-                k = 1 << (len(rows) - 1).bit_length()
-                rows_p = np.full(k, rows[0], np.int32)
-                rows_p[: len(rows)] = rows
-                nbytes = rows_p.nbytes + k * (4 * 4 + 4 + 4)
+                rows_p = _pad_rows(rows)
+                nbytes = rows_p.nbytes + len(rows_p) * (4 * 4 + 4 + 4)
                 util2, bw2, ports2 = apply_base_delta(
                     parent[2], parent[4], parent[5], rows_p,
                     np.asarray(base[2])[rows_p],
@@ -331,6 +335,44 @@ class PlacementBatcher:
             self._device_bases[token] = dev
         return dev
 
+    def _claim_fused_delta(self, token, delta):
+        """Claim the right to derive `token`'s base INSIDE the compact
+        dispatch itself (batched_placement_program_compact_delta): when
+        the delta's parent snapshot is still device-cached, the changed
+        rows can ride the dispatch's own arguments and the derived base
+        comes back with the results — zero extra round-trips, decisive
+        through a remote-device tunnel where every RPC is ~100ms.
+
+        Returns (parent_device_base, changed_rows, done_event) on a
+        successful claim, else None (caller falls back to
+        _device_base). A claim registers `done_event` in
+        self._base_pending[token]; the CALLER must cache the derived
+        base, clear the pending slot, and set the event — concurrent
+        dispatchers on this token wait on it instead of paying a
+        duplicate derivation."""
+        if delta is None:
+            return None
+        parent_token, rows = delta
+        if not rows:
+            return None
+        with self._lock:
+            if token in self._device_bases or token in self._base_pending:
+                # Already resident (or being built): the plain cached
+                # path is strictly cheaper than re-deriving.
+                return None
+            parent = self._device_bases.get(parent_token)
+            if parent is None:
+                return None
+            if len(parent[0].sharding.device_set) > 1:
+                # Sharded parents go through _build_device_base, whose
+                # apply_base_delta call preserves the mesh layout; the
+                # fused program is compiled for the single-chip case.
+                return None
+            self._device_bases.move_to_end(parent_token)
+            done = threading.Event()
+            self._base_pending[token] = done
+        return parent, rows, done
+
     def _run_batch(self, batch: List[_Request], config) -> None:
         import time as _time
 
@@ -370,6 +412,7 @@ class PlacementBatcher:
         asks = jax.tree.map(lambda *xs: np.stack(xs), *[r.asks for r in padded])
         token = batch[0].token
         payload = sum(x.nbytes for x in asks) + keys.nbytes
+        compact_dispatch = overlay_dispatch = False
         if token is not None and all(r.token == token for r in batch):
             # Shared-base fast path: base cached on device, only the
             # per-eval payloads cross host->device this dispatch.
@@ -392,9 +435,7 @@ class PlacementBatcher:
                     # as device residents — zero extra round-trips.
                     parent, rows, done = fused
                     try:
-                        k = 1 << (len(rows) - 1).bit_length()
-                        rows_p = np.full(k, rows[0], np.int32)
-                        rows_p[: len(rows)] = rows
+                        rows_p = _pad_rows(rows)
                         hb = batch[0].base
                         util_rows = np.asarray(hb[2])[rows_p]
                         bw_rows = np.asarray(hb[4])[rows_p]
@@ -427,7 +468,7 @@ class PlacementBatcher:
                     choices, scores, _ = batched_placement_program_compact(
                         dev[0], dev[1], dev[2], dev[3], dev[4], dev[5],
                         dev[6], dev[7], overlays, asks, keys, config)
-                self.compact_dispatches += 1
+                compact_dispatch = True
             else:
                 dev = self._device_base(
                     token, batch[0].base, batch[0].delta)
@@ -444,7 +485,7 @@ class PlacementBatcher:
                 t1 = _time.perf_counter()
                 choices, scores, _ = batched_placement_program_overlay(
                     state, asks, keys, config)
-            self.overlay_dispatches += 1
+            overlay_dispatch = True
         else:
             states = jax.tree.map(
                 lambda *xs: np.stack(xs), *[r.full_state() for r in padded])
@@ -461,6 +502,11 @@ class PlacementBatcher:
             self.t_issue += t2 - t1
             self.t_sync += t3 - t2
             self.bytes_overlay += payload
+            # Path counters under the lock: dispatchers of different
+            # shape keys run concurrently and += is not atomic across a
+            # GIL switch.
+            self.compact_dispatches += compact_dispatch
+            self.overlay_dispatches += overlay_dispatch
             sync = t3 - t2
             self._sync_ema = (sync if self._sync_ema == 0.0
                               else 0.7 * self._sync_ema + 0.3 * sync)

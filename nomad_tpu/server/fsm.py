@@ -205,7 +205,17 @@ class FSM:
                     consts.ALLOC_CLIENT_COMPLETE,
                     consts.ALLOC_CLIENT_FAILED,
                 ):
-                    node = self.state.node_by_id(alloc.node_id)
+                    # Client sync updates are SPARSE (id + status +
+                    # task_states, client/agent.py _flush_dirty): the
+                    # node comes from the stored record, which the
+                    # upsert above just refreshed. Looking at the wire
+                    # alloc's empty node_id here silently skipped every
+                    # unblock, wedging capacity-blocked evals forever.
+                    node_id = alloc.node_id
+                    if not node_id:
+                        stored = self.state.alloc_by_id(alloc.id)
+                        node_id = stored.node_id if stored else ""
+                    node = self.state.node_by_id(node_id)
                     if node is not None:
                         self.blocked_evals.unblock(node.computed_class, index)
         return None

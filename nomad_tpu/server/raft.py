@@ -264,6 +264,7 @@ class RaftNode:
         """A configuration takes effect as soon as it is appended (the
         single-server-change rule): votes and commit quorums count under
         the newest config in the log."""
+        old_peers = set(self.peers)
         self.peers = [m for m in members if m != self.node_id]
         self.removed = self.node_id not in members
         if self.state == LEADER:
@@ -275,6 +276,13 @@ class RaftNode:
                 if p not in self.peers:
                     self.next_index.pop(p, None)
                     self.match_index.pop(p, None)
+        # Departed peers: release their pooled transport connections
+        # (otherwise every address ever in the cluster keeps sockets
+        # open until process shutdown).
+        forget = getattr(self.transport, "forget_peer", None)
+        if forget is not None:
+            for p in old_peers - set(self.peers):
+                forget(p)
         self.logger.info("raft config active: %s", members)
 
     def _recompute_config_locked(self) -> None:
@@ -791,6 +799,13 @@ class RaftNode:
         this gate closes it. Raises if the barrier doesn't land in time
         (the membership reconcile sweep retries)."""
         deadline = time.monotonic() + timeout
+        # One nudge up front to drive the barrier's replication; after
+        # that the heartbeat thread owns retransmission. Broadcasting
+        # from the waiter loop (as this once did) serializes synchronous
+        # per-peer RPCs every 20ms — with an unreachable peer and a slow
+        # transport timeout a single _change_config could block far past
+        # the deadline while hammering the network.
+        nudged = False
         while True:
             with self._lock:
                 if self.state != LEADER:
@@ -801,7 +816,9 @@ class RaftNode:
                 raise ValueError(
                     "leadership not established: election barrier not "
                     "committed yet")
-            self._broadcast_heartbeat()
+            if not nudged:
+                nudged = True
+                self._broadcast_heartbeat()
             time.sleep(0.02)
 
     def _change_config(self, add: Optional[str] = None,

@@ -30,7 +30,7 @@ from ..utils.ids import generate_uuid
 from ..utils.pool import WorkPool
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
-from .broker import EvalBroker
+from .broker import FAILED_QUEUE, EvalBroker
 from .config import ServerConfig
 from .core_gc import CoreScheduler
 from .fsm import FSM, DevLog
@@ -483,6 +483,7 @@ class Server:
         self._restore_evals()
         self._restore_periodic()
         self._schedule_gc()
+        self._start_eval_hygiene()
         # Pause 3/4 of the workers on the leader (leader.go:111-117).
         if len(self.workers) > 1:
             for w in self.workers[: len(self.workers) * 3 // 4]:
@@ -490,6 +491,7 @@ class Server:
 
     def revoke_leadership(self) -> None:
         self._leader = False
+        self._stop_eval_hygiene()
         for timer in self._gc_threads:
             timer.cancel()
         self._gc_threads = []
@@ -517,6 +519,82 @@ class Server:
     def _restore_periodic(self) -> None:
         for job in self.fsm.state.jobs_by_periodic(True):
             self.periodic.add(job)
+
+    # ------------------------------------------------------ eval hygiene
+
+    def _start_eval_hygiene(self) -> None:
+        """Leader-only janitors (leader.go:369 reapFailedEvaluations,
+        :407 reapDupBlockedEvaluations, :441 periodicUnblockFailedEvals):
+        without them, delivery-limit evals sit in the broker's `_failed`
+        queue forever and displaced duplicate blocked evals leak in the
+        state store as pending-looking work."""
+        # The epoch's stop event rides in as a thread ARG: reading
+        # self._hygiene_stop from the thread body would race a fast
+        # revoke->re-establish (the body could bind the NEW epoch's
+        # event and never see its own stop, leaving duplicate janitors
+        # racing on the failed queue).
+        stop = threading.Event()
+        self._hygiene_stop = stop
+        self._hygiene_threads = [
+            threading.Thread(target=self._reap_failed_evals, args=(stop,),
+                             daemon=True, name="eval-reap-failed"),
+            threading.Thread(target=self._blocked_evals_hygiene,
+                             args=(stop,),
+                             daemon=True, name="eval-reap-dup"),
+        ]
+        for t in self._hygiene_threads:
+            t.start()
+
+    def _stop_eval_hygiene(self) -> None:
+        stop = getattr(self, "_hygiene_stop", None)
+        if stop is not None:
+            stop.set()
+
+    def _reap_failed_evals(self, stop: threading.Event) -> None:
+        """Mark delivery-limit evals status=failed through raft, then
+        ack them out of the broker. On a raft error the eval stays
+        unacked — its nack timer re-parks it on the failed queue and a
+        later pass retries."""
+        while self._leader and not self._shutdown and not stop.is_set():
+            ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0.5)
+            if ev is None:
+                continue
+            updated = ev.copy()
+            updated.status = consts.EVAL_STATUS_FAILED
+            updated.status_description = (
+                "evaluation reached delivery limit "
+                f"({self.config.eval_delivery_limit})")
+            try:
+                self.eval_update([updated])
+                self.broker.ack(ev.id, token)
+            except Exception:  # noqa: BLE001 - leader flap mid-reap
+                self.logger.exception("failed-eval reap of %s", ev.id)
+
+    def _blocked_evals_hygiene(self, stop: threading.Event) -> None:
+        """Cancel duplicate blocked evals (newer eval displaced them in
+        BlockedEvals) and periodically release max-plan-failure evals
+        back to the ready queue."""
+        next_unblock = (
+            time.monotonic() + self.config.failed_eval_unblock_interval)
+        while self._leader and not self._shutdown and not stop.is_set():
+            dups = self.blocked_evals.get_duplicates()
+            if dups:
+                cancelled = []
+                for ev in dups:
+                    upd = ev.copy()
+                    upd.status = consts.EVAL_STATUS_CANCELLED
+                    upd.status_description = (
+                        "evaluation is outdated: duplicate blocked eval")
+                    cancelled.append(upd)
+                try:
+                    self.eval_update(cancelled)
+                except Exception:  # noqa: BLE001 - leader flap mid-reap
+                    self.logger.exception("duplicate blocked-eval reap")
+            if time.monotonic() >= next_unblock:
+                next_unblock = (time.monotonic()
+                                + self.config.failed_eval_unblock_interval)
+                self.blocked_evals.unblock_failed()
+            stop.wait(0.1)
 
     # ------------------------------------------------------------ jobs
 

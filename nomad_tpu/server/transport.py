@@ -1,9 +1,14 @@
 """TCP/JSON raft transport for multi-host clusters.
 
 Reference: the reference multiplexes raft streams over one TCP port
-with a 1-byte protocol prefix (nomad/rpc.go:23-30, raft_rpc.go:33).
-Here each message is one length-prefixed JSON frame over a short-lived
-connection; peers are addressed host:port.
+with a 1-byte protocol prefix (nomad/rpc.go:23-30, raft_rpc.go:33) and
+POOLS yamux sessions per peer (nomad/pool.go:144) so replication fan-out
+rides persistent connections. Here each message is one length-prefixed
+JSON frame; connections are keep-alive and pooled per peer (a stale
+pooled socket gets one retry on a fresh dial, utils/httppool.py's
+discipline), and the whole channel optionally runs under mutual TLS
+(utils/tlsutil.py; a plaintext or unauthenticated peer fails the
+handshake, rpc.go:23-30 rpcTLS).
 """
 
 from __future__ import annotations
@@ -12,9 +17,10 @@ import json
 import logging
 import socket
 import socketserver
+import ssl
 import struct
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils.codec import from_dict, to_dict
 from .raft import LogEntry, Transport
@@ -22,6 +28,10 @@ from .raft import LogEntry, Transport
 _HEADER = struct.Struct(">I")
 CONNECT_TIMEOUT = 1.0
 RPC_TIMEOUT = 5.0
+# Server side: how long a pooled keep-alive connection may sit idle
+# before its handler thread gives up on it. Heartbeat cadence is
+# sub-second, so anything this quiet belongs to a departed peer.
+IDLE_CONN_TIMEOUT = 300.0
 
 
 def _send_frame(sock: socket.socket, obj: dict) -> None:
@@ -69,12 +79,24 @@ class TCPTransport(Transport):
     type; the decode_payload callback does that (the server wires it to
     the FSM's schema)."""
 
-    def __init__(self, decode_payload=None):
+    MAX_IDLE_PER_PEER = 4
+
+    def __init__(self, decode_payload=None,
+                 ssl_server_ctx: Optional[ssl.SSLContext] = None,
+                 ssl_client_ctx: Optional[ssl.SSLContext] = None):
         self.logger = logging.getLogger("nomad_tpu.raft.tcp")
         self.node: Optional[object] = None
         self.decode_payload = decode_payload or (lambda mt, p: p)
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self.addr: str = ""
+        self.ssl_server_ctx = ssl_server_ctx
+        self.ssl_client_ctx = ssl_client_ctx
+        # Per-peer idle keep-alive connections (pool.go:144): one
+        # socket per CONCURRENT in-flight RPC to a peer, reused across
+        # sequential heartbeats/appends instead of a dial per message.
+        self._pools: Dict[str, List[socket.socket]] = {}
+        self._pool_lock = threading.Lock()
+        self.dials = 0  # sockets ever opened (observability/tests)
 
     # ------------------------------------------------------- serving
 
@@ -86,13 +108,30 @@ class TCPTransport(Transport):
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
                 try:
-                    msg = _recv_frame(self.request)
-                    if msg is None:
-                        return
-                    resp = transport._dispatch(msg)
-                    _send_frame(self.request, resp)
-                except (OSError, ValueError):
+                    # The idle read timeout bounds handler threads
+                    # orphaned by peers that pooled a connection and
+                    # then left the cluster — and it must be armed
+                    # BEFORE the TLS handshake, or a peer that connects
+                    # and never handshakes pins the thread forever.
+                    sock.settimeout(IDLE_CONN_TIMEOUT)
+                    # TLS terminates HERE, in the per-connection thread:
+                    # wrapping in get_request would let one slow/failing
+                    # handshake stall the accept loop for every peer.
+                    if transport.ssl_server_ctx is not None:
+                        sock = transport.ssl_server_ctx.wrap_socket(
+                            sock, server_side=True)
+                    # Keep-alive: serve frames until the peer hangs up —
+                    # the client side pools this connection across
+                    # heartbeats/appends instead of redialling.
+                    while True:
+                        msg = _recv_frame(sock)
+                        if msg is None:
+                            return
+                        resp = transport._dispatch(msg)
+                        _send_frame(sock, resp)
+                except (OSError, ValueError, ssl.SSLError):
                     pass
 
         # Reuse-addr: an agent restarting on its configured port must
@@ -113,6 +152,14 @@ class TCPTransport(Transport):
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+        with self._pool_lock:
+            pools, self._pools = self._pools, {}
+        for conns in pools.values():
+            for sock in conns:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def _dispatch(self, msg: dict) -> dict:
         kind = msg.get("kind")
@@ -143,17 +190,80 @@ class TCPTransport(Transport):
 
     # -------------------------------------------------------- client
 
-    def _call(self, peer: str, msg: dict, timeout: float = RPC_TIMEOUT) -> Optional[dict]:
+    def _checkout(self, peer: str) -> Tuple[Optional[socket.socket], bool]:
+        """Returns (conn, pooled); dials when the idle pool is empty."""
+        with self._pool_lock:
+            conns = self._pools.get(peer)
+            if conns:
+                return conns.pop(), True
         host, port_s = peer.rsplit(":", 1)
         try:
-            with socket.create_connection(
-                (host, int(port_s)), timeout=CONNECT_TIMEOUT
-            ) as sock:
+            sock = socket.create_connection(
+                (host, int(port_s)), timeout=CONNECT_TIMEOUT)
+            if self.ssl_client_ctx is not None:
+                sock = self.ssl_client_ctx.wrap_socket(
+                    sock, server_hostname=host)
+        except (OSError, ValueError, ssl.SSLError):
+            return None, False
+        with self._pool_lock:
+            self.dials += 1
+        return sock, False
+
+    def forget_peer(self, peer: str) -> None:
+        """Drop the idle pool for a peer that left the cluster; without
+        this, every address ever contacted keeps up to
+        MAX_IDLE_PER_PEER sockets open until process shutdown."""
+        with self._pool_lock:
+            conns = self._pools.pop(peer, [])
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _checkin(self, peer: str, sock: socket.socket) -> None:
+        with self._pool_lock:
+            conns = self._pools.setdefault(peer, [])
+            if len(conns) < self.MAX_IDLE_PER_PEER:
+                conns.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _call(self, peer: str, msg: dict, timeout: float = RPC_TIMEOUT) -> Optional[dict]:
+        for attempt in (0, 1):
+            sock, pooled = self._checkout(peer)
+            if sock is None:
+                return None
+            try:
                 sock.settimeout(timeout)
                 _send_frame(sock, msg)
-                return _recv_frame(sock)
-        except (OSError, ValueError):
-            return None
+                resp = _recv_frame(sock)
+                if resp is None:
+                    raise OSError("peer closed connection")
+            except (OSError, ValueError, ssl.SSLError) as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # The peer may have dropped the idle socket between our
+                # messages (keep-alive race): raft RPCs are idempotent
+                # (term/index-guarded state machines), so one retry on
+                # a fresh dial is safe. NOT after a timeout: a slow but
+                # alive peer already burned the full RPC timeout, and
+                # _broadcast_heartbeat iterates peers serially — a
+                # retry would double the stall for every other
+                # follower. The keep-alive race shows up as instant
+                # EOF/RST, never as a timeout.
+                is_timeout = isinstance(e, (socket.timeout, TimeoutError))
+                if pooled and attempt == 0 and not is_timeout:
+                    continue
+                return None
+            self._checkin(peer, sock)
+            return resp
+        return None
 
     def request_vote(self, peer: str, args: dict) -> Optional[dict]:
         return self._call(peer, {"kind": "request_vote", "args": args})

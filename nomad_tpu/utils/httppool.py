@@ -50,6 +50,7 @@ class HTTPPool:
         self._idle: List[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
         self._dials = 0  # sockets ever opened (observability/tests)
+        self._closed = False
 
     # ------------------------------------------------------------ conns
 
@@ -96,7 +97,10 @@ class HTTPPool:
 
     def _checkin(self, conn: http.client.HTTPConnection) -> None:
         with self._lock:
-            if len(self._idle) < self.max_idle:
+            # A request in flight when close() ran must not park its
+            # socket in a pool nobody will drain again (the SDK swaps
+            # pools on address change mid-request).
+            if not self._closed and len(self._idle) < self.max_idle:
                 self._idle.append(conn)
                 return
         conn.close()
@@ -108,6 +112,7 @@ class HTTPPool:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             idle, self._idle = self._idle, []
         for conn in idle:
             conn.close()

@@ -85,6 +85,51 @@ def test_job_register_without_capacity_blocks_then_unblocks(server):
         client.stop()
 
 
+def test_sparse_client_terminal_update_unblocks_capacity_evals(server):
+    """Regression: the live client's alloc sync sends SPARSE allocs
+    (id + client_status only, client/agent.py _flush_dirty) — the FSM
+    must resolve the node from the stored alloc or the capacity
+    unblock never fires and blocked evals wedge forever (found driving
+    a real agent: 16/30 batch jobs never placed)."""
+    from nomad_tpu.structs import Allocation
+
+    node = mock.node()
+    node.resources.cpu = 2000
+    node.compute_class()
+    server.node_register(node)
+
+    jobs = []
+    for i in range(5):
+        j = mock.job()
+        j.id = j.name = f"wave-{i}"
+        j.type = "batch"
+        j.task_groups[0].count = 1
+        j.task_groups[0].tasks[0].resources.cpu = 600
+        j.task_groups[0].tasks[0].resources.networks = []
+        jobs.append(j)
+        server.job_register(j)
+
+    # 3 fit (2000/600), 2 block on capacity.
+    assert wait_until(
+        lambda: len([a for a in server.fsm.state.allocs()
+                     if a.desired_status == consts.ALLOC_DESIRED_RUN]) == 3
+        and server.blocked_evals.stats()["total_blocked"] == 2
+    )
+
+    # Complete the running allocs the way the REAL client does: a
+    # sparse record with no node_id.
+    sparse = [
+        Allocation(id=a.id, client_status=consts.ALLOC_CLIENT_COMPLETE)
+        for a in server.fsm.state.allocs()
+        if a.desired_status == consts.ALLOC_DESIRED_RUN
+    ]
+    server.node_update_allocs(sparse)
+    assert wait_until(
+        lambda: server.blocked_evals.stats()["total_blocked"] == 0)
+    assert wait_until(
+        lambda: len(server.fsm.state.allocs()) == 5, timeout=8.0)
+
+
 def test_node_down_triggers_replacement(server):
     c1 = MockClient(server)
     c2 = MockClient(server)

@@ -86,6 +86,26 @@ def test_upsert_allocs_and_queries():
     assert s.job_by_id(j.id).status == consts.JOB_STATUS_RUNNING
 
 
+def test_upsert_allocs_copies_shared_metrics():
+    """The TPU pinned-placement path shares ONE AllocMetric across a
+    plan's successful allocs (scheduler/tpu.py); the store's upsert
+    copy must deep-copy it per stored alloc so no later in-place
+    mutation of one alloc's metrics can alter its siblings."""
+    from nomad_tpu.structs.alloc import AllocMetric
+
+    s = StateStore()
+    shared = AllocMetric()
+    shared.evaluate_node()
+    a1, a2 = mock.alloc(), mock.alloc()
+    a1.metrics = a2.metrics = shared
+    s.upsert_allocs(10, [a1, a2])
+    m1 = s.alloc_by_id(a1.id).metrics
+    m2 = s.alloc_by_id(a2.id).metrics
+    assert m1 is not shared and m2 is not shared and m1 is not m2
+    m1.nodes_evaluated = 999
+    assert m2.nodes_evaluated != 999
+
+
 def test_upsert_allocs_preserves_client_status():
     s = StateStore()
     a = mock.alloc()

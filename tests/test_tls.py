@@ -1,0 +1,331 @@
+"""mTLS on the wire protocols (utils/tlsutil.py; reference
+rpc.go:23-30 rpcTLS + nomad/structs/config/tls.go) and the raft
+transport's keep-alive connection pool (reference pool.go:144):
+
+- a raft cluster forms and replicates over mutual TLS;
+- a plaintext (or wrong-CA) peer is rejected at the handshake;
+- the HTTP API terminates TLS and the SDK talks to it over https;
+- transport connections are pooled: a heartbeat storm rides O(1)
+  sockets per peer, not one per message;
+- the alloc long-poll requires the node secret whenever the node has
+  one (node_endpoint.go:585-607).
+"""
+
+import datetime
+import ssl
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.raft import RaftNode
+from nomad_tpu.server.transport import TCPTransport, fsm_payload_decoder
+from nomad_tpu.utils import tlsutil
+
+
+def wait_until(fn, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """A CA plus one node cert (SAN 127.0.0.1/localhost), written as
+    PEM files the way an operator would provide them."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    import ipaddress
+
+    d = tmp_path_factory.mktemp("certs")
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _write_key(path, key):
+        path.write_bytes(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "nomad-tpu test CA")])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name).issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    def issue(cn, signer_key, issuer_name, path_prefix):
+        key = ec.generate_private_key(ec.SECP256R1())
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+            .issuer_name(issuer_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName([
+                x509.DNSName("localhost"),
+                x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            ]), critical=False)
+            .sign(signer_key, hashes.SHA256())
+        )
+        (d / f"{path_prefix}.pem").write_bytes(
+            cert.public_bytes(serialization.Encoding.PEM))
+        _write_key(d / f"{path_prefix}.key", key)
+
+    (d / "ca.pem").write_bytes(ca_cert.public_bytes(
+        serialization.Encoding.PEM))
+    issue("server.global.nomad-tpu", ca_key, ca_name, "node")
+    # A second, UNRELATED CA + cert for the wrong-chain rejection test.
+    rogue_key = ec.generate_private_key(ec.SECP256R1())
+    rogue_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "rogue CA")])
+    rogue_ca = (
+        x509.CertificateBuilder()
+        .subject_name(rogue_name).issuer_name(rogue_name)
+        .public_key(rogue_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(rogue_key, hashes.SHA256())
+    )
+    (d / "rogue-ca.pem").write_bytes(rogue_ca.public_bytes(
+        serialization.Encoding.PEM))
+    issue("rogue.node", rogue_key, rogue_name, "rogue")
+    return d
+
+
+def _tls_transport(certs):
+    return TCPTransport(
+        fsm_payload_decoder,
+        ssl_server_ctx=tlsutil.server_context(
+            str(certs / "ca.pem"), str(certs / "node.pem"),
+            str(certs / "node.key")),
+        ssl_client_ctx=tlsutil.client_context(
+            str(certs / "ca.pem"), str(certs / "node.pem"),
+            str(certs / "node.key")),
+    )
+
+
+def find_leader(nodes):
+    for n in nodes:
+        if n.is_leader():
+            return n
+    return None
+
+
+def test_raft_cluster_forms_and_replicates_over_mtls(certs):
+    transports = [_tls_transport(certs) for _ in range(3)]
+    addrs = [t.serve("127.0.0.1", 0) for t in transports]
+    applied = {i: [] for i in range(3)}
+    nodes = []
+    for i, t in enumerate(transports):
+        def make_apply(i):
+            return lambda index, mtype, payload: applied[i].append(mtype)
+
+        node = RaftNode(addrs[i], addrs, t, make_apply(i), lambda _: None)
+        t.register(node)
+        nodes.append(node)
+    for n in nodes:
+        n.start()
+    try:
+        assert wait_until(lambda: find_leader(nodes) is not None)
+        leader = find_leader(nodes)
+        leader.apply("node_register", {"node": mock.node()})
+        assert wait_until(lambda: all(len(applied[i]) == 1 for i in range(3)))
+        # Follower forward rides the same mTLS channel.
+        follower = next(n for n in nodes if not n.is_leader())
+        follower.apply("test", {"x": 1})
+        assert wait_until(lambda: all(len(applied[i]) == 2 for i in range(3)))
+    finally:
+        for n in nodes:
+            n.stop()
+        for t in transports:
+            t.close()
+
+
+def test_plaintext_and_wrong_ca_peers_rejected(certs):
+    server_t = _tls_transport(certs)
+    addr = server_t.serve("127.0.0.1", 0)
+
+    class Echo:
+        def handle_request_vote(self, args):
+            return {"ok": True}
+
+    server_t.register(Echo())
+    try:
+        # Plaintext client: the TLS server kills the handshake.
+        plain = TCPTransport(fsm_payload_decoder)
+        assert plain.request_vote(addr, {"term": 1}) is None
+        plain.close()
+        # Wrong CA chain: mutual verification fails both directions.
+        rogue = TCPTransport(
+            fsm_payload_decoder,
+            ssl_client_ctx=tlsutil.client_context(
+                str(certs / "rogue-ca.pem"), str(certs / "rogue.pem"),
+                str(certs / "rogue.key")),
+        )
+        assert rogue.request_vote(addr, {"term": 1}) is None
+        rogue.close()
+        # The real cert still works.
+        good = _tls_transport(certs)
+        assert good.request_vote(addr, {"term": 1}) == {"ok": True}
+        good.close()
+    finally:
+        server_t.close()
+
+
+def test_transport_pools_connections_under_heartbeat_storm(certs):
+    """One socket per peer serves sequential RPCs; a concurrent burst
+    opens at most MAX_IDLE_PER_PEER (pool.go:144's O(clients) not
+    O(messages) property). Runs over TLS so the pooled path and the
+    handshake compose."""
+    server_t = _tls_transport(certs)
+    addr = server_t.serve("127.0.0.1", 0)
+
+    class Echo:
+        def handle_request_vote(self, args):
+            return {"ok": True}
+
+    server_t.register(Echo())
+    client_t = _tls_transport(certs)
+    try:
+        for _ in range(50):
+            assert client_t.request_vote(addr, {"t": 1}) == {"ok": True}
+        assert client_t.dials == 1
+
+        errors = []
+
+        def storm():
+            for _ in range(20):
+                if client_t.request_vote(addr, {"t": 2}) != {"ok": True}:
+                    errors.append(1)
+
+        threads = [threading.Thread(target=storm) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert client_t.dials <= 1 + 8  # bounded by concurrency, not msgs
+
+        # forget_peer releases the idle pool.
+        client_t.forget_peer(addr)
+        assert client_t._pools.get(addr) in (None, [])
+    finally:
+        client_t.close()
+        server_t.close()
+
+
+def test_agent_tls_block_plumbs_to_http(certs, tmp_path):
+    """A spawned `agent` with a tls{} config block serves https and
+    refuses plaintext — the operator-facing config path, not just the
+    library wiring."""
+    import os
+    import subprocess
+    import sys
+    import urllib.request
+
+    cfg = tmp_path / "tls-agent.hcl"
+    cfg.write_text(f'''
+        bind_addr = "127.0.0.1"
+        ports {{ http = 14896 serf = 14898 }}
+        server {{ enabled = true num_schedulers = 1 }}
+        tls {{
+          enabled   = true
+          ca_file   = "{certs / 'ca.pem'}"
+          cert_file = "{certs / 'node.pem'}"
+          key_file  = "{certs / 'node.key'}"
+        }}
+    ''')
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.cli", "agent", "-config",
+         str(cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            p for p in [repo, os.environ.get("PYTHONPATH", "")] if p)},
+    )
+    try:
+        ctx = ssl.create_default_context(cafile=str(certs / "ca.pem"))
+        ctx.check_hostname = False
+        deadline = time.monotonic() + 20.0
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        "https://127.0.0.1:14896/v1/status/leader",
+                        context=ctx, timeout=2.0):
+                    ok = True
+                    break
+            except Exception:
+                time.sleep(0.3)
+        assert ok, "agent never served https"
+        # Plaintext request against the TLS port fails.
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                "http://127.0.0.1:14896/v1/status/leader", timeout=2.0)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_http_api_over_tls_and_secret_gate(certs):
+    """The HTTP API terminates TLS; the SDK talks https; the alloc
+    long-poll rejects a missing/wrong node secret (403) and serves the
+    right one."""
+    from nomad_tpu.api.client import APIError, Client
+    from nomad_tpu.api.http import HTTPServer
+    from nomad_tpu.server import Server, ServerConfig
+
+    srv = Server(ServerConfig(num_schedulers=0))
+    srv.start()
+    http = HTTPServer(
+        srv, host="127.0.0.1", port=0,
+        ssl_context=tlsutil.server_context(
+            str(certs / "ca.pem"), str(certs / "node.pem"),
+            str(certs / "node.key"), verify_client=False))
+    http.start()
+    try:
+        assert http.addr.startswith("https://")
+        node = mock.node()
+        srv.node_register(node)
+
+        api = Client(http.addr, ssl_context=tlsutil.client_context(
+            str(certs / "ca.pem"), str(certs / "node.pem"),
+            str(certs / "node.key")))
+        listing, _ = api.nodes.list()
+        assert any(n["id"] == node.id for n in listing)
+
+        # Plaintext client is refused at the TLS layer.
+        plain = Client(f"http://127.0.0.1:{http.port}")
+        with pytest.raises(APIError):
+            plain.nodes.list()
+
+        # Secret gate: absent and wrong secrets are 403, right one 200.
+        for bad in ("", "wrong-secret"):
+            with pytest.raises(APIError) as e:
+                api.nodes.allocations(node.id, secret=bad)
+            assert e.value.status == 403
+        allocs, _ = api.nodes.allocations(node.id, secret=node.secret_id)
+        assert allocs == []
+    finally:
+        http.stop()
+        srv.shutdown()
