@@ -509,6 +509,8 @@ def _resolve_agent_config(args):
         cfg.bind_addr = args.bind
     if args.port:
         cfg.ports.http = args.port
+    if getattr(args, "serf_port", 0):
+        cfg.ports.serf = args.serf_port
     if args.region:
         cfg.region = args.region
     if args.node_name:
@@ -609,7 +611,20 @@ def cmd_agent(args) -> int:
         # startup, on the operator's console — rather than as per-eval
         # scheduler errors in the middle of the first placement storm.
         # Client-only agents never schedule and skip the cost.
-        import jax  # noqa: F401
+        import jax
+
+        # Operator backend override: dense factories are correct on any
+        # XLA backend (CPU/TPU parity is a test invariant), so agents
+        # on TPU-less hosts can still run them — and some environments
+        # pin jax_platforms in site config where JAX_PLATFORMS can't
+        # override it.
+        plat = os.environ.get("NOMAD_TPU_PLATFORM")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception as e:  # noqa: BLE001 - backend already up
+                print(f"warning: NOMAD_TPU_PLATFORM={plat!r} ignored: {e}",
+                      file=sys.stderr)
 
     # Unique gossip identity per agent: two same-region agents with the
     # same member name would clobber each other in the serf pool.
@@ -872,6 +887,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-statsd", dest="statsd", default="", help="statsd UDP addr host:port")
     p.add_argument("-bind", dest="bind", default="")
     p.add_argument("-port", dest="port", type=int, default=0)
+    p.add_argument("-serf-port", dest="serf_port", type=int, default=0)
     p.add_argument("-num-schedulers", dest="num_schedulers", type=int,
                    default=None)
     p.add_argument("-region", dest="region", default="")

@@ -16,6 +16,11 @@ from ..structs import Allocation, TaskState, consts, new_task_event
 from .allocdir import AllocDir
 from .task_runner import TaskRunner
 
+# Ephemeral-disk usage poll cadence (alloc_dir.go:618 uses a rising
+# 250ms..duration watcher; one flat interval keeps the walk cost
+# predictable). Module-level so tests can shrink it.
+DISK_WATCH_INTERVAL = 5.0
+
 
 class AllocRunner:
     def __init__(
@@ -87,6 +92,38 @@ class AllocRunner:
             )
             self.task_runners[task.name] = runner
             runner.start()
+        ed = tg.ephemeral_disk
+        if ed is not None and ed.size_mb:
+            threading.Thread(
+                target=self._disk_watcher, args=(float(ed.size_mb),),
+                daemon=True, name=f"disk-watch-{self.alloc.id[:8]}",
+            ).start()
+
+    def _disk_watcher(self, limit_mb: float) -> None:
+        """Enforce EphemeralDisk.SizeMB (alloc_dir.go:618 disk
+        watcher): a task group writing past its quota gets every task
+        killed with a disk-exceeded event and the alloc fails — the
+        scheduler counted that disk on this node for OTHER allocs."""
+        import time as _time
+
+        while not self._destroyed:
+            states = list(self.task_states.values())
+            if states and all(
+                    s.state == consts.TASK_STATE_DEAD for s in states):
+                return
+            used = self.alloc_dir.disk_used_mb()
+            if used > limit_mb:
+                self.logger.warning(
+                    "ephemeral disk exceeded: %.1fMB used > %dMB limit",
+                    used, limit_mb)
+                ev = new_task_event(consts.TASK_EVENT_DISK_EXCEEDED)
+                ev.message = (
+                    f"ephemeral disk: {used:.0f}MB used exceeds "
+                    f"{limit_mb:.0f}MB limit")
+                for runner in self.task_runners.values():
+                    runner.kill(ev, fail=True)
+                return
+            _time.sleep(DISK_WATCH_INTERVAL)
 
     def _on_task_state(self, task_name: str, state: TaskState) -> None:
         with self._lock:

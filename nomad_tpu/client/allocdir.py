@@ -19,6 +19,82 @@ SHARED_DIRS = ("data", "logs", "tmp")
 TASK_LOCAL = "local"
 TASK_SECRETS = "secrets"
 
+# Host paths a chrooted exec task sees (client/allocdir/alloc_dir.go:40
+# chrootEnv): the toolchain a dynamically-linked binary needs. Embedded
+# by hardlink (copy across filesystems), so the disk cost is inodes,
+# not bytes.
+CHROOT_ENV = {
+    "/bin": "bin",
+    "/sbin": "sbin",
+    "/usr": "usr",
+    "/lib": "lib",
+    "/lib32": "lib32",
+    "/lib64": "lib64",
+    "/etc/ld.so.cache": "etc/ld.so.cache",
+    "/etc/ld.so.conf": "etc/ld.so.conf",
+    "/etc/ld.so.conf.d": "etc/ld.so.conf.d",
+    "/etc/passwd": "etc/passwd",
+    "/run/resolvconf": "run/resolvconf",
+}
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    if os.path.exists(dst):
+        return
+    if os.path.islink(src):
+        # Preserve symlinks (ld.so farms are full of them); a hardlink
+        # would flatten the chain and break same-dir relative targets.
+        os.symlink(os.readlink(src), dst)
+        return
+    try:
+        os.link(src, dst)
+    except OSError:
+        try:
+            shutil.copy2(src, dst)
+        except OSError:
+            pass  # unreadable host file: leave a hole, not a failure
+
+
+EMBED_MANIFEST = ".nomad-embed.json"
+
+
+def embed_chroot(root: str, sources: Optional[Dict[str, str]] = None) -> None:
+    """Populate `root` as a chroot by hardlinking host paths into it
+    (alloc_dir.go:348 Embed). `sources` maps host path -> relative
+    destination; missing host paths are skipped (not every distro has
+    /lib32). A manifest of the embedded destinations is written so the
+    disk watcher can exclude them from ephemeral-disk accounting."""
+    import json as _json
+
+    rels = sorted({rel.lstrip("/").split("/", 1)[0]
+                   for rel in (sources or CHROOT_ENV).values()})
+    with open(os.path.join(root, EMBED_MANIFEST), "w") as f:
+        _json.dump(rels, f)
+    for src, rel in (sources or CHROOT_ENV).items():
+        if not os.path.exists(src):
+            continue
+        dst = os.path.join(root, rel.lstrip("/"))
+        if os.path.isdir(src) and not os.path.islink(src):
+            for dirpath, _dirnames, filenames in os.walk(src):
+                relpath = os.path.relpath(dirpath, src)
+                tdir = dst if relpath == "." else os.path.join(dst, relpath)
+                try:
+                    os.makedirs(tdir, exist_ok=True)
+                except OSError:
+                    continue
+                for fn in filenames:
+                    _link_or_copy(os.path.join(dirpath, fn),
+                                  os.path.join(tdir, fn))
+                # os.walk doesn't descend symlinked dirs: recreate the
+                # link itself (its target is embedded on its own).
+                for dn in _dirnames:
+                    sp = os.path.join(dirpath, dn)
+                    if os.path.islink(sp):
+                        _link_or_copy(sp, os.path.join(tdir, dn))
+        else:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            _link_or_copy(src, dst)
+
 
 class AllocDir:
     def __init__(self, root: str):
@@ -224,11 +300,35 @@ class AllocDir:
         }
 
     def disk_used_mb(self) -> float:
+        """Bytes the ALLOCATION is charged for: everything under the
+        alloc dir except the embedded chroot toolchain (embed_chroot's
+        manifest — those hardlinks consume no new disk and would blow
+        any sane quota), with each inode counted once so a task can't
+        dodge (or double-pay) the quota through its own hardlinks."""
+        import json as _json
+
+        pruned = set()
+        for task_dir in self.task_dirs.values():
+            try:
+                with open(os.path.join(task_dir, EMBED_MANIFEST)) as f:
+                    for rel in _json.load(f):
+                        pruned.add(os.path.join(task_dir, rel))
+            except (OSError, ValueError):
+                pass
         total = 0
-        for dirpath, _, files in os.walk(self.root):
+        seen = set()
+        for dirpath, dirnames, files in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames
+                           if os.path.join(dirpath, d) not in pruned]
             for name in files:
                 try:
-                    total += os.path.getsize(os.path.join(dirpath, name))
+                    st = os.lstat(os.path.join(dirpath, name))
                 except OSError:
-                    pass
+                    continue
+                if st.st_nlink > 1:
+                    key = (st.st_dev, st.st_ino)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                total += st.st_size
         return total / (1024 * 1024)

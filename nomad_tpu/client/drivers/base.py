@@ -21,6 +21,9 @@ class TaskContext:
     # resolve against (reference: alloc_dir.go task dir layout)
     log_dir: str = ""
     env: Dict[str, str] = field(default_factory=dict)
+    # The ALLOCATED networks for this task (alloc.task_resources, not
+    # the ask): drivers publish these ports (docker.go:521-577).
+    networks: list = field(default_factory=list)
     max_kill_timeout: float = 30.0
     # task log rotation budget (structs LogConfig), so drivers that
     # rebuild log plumbing on reattach honor the configured limits

@@ -156,12 +156,106 @@ class DockerDriver(Driver):
         "image": Field("string", required=True),
         "command": Field("string"),
         "args": Field("list"),
+        # Image archives (relative to the task dir) loaded instead of
+        # pulled (docker.go:97 LoadImages).
+        "load": Field("list"),
+        # [{label: container_port}, ...] — allocated host ports publish
+        # to these container ports (docker.go:104 PortMapRaw).
         "port_map": Field("list"),
         "network_mode": Field("string"),
+        "ipc_mode": Field("string"),
+        "pid_mode": Field("string"),
+        "uts_mode": Field("string"),
+        "dns_servers": Field("list"),
+        "dns_search_domains": Field("list"),
+        "hostname": Field("string"),
+        "labels": Field("list"),  # [{k: v}, ...] (docker.go LabelsRaw)
+        # [{username, password, email, server_address}] for private
+        # registries (docker.go:112 Auth).
+        "auth": Field("list"),
+        "ssl": Field("bool"),
         "work_dir": Field("string"),
         "privileged": Field("bool"),
     })
 
+    @staticmethod
+    def _parse_repo_tag(image: str):
+        """repo, tag — the tag is after the last ':' only if that comes
+        after the last '/' (registry.example:5000/img has no tag)."""
+        slash = image.rfind("/")
+        colon = image.rfind(":")
+        if colon > slash:
+            return image[:colon], image[colon + 1:]
+        return image, "latest"
+
+    def _ensure_image(self, docker: str, cfg: dict, ctx: TaskContext,
+                      image: str) -> None:
+        """Pull policy (docker.go:636 createImage): a non-latest tag
+        already present locally is reused; 'latest' always re-pulls so
+        a moved tag is seen; `load` archives short-circuit the
+        registry entirely. Registry auth rides an ephemeral
+        DOCKER_CONFIG (the CLI analog of AuthConfiguration) so
+        credentials never touch the operator's ~/.docker."""
+        _repo, tag = self._parse_repo_tag(image)
+        if tag != "latest":
+            probe = _run([docker, "image", "inspect", image], timeout=30.0)
+            if probe.returncode == 0:
+                return
+        loads = cfg.get("load") or []
+        if loads:
+            base = ctx.task_dir or "."
+            for archive in loads:
+                path = os.path.join(base, str(archive))
+                proc = _run([docker, "load", "-i", path], timeout=300.0)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"docker load {path!r} failed: "
+                        f"{proc.stderr.strip()}")
+            return
+        env = None
+        tmp = None
+        auths = cfg.get("auth") or []
+        if auths:
+            import base64
+            import tempfile
+
+            a = dict(auths[0])
+            registry = a.get("server_address")
+            if not registry:
+                # Only a first path segment with a '.' or ':' (or
+                # "localhost") is a registry HOST; "myorg/app" is a
+                # Docker Hub org and its credentials key is the Hub
+                # index URL — keying on "myorg" would never match and
+                # the pull would silently go anonymous.
+                first = image.split("/", 1)[0]
+                if "/" in image and ("." in first or ":" in first
+                                    or first == "localhost"):
+                    registry = first
+                    if cfg.get("ssl"):
+                        registry = "https://" + registry
+                else:
+                    registry = "https://index.docker.io/v1/"
+            token = base64.b64encode(
+                f"{a.get('username', '')}:{a.get('password', '')}"
+                .encode()).decode()
+            entry = {"auth": token}
+            if a.get("email"):
+                entry["email"] = a["email"]
+            tmp = tempfile.mkdtemp(prefix="nomad-docker-auth-")
+            with open(os.path.join(tmp, "config.json"), "w") as f:
+                json.dump({"auths": {registry: entry}}, f)
+            os.chmod(os.path.join(tmp, "config.json"), 0o600)
+            env = {**os.environ, "DOCKER_CONFIG": tmp}
+        try:
+            proc = subprocess.run(
+                [docker, "pull", image], capture_output=True, text=True,
+                timeout=600.0, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"docker pull {image!r} failed: {proc.stderr.strip()}")
+        finally:
+            if tmp is not None:
+                shutil.rmtree(tmp, ignore_errors=True)
 
     def start(self, ctx: TaskContext, task: Task) -> DriverHandle:
         docker = _docker_bin()
@@ -171,6 +265,7 @@ class DockerDriver(Driver):
         image = cfg.get("image")
         if not image:
             raise ValueError(f"docker task {task.name!r} missing 'image'")
+        self._ensure_image(docker, cfg, ctx, image)
 
         args = [docker, "run", "-d",
                 "--name", f"nomad-{ctx.alloc_id[:8]}-{task.name}-{int(time.time())}"]
@@ -207,13 +302,57 @@ class DockerDriver(Driver):
             secrets = os.path.join(os.path.abspath(ctx.task_root), "secrets")
             os.makedirs(secrets, exist_ok=True)
             args += ["-v", f"{secrets}:/secrets"]
-        for key, val in ctx.env.items():
+        # Port publishing (docker.go:519-577): every allocated port of
+        # the first network publishes host ip:port -> container port,
+        # tcp AND udp; port_map relabels the container side, default
+        # 1:1. The task env advertises the CONTAINER port for mapped
+        # labels (taskEnv.SetPortMap) — that's the port the in-container
+        # process must bind.
+        port_map = {}
+        for entry in cfg.get("port_map") or []:
+            if not isinstance(entry, dict):
+                # The old string form ("8080:80") must fail loudly: a
+                # silently-dropped mapping ships a container with no
+                # published ports.
+                raise ValueError(
+                    f"port_map entries must be label->port maps, got "
+                    f"{entry!r}")
+            port_map.update({str(k): int(v) for k, v in entry.items()})
+        env = dict(ctx.env)
+        if port_map and not ctx.networks:
+            raise RuntimeError(
+                "trying to map ports but no network interface is "
+                "available")
+        if ctx.networks:
+            net = ctx.networks[0]
+            ip = getattr(net, "ip", "") or ""
+            prefix = f"{ip}:" if ip else ""
+            for port in (list(net.reserved_ports)
+                         + list(net.dynamic_ports)):
+                container = port_map.get(port.label, port.value)
+                args += ["-p", f"{prefix}{port.value}:{container}/tcp",
+                         "-p", f"{prefix}{port.value}:{container}/udp"]
+                if port.label in port_map:
+                    label = port.label.upper().replace("-", "_")
+                    env[f"NOMAD_PORT_{label}"] = str(container)
+        for key, val in env.items():
             args += ["-e", f"{key}={val}"]
-        # Static port publishing from the first allocated network.
-        for label_port in cfg.get("port_map", []) or []:
-            args += ["-p", str(label_port)]
         if cfg.get("network_mode"):
             args += ["--network", str(cfg["network_mode"])]
+        for mode_flag, key in (("--ipc", "ipc_mode"), ("--pid", "pid_mode"),
+                               ("--uts", "uts_mode")):
+            if cfg.get(key):
+                args += [mode_flag, str(cfg[key])]
+        for ip_addr in cfg.get("dns_servers") or []:
+            args += ["--dns", str(ip_addr)]
+        for domain in cfg.get("dns_search_domains") or []:
+            args += ["--dns-search", str(domain)]
+        if cfg.get("hostname"):
+            args += ["--hostname", str(cfg["hostname"])]
+        for entry in cfg.get("labels") or []:
+            if isinstance(entry, dict):
+                for k, v in entry.items():
+                    args += ["--label", f"{k}={v}"]
         if cfg.get("work_dir"):
             args += ["-w", str(cfg["work_dir"])]
         if cfg.get("privileged"):

@@ -24,6 +24,9 @@ class ExecDriver(Driver):
         "command": Field("string", required=True),
         "args": Field("list"),
         "chroot": Field("bool"),
+        # host path -> chroot-relative destination overrides; defaults
+        # to allocdir.CHROOT_ENV (client config chroot_env).
+        "chroot_env": Field("map"),
     })
 
 
@@ -39,12 +42,16 @@ class ExecDriver(Driver):
         mem_bytes = None
         if task.resources is not None and task.resources.memory_mb:
             mem_bytes = task.resources.memory_mb * 1024 * 1024
-        # Chroot only on explicit opt-in while running as root; the
-        # reference builds a populated chroot per task (exec_linux.go),
-        # which needs root and an embedded toolchain.
+        # Chroot only on explicit opt-in while running as root: embed
+        # the host toolchain into the task dir (alloc_dir.go:348 Embed
+        # + exec_linux.go:48) so the chrooted binary finds its loader
+        # and libraries, then ask the executor to chroot there.
         chroot = None
         if (task.config or {}).get("chroot") and os.geteuid() == 0:
+            from ..allocdir import embed_chroot
+
             chroot = ctx.task_root or ctx.task_dir
+            embed_chroot(chroot, (task.config or {}).get("chroot_env"))
         return launch_executor(ctx, task, rlimit_as=mem_bytes, chroot=chroot)
 
     def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:

@@ -91,9 +91,13 @@ class TaskRunner:
         if self._thread is not None:
             self._thread.join(timeout)
 
-    def kill(self, event: Optional[TaskEvent] = None) -> None:
+    def kill(self, event: Optional[TaskEvent] = None,
+             fail: bool = False) -> None:
+        """`fail=True` marks the task failed when it dies (a policy
+        kill — disk quota, leader kill — not an operator stop)."""
         with self._lock:
             self._destroy_event = event or new_task_event(consts.TASK_EVENT_KILLING)
+            self._destroy_fail = fail
             self._kill.set()
             handle = self.handle  # run() re-kills if start() races us
         if handle is not None:
@@ -136,6 +140,9 @@ class TaskRunner:
             log_dir=self.alloc_dir.log_dir(),
             env=task_env_from_alloc_dir(self.alloc, self.task,
                                         self.alloc_dir),
+            networks=list(getattr(
+                self.alloc.task_resources.get(self.task.name),
+                "networks", None) or []),
             max_kill_timeout=self.max_kill_timeout,
             log_max_files=(self.task.log_config.max_files
                            if self.task.log_config else 10),
@@ -284,10 +291,11 @@ class TaskRunner:
         if self._kill.is_set():
             with self._lock:
                 destroy_ev = self._destroy_event
+                destroy_fail = getattr(self, "_destroy_fail", False)
             self._emit(
                 consts.TASK_STATE_DEAD,
                 destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
-                failed=False,
+                failed=destroy_fail,
             )
             return True
 
@@ -504,10 +512,11 @@ class TaskRunner:
                 self.logger.exception("kill during shutdown failed")
         with self._lock:
             destroy_ev = self._destroy_event
+            destroy_fail = getattr(self, "_destroy_fail", False)
         self._emit(
             consts.TASK_STATE_DEAD,
             destroy_ev or new_task_event(consts.TASK_EVENT_KILLED),
-            failed=False,
+            failed=destroy_fail,
         )
 
     def _try_reattach(self, driver, ctx) -> bool:

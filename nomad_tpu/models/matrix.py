@@ -58,6 +58,8 @@ JOBPOS_BUCKETS = [16, 64, 256, 1024]
 # nodes / 50k+ allocs.
 _BASE_CACHE: Dict[Tuple, "_ClusterBase"] = {}
 _BASE_FAMILY: Dict[Tuple, "_ClusterBase"] = {}
+# key -> Event while a build is in flight (single-flight guard).
+_BASE_PENDING: Dict[Tuple, object] = {}
 _BASE_CACHE_MAX = 8
 _BASE_CACHE_LOCK = __import__("threading").Lock()
 _BASE_TOKENS = __import__("itertools").count(1)
@@ -239,12 +241,33 @@ class _ClusterBase:
         created = sum(1 for a in allocs if a.create_index > base_allocs_index)
         if len(allocs) != base_table_len + created:
             return None  # deletions happened; they are untraceable
-        changed_nodes = {
-            a.node_id for a in allocs
-            if a.modify_index > base_allocs_index
-        }
+        # Split the changes: an alloc CREATED after our watermark was
+        # never in this base, so its usage can be scatter-ADDED to its
+        # row directly — no re-scan of the node's other allocs. Only
+        # rows with modified pre-existing allocs (in-place updates,
+        # terminal transitions whose usage must come OUT) need the full
+        # refill. A placement storm is pure creations — without this
+        # split every committed plan degraded the next eval's delta to
+        # a full O(N x allocs) rebuild (the refill cap below), making
+        # the storm quadratic in total allocs (VERDICT r4 ask #8).
+        refill_nids = set()
+        adds = []
+        for a in allocs:
+            if a.modify_index <= base_allocs_index:
+                continue
+            if a.create_index > base_allocs_index:
+                if not a.terminal_status():
+                    adds.append(a)
+                # created-then-terminal since the base: never counted,
+                # consumes nothing now — nothing to do.
+            else:
+                refill_nids.add(a.node_id)
         row_of = {node.id: i for i, node in enumerate(nodes)}
-        rows = [row_of[nid] for nid in changed_nodes if nid in row_of]
+        adds = [a for a in adds
+                if a.node_id not in refill_nids and a.node_id in row_of]
+        refill_rows = [row_of[nid] for nid in refill_nids if nid in row_of]
+        rows = sorted({row_of[a.node_id] for a in adds}
+                      | set(refill_rows))
         if not rows:
             # Nothing in OUR node set changed: rekey in place. table_len
             # must advance too — allocs may have been created on nodes
@@ -258,8 +281,9 @@ class _ClusterBase:
                     self.allocs_index = new_allocs_index
                     self.table_len = len(allocs)
             return self
-        if len(rows) > max(64, self.n_real // 4):
-            return None  # full rebuild is cheaper
+        if len(refill_rows) > max(64, self.n_real // 4):
+            return None  # full rebuild is cheaper (refills only: the
+            #              additive rows cost O(1) per new alloc)
         new = _ClusterBase.__new__(_ClusterBase)
         new.token = next(_BASE_TOKENS)
         new.allocs_index = new_allocs_index
@@ -279,10 +303,24 @@ class _ClusterBase:
         new.node_ok = self.node_ok.copy()
         new.alloc_groups = list(self.alloc_groups)
         old_groups = {i: self.alloc_groups[i] for i in rows}
-        for i in rows:
+        for i in refill_rows:
             new._fill_row(
                 i, nodes[i],
                 state.allocs_by_node_terminal(nodes[i].id, False))
+        if adds:
+            # Additive rows: one bulk scatter-add of the new allocs'
+            # memoized usage — O(new allocs), not O(rows x allocs).
+            ridx = np.asarray([row_of[a.node_id] for a in adds], np.intp)
+            ua = np.asarray([_alloc_usage(a) for a in adds], np.float32)
+            np.add.at(new.util, ridx, ua[:, :4])
+            np.add.at(new.bw_used, ridx, ua[:, 4])
+            np.subtract.at(new.ports_free, ridx, ua[:, 5])
+            for a in adds:
+                i = row_of[a.node_id]
+                # Copy-on-write: the parent's row list stays untouched.
+                if new.alloc_groups[i] is self.alloc_groups[i]:
+                    new.alloc_groups[i] = list(self.alloc_groups[i])
+                new.alloc_groups[i].append((a.job_id, a.task_group))
         new._patch_positions(self, rows, old_groups)
         return new
 
@@ -563,30 +601,48 @@ class ClusterMatrix:
                    len(self.nodes), nodes_sig)
             family = (self.state.store_id, nodes_idx, dcs,
                       len(self.nodes), nodes_sig)
-            with _BASE_CACHE_LOCK:
-                cached = _BASE_CACHE.get(key)
-                if cached is None:
-                    prev = _BASE_FAMILY.get(family)
-            if cached is not None:
-                return cached
+            # Single-flight per key: a drained batch's evals all build
+            # their matrices CONCURRENTLY against one fresh snapshot —
+            # without the pending gate every thread misses at once and
+            # builds its own base with its own token, which fragments
+            # the batcher's token-keyed queues AND pays one ~full base
+            # upload per thread (observed: 24 uploads of one identical
+            # 10k-node base through the device tunnel).
+            while True:
+                with _BASE_CACHE_LOCK:
+                    cached = _BASE_CACHE.get(key)
+                    if cached is not None:
+                        return cached
+                    pending = _BASE_PENDING.get(key)
+                    if pending is None:
+                        done = __import__("threading").Event()
+                        _BASE_PENDING[key] = done
+                        prev = _BASE_FAMILY.get(family)
+                        break
+                pending.wait(60.0)
         base = None
-        if prev is not None and 0 <= prev.allocs_index <= allocs_idx:
-            base = prev.delta_update(self.nodes, self.state, allocs_idx)
-        if base is None:
-            table_len = (self.state.alloc_count()
-                         if key is not None
-                         and hasattr(self.state, "alloc_count") else -1)
-            base = _ClusterBase(self.nodes, self._proposed_allocs,
-                                allocs_index=allocs_idx if key else -1,
-                                table_len=table_len)
-        if key is not None:
-            with _BASE_CACHE_LOCK:
-                while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
-                    _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
-                _BASE_CACHE[key] = base
-                _BASE_FAMILY[family] = base
-                while len(_BASE_FAMILY) > _BASE_CACHE_MAX:
-                    _BASE_FAMILY.pop(next(iter(_BASE_FAMILY)))
+        try:
+            if prev is not None and 0 <= prev.allocs_index <= allocs_idx:
+                base = prev.delta_update(self.nodes, self.state, allocs_idx)
+            if base is None:
+                table_len = (self.state.alloc_count()
+                             if key is not None
+                             and hasattr(self.state, "alloc_count") else -1)
+                base = _ClusterBase(self.nodes, self._proposed_allocs,
+                                    allocs_index=allocs_idx if key else -1,
+                                    table_len=table_len)
+        finally:
+            if key is not None:
+                with _BASE_CACHE_LOCK:
+                    if base is not None:
+                        while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
+                            _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
+                        _BASE_CACHE[key] = base
+                        _BASE_FAMILY[family] = base
+                        while len(_BASE_FAMILY) > _BASE_CACHE_MAX:
+                            _BASE_FAMILY.pop(next(iter(_BASE_FAMILY)))
+                    _BASE_PENDING.pop(key, None)
+                done.set()
         return base
 
     def _build(self) -> None:

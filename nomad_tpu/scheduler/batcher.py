@@ -99,14 +99,37 @@ class _Request:
         )
 
 
+# Shape-bucket ladders. Every distinct padded size is a distinct XLA
+# program: through a remote tunnel one trace+compile-cache-load costs
+# ~1-2s, so COARSE ladders beat tight padding — the wasted lanes are
+# microseconds of device compute, the extra shapes are seconds of host
+# stall (measured: pow2 row buckets made every storm dispatch a fresh
+# shape).
+ROW_BUCKETS = (256, 4096)
+BATCH_BUCKETS = (4, 16, 64)
+
+
 def _pad_rows(rows) -> np.ndarray:
-    """Pad a changed-row index list to a power of two (every distinct
-    length is a compile); padding repeats the FIRST changed row, and a
-    duplicate-index scatter writing the identical value is benign."""
-    k = 1 << (len(rows) - 1).bit_length()
+    """Pad a changed-row index list up to a ladder bucket; padding
+    repeats the FIRST changed row, and a duplicate-index scatter
+    writing the identical value is benign."""
+    n = len(rows)
+    for b in ROW_BUCKETS:
+        if n <= b:
+            k = b
+            break
+    else:
+        k = 1 << (n - 1).bit_length()
     rows_p = np.full(k, rows[0], np.int32)
-    rows_p[: len(rows)] = rows
+    rows_p[:n] = rows
     return rows_p
+
+
+def _pad_batch(n: int, max_batch: int) -> int:
+    for b in BATCH_BUCKETS:
+        if n <= b <= max_batch:
+            return b
+    return max_batch
 
 
 class PlacementBatcher:
@@ -117,6 +140,10 @@ class PlacementBatcher:
         self.window = window
         self.logger = logging.getLogger("nomad_tpu.batcher")
         self._lock = threading.Lock()
+        # Signaled by place() when a shape's queue reaches max_batch so
+        # an accumulating dispatcher wakes immediately instead of
+        # polling out its window.
+        self._full = threading.Condition(self._lock)
         self._queues: Dict[Tuple, List[_Request]] = {}
         self._dispatchers: Dict[Tuple, int] = {}  # live dispatchers/shape
         self._device_bases: "OrderedDict[object, tuple]" = OrderedDict()  # token -> device arrays
@@ -192,7 +219,10 @@ class PlacementBatcher:
                        compact=compact)
         run_dispatch = False
         with self._lock:
-            self._queues.setdefault(shape_key, []).append(req)
+            q = self._queues.setdefault(shape_key, [])
+            q.append(req)
+            if len(q) >= self.max_batch:
+                self._full.notify_all()
             if self._dispatchers.get(shape_key, 0) == 0:
                 # First in: this thread becomes the batch's dispatcher.
                 # (Only idle shapes start here — while dispatchers are
@@ -399,12 +429,12 @@ class PlacementBatcher:
             req.scores = np.asarray(scores)
             return
 
-        # Pad the batch axis to a power of two: every distinct B is a
-        # distinct XLA program, and live drains produce ragged sizes —
-        # unbucketed, each one would pay a full compile. Padding rows
-        # replicate the last request; their outputs are discarded.
+        # Pad the batch axis up a ladder bucket (see BATCH_BUCKETS):
+        # live drains produce ragged sizes — unbucketed, each one would
+        # pay a full compile. Padding rows replicate the last request;
+        # their outputs are discarded.
         n_live = len(batch)
-        pad_to = min(1 << (n_live - 1).bit_length(), self.max_batch)
+        pad_to = _pad_batch(n_live, self.max_batch)
         padded = batch + [batch[-1]] * (pad_to - n_live)
 
         t0 = _time.perf_counter()
@@ -514,6 +544,23 @@ class PlacementBatcher:
             req.choices = choices[i]
             req.scores = scores[i]
 
+    def _accumulate(self, shape_key, window: float) -> None:
+        """Wait up to `window` for requests to pile on — but a FULL
+        batch dispatches immediately: once max_batch requests are
+        queued nothing more can join this dispatch, and through a
+        remote tunnel the window is a large fraction of the round-trip
+        itself. Sleeps on a condition place() signals at max_batch —
+        no lock-polling on the scheduler hot path."""
+        import time as _time
+
+        deadline = _time.monotonic() + window
+        with self._full:
+            while len(self._queues.get(shape_key, ())) < self.max_batch:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return
+                self._full.wait(remaining)
+
     def _spawn_dispatcher(self, shape_key, config) -> None:
         threading.Thread(
             target=self._dispatch, args=(shape_key, config, False),
@@ -538,11 +585,23 @@ class PlacementBatcher:
                 # most of their batch accumulated during the in-flight
                 # device call (the adaptive part); the short wait only
                 # catches stragglers mid-host-phase. The window grows
-                # with the measured round-trip (see WINDOW_S note).
-                _time.sleep(min(WINDOW_MAX_S,
-                                max(self.window, self._sync_ema * 0.5)))
+                # with the measured round-trip (see WINDOW_S note) —
+                # but a FULL batch dispatches immediately: once
+                # max_batch requests are queued nothing more can join
+                # this dispatch, and through a remote tunnel the window
+                # is a large fraction of the round-trip itself.
+                self._accumulate(shape_key, min(
+                    WINDOW_MAX_S, max(self.window, self._sync_ema * 0.5)))
             elif not wait_window and RESPAWN_WINDOW_S > 0:
-                _time.sleep(RESPAWN_WINDOW_S)
+                # Respawn window is adaptive too: through a remote
+                # tunnel (sync_ema ~100ms+) a 5ms straggler window
+                # ships near-empty follow-up dispatches — each ragged
+                # size is its own XLA program, so tiny respawn batches
+                # pay compiles AND round-trips. The floor stays small
+                # for locally-attached chips.
+                self._accumulate(shape_key, max(
+                    RESPAWN_WINDOW_S,
+                    min(WINDOW_MAX_S, self._sync_ema * 0.5)))
             with self._lock:
                 waiting = self._queues.pop(shape_key, [])
                 batch = waiting[: self.max_batch]

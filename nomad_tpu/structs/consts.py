@@ -73,6 +73,7 @@ TASK_EVENT_DOWNLOADING_ARTIFACTS = "Downloading Artifacts"
 TASK_EVENT_ARTIFACT_DOWNLOAD_FAILED = "Failed Artifact Download"
 TASK_EVENT_SIGNALING = "Signaling"
 TASK_EVENT_RESTART_SIGNAL = "Restart Signaled"
+TASK_EVENT_DISK_EXCEEDED = "Disk Resources Exceeded"
 
 # --- Constraint operands (structs.go:2713-2715, feasible.go:337-371) ---
 CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"

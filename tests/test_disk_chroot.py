@@ -1,0 +1,133 @@
+"""Ephemeral-disk enforcement (alloc_dir.go:618 disk watcher) and the
+chroot Embed (alloc_dir.go:348, exec_linux.go:48): an over-quota task
+group is killed with a disk-exceeded event, and a chrooted exec task
+finds its toolchain inside the populated task dir."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import alloc_runner as ar_mod
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.client.allocdir import CHROOT_ENV, embed_chroot
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_disk_exceeded_kills_tasks(tmp_path, monkeypatch):
+    monkeypatch.setattr(ar_mod, "DISK_WATCH_INTERVAL", 0.1)
+    alloc = mock.alloc()
+    tg = alloc.job.task_groups[0]
+    tg.ephemeral_disk.size_mb = 1
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": 30.0}
+    alloc.task_resources = {task.name: task.resources}
+    synced = []
+    runner = AllocRunner(alloc, str(tmp_path),
+                         lambda a: synced.append(a.client_status), 5.0)
+    runner.run()
+    assert wait_until(
+        lambda: (alloc.task_states.get(task.name) or mock.alloc()
+                 ).state == consts.TASK_STATE_RUNNING
+        if alloc.task_states.get(task.name) else False)
+
+    # Blow the 1MB quota from inside the alloc dir.
+    hog = os.path.join(runner.alloc_dir.shared_dir, "data", "hog")
+    with open(hog, "wb") as f:
+        f.write(b"\x00" * (3 * 1024 * 1024))
+
+    assert wait_until(
+        lambda: alloc.task_states[task.name].state == consts.TASK_STATE_DEAD)
+    ts = alloc.task_states[task.name]
+    assert ts.failed, "disk-exceeded kill must fail the task"
+    assert any(e.type == consts.TASK_EVENT_DISK_EXCEEDED for e in ts.events)
+    assert any("exceeds" in (e.message or "") for e in ts.events)
+    assert wait_until(
+        lambda: alloc.client_status == consts.ALLOC_CLIENT_FAILED)
+
+
+def test_disk_within_quota_untouched(tmp_path, monkeypatch):
+    monkeypatch.setattr(ar_mod, "DISK_WATCH_INTERVAL", 0.1)
+    alloc = mock.alloc()
+    alloc.job.type = "batch"  # completes instead of restarting
+    tg = alloc.job.task_groups[0]
+    tg.ephemeral_disk.size_mb = 100
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": 0.5}
+    alloc.task_resources = {task.name: task.resources}
+    runner = AllocRunner(alloc, str(tmp_path), lambda a: None, 5.0)
+    runner.run()
+    assert wait_until(
+        lambda: alloc.task_states.get(task.name) is not None
+        and alloc.task_states[task.name].state == consts.TASK_STATE_DEAD)
+    ts = alloc.task_states[task.name]
+    assert not ts.failed
+    assert not any(
+        e.type == consts.TASK_EVENT_DISK_EXCEEDED for e in ts.events)
+
+
+def test_embed_chroot_links_files_and_symlinks(tmp_path):
+    src = tmp_path / "hostroot"
+    (src / "inner").mkdir(parents=True)
+    (src / "tool").write_text("#!/bin/sh\necho hi\n")
+    (src / "inner" / "lib.so.1.2").write_text("lib")
+    os.symlink("lib.so.1.2", src / "inner" / "lib.so")
+
+    chroot = tmp_path / "chroot"
+    chroot.mkdir()
+    embed_chroot(str(chroot), {str(src): "opt/host", "/nonexistent": "x"})
+
+    assert (chroot / "opt/host/tool").read_text().startswith("#!")
+    # Hardlinked, not copied: same inode.
+    assert (chroot / "opt/host/tool").stat().st_ino == (src / "tool").stat().st_ino
+    # Symlink preserved as a symlink with its relative target.
+    link = chroot / "opt/host/inner/lib.so"
+    assert link.is_symlink() and os.readlink(link) == "lib.so.1.2"
+    assert not (chroot / "x").exists()
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="chroot requires root")
+def test_chroot_exec_runs_in_populated_root(tmp_path):
+    """A chrooted exec task runs /bin/sh from the EMBEDDED toolchain
+    and can only see the task dir as its filesystem."""
+    alloc = mock.alloc()
+    alloc.job.type = "batch"  # completes instead of restarting
+    tg = alloc.job.task_groups[0]
+    task = tg.tasks[0]
+    task.driver = "exec"
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", "ls / > /local/rootlist; echo ok > /local/out"],
+        "chroot": True,
+    }
+    alloc.task_resources = {task.name: task.resources}
+    runner = AllocRunner(alloc, str(tmp_path), lambda a: None, 5.0)
+    runner.run()
+    assert wait_until(
+        lambda: alloc.task_states.get(task.name) is not None
+        and alloc.task_states[task.name].state == consts.TASK_STATE_DEAD,
+        timeout=60.0)
+    ts = alloc.task_states[task.name]
+    assert not ts.failed, [
+        (e.type, e.message, e.driver_error) for e in ts.events]
+    task_dir = runner.alloc_dir.task_dirs[task.name]
+    out = os.path.join(task_dir, "local", "out")
+    assert wait_until(lambda: os.path.exists(out), timeout=10.0)
+    assert open(out).read().strip() == "ok"
+    # The task's / was the task dir: its listing has the embedded
+    # toolchain and local/, not the host root's contents.
+    rootlist = open(os.path.join(task_dir, "local", "rootlist")).read()
+    assert "local" in rootlist and "bin" in rootlist
+    assert "hostroot-canary" not in rootlist

@@ -1,0 +1,203 @@
+"""Docker driver parity against a FAKE docker CLI (docker.go:324-360
+and the createContainer/createImage paths): pull policy, registry
+auth via ephemeral DOCKER_CONFIG, port-map modes, resource flags,
+container modes, and reattach — all asserted from the argv the driver
+actually hands the CLI."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from nomad_tpu.client.drivers.base import TaskContext
+from nomad_tpu.client.drivers.docker import DockerDriver
+from nomad_tpu.structs import NetworkResource, Port, Resources, Task
+
+FAKE = """#!/usr/bin/env python3
+import json, os, sys
+with open(os.environ["FAKE_DOCKER_LOG"], "a") as f:
+    rec = {"argv": sys.argv[1:]}
+    cfg = os.environ.get("DOCKER_CONFIG")
+    if cfg:
+        try:
+            rec["docker_config"] = json.load(
+                open(os.path.join(cfg, "config.json")))
+        except OSError:
+            pass
+    f.write(json.dumps(rec) + "\\n")
+cmd = sys.argv[1]
+if cmd == "version":
+    print("99.9"); sys.exit(0)
+if cmd == "image":  # image inspect
+    sys.exit(0 if os.environ.get("FAKE_DOCKER_HAS_IMAGE") == "1" else 1)
+if cmd in ("pull", "load", "rm", "stop", "kill"):
+    sys.exit(0)
+if cmd == "run":
+    print("cafebabe42"); sys.exit(0)
+if cmd == "wait":
+    print("0"); sys.exit(0)
+if cmd == "inspect":
+    fmt = sys.argv[sys.argv.index("-f") + 1]
+    print("true" if "Running" in fmt else "4242"); sys.exit(0)
+sys.exit(0)
+"""
+
+
+@pytest.fixture
+def fake_docker(tmp_path, monkeypatch):
+    bin_path = tmp_path / "docker"
+    bin_path.write_text(FAKE)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IXUSR)
+    log = tmp_path / "docker.log"
+    log.write_text("")
+    monkeypatch.setenv("NOMAD_DOCKER_BIN", str(bin_path))
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    monkeypatch.delenv("FAKE_DOCKER_HAS_IMAGE", raising=False)
+
+    def calls():
+        return [json.loads(line)
+                for line in log.read_text().splitlines() if line]
+
+    return calls
+
+
+def make_ctx(tmp_path, networks=None):
+    return TaskContext(
+        alloc_id="a1b2c3d4",
+        alloc_dir=str(tmp_path / "alloc"),
+        task_dir=str(tmp_path / "task" / "local"),
+        task_root=str(tmp_path / "task"),
+        env={"NOMAD_PORT_http": "22000"},
+        networks=networks or [],
+    )
+
+
+def make_task(**cfg):
+    t = Task(name="web", driver="docker",
+             config={"image": "redis:3.2", **cfg})
+    t.resources = Resources(cpu=512, memory_mb=256)
+    return t
+
+
+def run_argv(calls):
+    return next(c["argv"] for c in calls() if c["argv"][0] == "run")
+
+
+def test_pull_policy_skips_present_pinned_tag(tmp_path, fake_docker,
+                                              monkeypatch):
+    monkeypatch.setenv("FAKE_DOCKER_HAS_IMAGE", "1")
+    h = DockerDriver().start(make_ctx(tmp_path), make_task())
+    h.kill()
+    cmds = [c["argv"][0] for c in fake_docker()]
+    assert "pull" not in cmds, "pinned tag already present must not pull"
+
+
+def test_pull_policy_pulls_missing_image(tmp_path, fake_docker):
+    h = DockerDriver().start(make_ctx(tmp_path), make_task())
+    h.kill()
+    cmds = [c["argv"][:2] for c in fake_docker() if c["argv"][0] == "pull"]
+    assert cmds == [["pull", "redis:3.2"]]
+
+
+def test_latest_tag_always_pulls(tmp_path, fake_docker, monkeypatch):
+    monkeypatch.setenv("FAKE_DOCKER_HAS_IMAGE", "1")
+    task = make_task()
+    task.config["image"] = "redis:latest"
+    h = DockerDriver().start(make_ctx(tmp_path), task)
+    h.kill()
+    assert any(c["argv"][0] == "pull" for c in fake_docker())
+
+
+def test_registry_auth_rides_ephemeral_docker_config(tmp_path, fake_docker):
+    task = make_task()
+    task.config["image"] = "registry.example.com:5000/app:1.0"
+    task.config["auth"] = [{
+        "username": "u", "password": "p", "email": "e@x.com",
+        "server_address": "registry.example.com:5000",
+    }]
+    h = DockerDriver().start(make_ctx(tmp_path), task)
+    h.kill()
+    pull = next(c for c in fake_docker() if c["argv"][0] == "pull")
+    auths = pull["docker_config"]["auths"]
+    assert "registry.example.com:5000" in auths
+    import base64
+    assert base64.b64decode(
+        auths["registry.example.com:5000"]["auth"]) == b"u:p"
+    assert auths["registry.example.com:5000"]["email"] == "e@x.com"
+
+
+def test_load_archives_instead_of_pull(tmp_path, fake_docker):
+    (tmp_path / "task" / "local").mkdir(parents=True)
+    task = make_task()
+    task.config["load"] = ["redis.tar"]
+    h = DockerDriver().start(make_ctx(tmp_path), task)
+    h.kill()
+    loads = [c["argv"] for c in fake_docker() if c["argv"][0] == "load"]
+    assert loads and loads[0][2].endswith("local/redis.tar")
+    assert not any(c["argv"][0] == "pull" for c in fake_docker())
+
+
+def test_port_map_publishes_and_remaps_env(tmp_path, fake_docker):
+    net = NetworkResource(
+        ip="10.0.0.5",
+        reserved_ports=[Port(label="admin", value=12345)],
+        dynamic_ports=[Port(label="http", value=22000)],
+    )
+    task = make_task()
+    task.config["port_map"] = [{"http": 8080}]
+    h = DockerDriver().start(make_ctx(tmp_path, networks=[net]), task)
+    h.kill()
+    argv = run_argv(fake_docker)
+    published = [argv[i + 1] for i, a in enumerate(argv) if a == "-p"]
+    # Reserved port: 1:1 (no map entry); dynamic http: host->8080.
+    assert "10.0.0.5:12345:12345/tcp" in published
+    assert "10.0.0.5:12345:12345/udp" in published
+    assert "10.0.0.5:22000:8080/tcp" in published
+    assert "10.0.0.5:22000:8080/udp" in published
+    # The env advertises the CONTAINER port for the mapped label.
+    envs = [argv[i + 1] for i, a in enumerate(argv) if a == "-e"]
+    assert "NOMAD_PORT_HTTP=8080" in envs
+
+
+def test_port_map_without_network_fails(tmp_path, fake_docker):
+    task = make_task()
+    task.config["port_map"] = [{"http": 8080}]
+    with pytest.raises(RuntimeError, match="no network interface"):
+        DockerDriver().start(make_ctx(tmp_path), task)
+
+
+def test_resource_and_mode_flags(tmp_path, fake_docker):
+    task = make_task()
+    task.config.update({
+        "network_mode": "host", "ipc_mode": "host", "pid_mode": "host",
+        "uts_mode": "host", "hostname": "web1",
+        "dns_servers": ["8.8.8.8"], "dns_search_domains": ["example.com"],
+        "labels": [{"team": "infra"}], "privileged": True,
+        "work_dir": "/srv",
+    })
+    h = DockerDriver().start(make_ctx(tmp_path), task)
+    h.kill()
+    argv = run_argv(fake_docker)
+    joined = " ".join(argv)
+    assert "--cpu-shares 512" in joined
+    assert "--memory 256m" in joined
+    assert "--network host" in joined
+    assert "--ipc host" in joined and "--pid host" in joined
+    assert "--uts host" in joined
+    assert "--dns 8.8.8.8" in joined
+    assert "--dns-search example.com" in joined
+    assert "--hostname web1" in joined
+    assert "--label team=infra" in joined
+    assert "--privileged" in joined
+    assert "-w /srv" in joined
+
+
+def test_reattach_by_container_id(tmp_path, fake_docker):
+    drv = DockerDriver()
+    h = drv.start(make_ctx(tmp_path), make_task())
+    handle_id = h.id()
+    h.kill()
+    h2 = drv.open(make_ctx(tmp_path), handle_id)
+    assert h2 is not None and h2.container_id == "cafebabe42"
+    h2.kill()

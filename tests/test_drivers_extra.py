@@ -57,6 +57,8 @@ log="$STUB_LOG"
 echo "$@" >> "$log"
 case "$1" in
   version) echo "25.0.0" ;;
+  image) exit 1 ;;
+  pull) : ;;
   run) echo "cafebabe0001" ;;
   wait) echo "0" ;;
   inspect)
@@ -513,7 +515,8 @@ def test_docker_run_points_logs_at_syslog_collector(docker_stub, tmp_path):
     task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
     handle = DockerDriver().start(ctx, task)
     try:
-        line = docker_stub.read_text().splitlines()[0]
+        line = next(l for l in docker_stub.read_text().splitlines()
+                    if l.startswith("run "))
         assert "--log-driver syslog" in line
         assert "syslog-address=tcp://127.0.0.1:" in line
         assert handle.syslog is not None

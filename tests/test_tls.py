@@ -243,10 +243,18 @@ def test_agent_tls_block_plumbs_to_http(certs, tmp_path):
     import sys
     import urllib.request
 
+    import socket as _socket
+
+    # OS-assigned serf port (http uses port 0 directly; serf's default
+    # 4648 would collide with any other agent on the machine).
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    serf_port = s.getsockname()[1]
+    s.close()
     cfg = tmp_path / "tls-agent.hcl"
     cfg.write_text(f'''
         bind_addr = "127.0.0.1"
-        ports {{ http = 14896 serf = 14898 }}
+        ports {{ http = 0 serf = {serf_port} }}
         server {{ enabled = true num_schedulers = 1 }}
         tls {{
           enabled   = true
@@ -256,23 +264,34 @@ def test_agent_tls_block_plumbs_to_http(certs, tmp_path):
         }}
     ''')
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = tmp_path / "agent.out"
+    out = open(out_path, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "nomad_tpu.cli", "agent", "-config",
          str(cfg)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        stdout=out, stderr=subprocess.STDOUT, text=True,
         env={**os.environ, "PYTHONPATH": os.pathsep.join(
             p for p in [repo, os.environ.get("PYTHONPATH", "")] if p)},
     )
     try:
+        # The agent prints its bound address ("HTTP: https://...").
+        addr = None
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and addr is None:
+            for line in out_path.read_text().splitlines():
+                if "HTTP: https://" in line:
+                    addr = line.split("HTTP: ", 1)[1].strip()
+                    break
+            time.sleep(0.2)
+        assert addr, f"agent never announced https: {out_path.read_text()}"
         ctx = ssl.create_default_context(cafile=str(certs / "ca.pem"))
         ctx.check_hostname = False
-        deadline = time.monotonic() + 20.0
         ok = False
         while time.monotonic() < deadline:
             try:
                 with urllib.request.urlopen(
-                        "https://127.0.0.1:14896/v1/status/leader",
-                        context=ctx, timeout=2.0):
+                        f"{addr}/v1/status/leader", context=ctx,
+                        timeout=2.0):
                     ok = True
                     break
             except Exception:
@@ -281,10 +300,12 @@ def test_agent_tls_block_plumbs_to_http(certs, tmp_path):
         # Plaintext request against the TLS port fails.
         with pytest.raises(Exception):
             urllib.request.urlopen(
-                "http://127.0.0.1:14896/v1/status/leader", timeout=2.0)
+                addr.replace("https://", "http://") + "/v1/status/leader",
+                timeout=2.0)
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+        out.close()
 
 
 def test_http_api_over_tls_and_secret_gate(certs):
