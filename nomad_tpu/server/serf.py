@@ -3,11 +3,16 @@
 Reference: nomad/serf.go (event handler wiring peers/localPeers maps,
 server.go:100-104), server tags at server.go:740-760, and Serf's
 push-pull anti-entropy protocol. The reference rides hashicorp/serf
-(SWIM over UDP/TCP); here membership is a TCP push-pull gossip: each
-member runs a small listener, periodically syncs its full member table
-with one random alive peer, and marks peers failed after consecutive
-probe failures. Member records carry lamport-style incarnation numbers
-so newer information wins and a live member can refute its own death.
+(SWIM over UDP/TCP); here membership is a TCP digest gossip: each
+member runs a small listener, periodically exchanges an incarnation
+digest with one random alive peer (full member records cross the wire
+only for rows the digests disagree on — O(changes), not O(members)
+state per round), and marks peers failed after consecutive probe
+failures. Member records carry lamport-style incarnation numbers so
+newer information wins and a live member can refute its own death.
+SWIM-style indirect UDP probing is still out of scope: failure
+detection is direct-probe only, which is fine at server-pool sizes
+(~3-7 per region) though not at client-pool scale.
 
 This layer only tracks *server* membership (within and across regions)
 — clients discover servers via the HTTP API, as in the reference.
@@ -33,6 +38,18 @@ CONNECT_TIMEOUT = 1.0
 ALIVE = "alive"
 LEFT = "left"
 FAILED = "failed"
+
+# Equal-incarnation precedence (SWIM's dead-state dominance): a
+# FAILED/LEFT claim at incarnation k beats ALIVE at k — only the
+# member ITSELF refutes, by re-asserting ALIVE at k+1 (_merge's
+# self-refutation branch). Without this ordering a detector's FAILED
+# marking would be erased by any peer still holding ALIVE at the same
+# incarnation, and failure information could never spread.
+_STATUS_RANK = {ALIVE: 0, FAILED: 1, LEFT: 2}
+
+
+def _outranks(a: str, b: str) -> bool:
+    return _STATUS_RANK.get(a, 0) > _STATUS_RANK.get(b, 0)
 
 # Gossip events (serf.go: serfEventHandler switch).
 EVENT_JOIN = "member-join"
@@ -138,16 +155,38 @@ class Serf:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
+                    # Bounded reads: the digest exchange has a second
+                    # inbound frame, and an initiator dying mid-exchange
+                    # must not pin this handler thread in recv forever.
+                    self.request.settimeout(CONNECT_TIMEOUT * 5)
                     msg = _recv_frame(self.request)
                     if msg is None:
                         return
                     if msg.get("kind") == "push_pull":
+                        # Legacy full-table exchange (kept for mixed
+                        # versions during a rolling upgrade).
                         remote = [Member.from_wire(m) for m in msg["members"]]
                         serf._merge(remote)
                         _send_frame(
                             self.request,
                             {"members": [m.to_wire() for m in serf.members()]},
                         )
+                    elif msg.get("kind") == "push_pull_digest":
+                        # Digest anti-entropy: the initiator sent only
+                        # {name: [incarnation, status]}; full records
+                        # cross the wire ONLY where the digests
+                        # disagree — O(changes), not O(members²) state
+                        # per round at steady gossip.
+                        digest = msg.get("digest") or {}
+                        updates, want = serf._diff_digest(digest)
+                        _send_frame(self.request, {
+                            "updates": [m.to_wire() for m in updates],
+                            "want": want,
+                        })
+                        reply = _recv_frame(self.request)
+                        if reply and reply.get("updates"):
+                            serf._merge([Member.from_wire(m)
+                                         for m in reply["updates"]])
                 except (OSError, ValueError):
                     pass
 
@@ -259,7 +298,69 @@ class Serf:
                 if n >= self.suspicion_probes:
                     self._mark_failed(target.name)
 
+    def _digest(self) -> Dict[str, list]:
+        with self._lock:
+            return {m.name: [m.incarnation, m.status]
+                    for m in self._members.values()}
+
+    def _diff_digest(self, digest: Dict[str, list]):
+        """(records newer here than the digest, names newer there).
+        "Newer" follows incarnation first, then the equal-incarnation
+        status precedence (_outranks): failure detection is a status
+        edge at the victim's current incarnation, and it must both
+        propagate outward and never be pulled back by a stale ALIVE."""
+        updates: List[Member] = []
+        want: List[str] = []
+        with self._lock:
+            for m in self._members.values():
+                ent = digest.get(m.name)
+                if (ent is None or m.incarnation > int(ent[0])
+                        or (m.incarnation == int(ent[0])
+                            and _outranks(m.status, ent[1]))):
+                    updates.append(m)
+            for name, ent in digest.items():
+                cur = self._members.get(name)
+                if (cur is None or int(ent[0]) > cur.incarnation
+                        or (int(ent[0]) == cur.incarnation
+                            and _outranks(ent[1], cur.status))):
+                    want.append(name)
+        return updates, want
+
     def _push_pull(self, addr: str) -> bool:
+        """Digest-based anti-entropy round (memberlist pushPull with a
+        digest instead of the full state): exchange {name:
+        incarnation/status} summaries, ship full member records only
+        for the rows the summaries disagree on."""
+        try:
+            host, port_s = addr.rsplit(":", 1)
+            with socket.create_connection(
+                (host, int(port_s)), timeout=CONNECT_TIMEOUT
+            ) as sock:
+                sock.settimeout(CONNECT_TIMEOUT)
+                _send_frame(sock, {"kind": "push_pull_digest",
+                                   "digest": self._digest()})
+                resp = _recv_frame(sock)
+                if resp is None:
+                    # A pre-digest peer drops unknown kinds: fall back
+                    # to the legacy full-table exchange rather than
+                    # counting a healthy old-version server as a probe
+                    # failure (which would mark the whole un-upgraded
+                    # pool FAILED during a rolling upgrade).
+                    return self._push_pull_full(addr)
+                if resp.get("updates"):
+                    self._merge([Member.from_wire(m)
+                                 for m in resp["updates"]])
+                wanted = resp.get("want") or []
+                with self._lock:
+                    send = [self._members[n].to_wire()
+                            for n in wanted if n in self._members]
+                _send_frame(sock, {"updates": send})
+                return True
+        except (OSError, ValueError):
+            return False
+
+    def _push_pull_full(self, addr: str) -> bool:
+        """Legacy full-table exchange (pre-digest wire protocol)."""
         try:
             host, port_s = addr.rsplit(":", 1)
             with socket.create_connection(
@@ -272,7 +373,8 @@ class Serf:
                 resp = _recv_frame(sock)
                 if resp is None:
                     return False
-                self._merge([Member.from_wire(m) for m in resp.get("members", [])])
+                self._merge([Member.from_wire(m)
+                             for m in resp.get("members", [])])
                 return True
         except (OSError, ValueError):
             return False
@@ -298,7 +400,8 @@ class Serf:
                     continue
                 if rm.incarnation < cur.incarnation:
                     continue
-                if rm.incarnation == cur.incarnation and rm.status == cur.status:
+                if (rm.incarnation == cur.incarnation
+                        and not _outranks(rm.status, cur.status)):
                     continue
                 old_status = cur.status
                 cur.incarnation = rm.incarnation

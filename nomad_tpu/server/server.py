@@ -69,7 +69,7 @@ class Server:
         # workers' batches would hold its broker lease past the nack
         # clock and miss its batch's dispatch window.
         self.eval_pool = WorkPool(
-            max(2, min(64, self.config.num_schedulers
+            max(2, min(128, self.config.num_schedulers
                        * max(1, self.config.eval_batch_size - 1))),
             name="eval-batch")
         self._leader = False

@@ -48,7 +48,9 @@ def tpu_agent(tmp_path):
          "-port", str(HTTP_PORT), "-serf-port", str(SERF_PORT)],
         stdout=log, stderr=subprocess.STDOUT, env=env)
     try:
-        deadline = time.monotonic() + 30
+        # Generous: under full-suite load the spawned interpreter's jax
+        # import alone can take tens of seconds.
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             try:
                 nodes = get("/v1/nodes", timeout=2.0)

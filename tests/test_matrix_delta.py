@@ -244,3 +244,90 @@ def test_chained_deltas_stay_correct(cluster):
     oracle = _ClusterBase(
         m.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
     assert_bases_equal(m._cached_base(), oracle)
+
+
+def test_additive_delta_for_pure_creations(cluster):
+    """A placement storm is pure CREATIONS: even when they touch most
+    nodes (past the refill cap), the delta path must survive by
+    scatter-adding the new allocs' usage — the quadratic-storm fix —
+    and stay bit-identical to a fresh build."""
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    tok1 = m1.base_token
+
+    # New allocs on EVERY node (16 rows > the 16//4 refill cap, and
+    # far over it proportionally at scale).
+    fresh = [make_alloc(n, job, cpu=30 + i) for i, n in enumerate(nodes)]
+    index += 1
+    store.upsert_allocs(index, fresh)
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    base = m2._cached_base()
+    # Delta, not rebuild: the chain to the parent is recorded.
+    assert m2.base_token != tok1
+    assert base.delta_parent is not None and base.delta_parent[0] == tok1
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(base, oracle)
+
+
+def test_additive_delta_skips_created_then_terminal(cluster):
+    """An alloc created AND terminated since the base was built never
+    consumed capacity the base saw: it must contribute nothing."""
+    store, job, nodes, allocs, index = cluster
+    m1 = ClusterMatrix(store.snapshot(), job)
+    tok1 = m1.base_token
+
+    ghost = make_alloc(nodes[4], job, cpu=999)
+    ghost.client_status = consts.ALLOC_CLIENT_COMPLETE
+    live = make_alloc(nodes[9], job, cpu=40)
+    index += 1
+    store.upsert_allocs(index, [ghost, live])
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+    assert m2.base_token != tok1
+
+
+def test_mixed_creations_and_modifications(cluster):
+    """Creations on some nodes + a terminal transition on another in
+    ONE index step: the modified node refills, the created ones
+    scatter-add, and the result matches a fresh build."""
+    store, job, nodes, allocs, index = cluster
+    ClusterMatrix(store.snapshot(), job)
+
+    stopped = allocs[0]
+    stopped.desired_status = consts.ALLOC_DESIRED_STOP
+    stopped.client_status = consts.ALLOC_CLIENT_COMPLETE
+    fresh = [make_alloc(nodes[i], job, cpu=20) for i in (2, 5, 11)]
+    index += 1
+    store.upsert_allocs(index, [stopped] + fresh)
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)
+
+
+def test_addition_on_refilled_node_not_double_counted(cluster):
+    """A creation landing on the SAME node as a modification must ride
+    the refill (which already reads current allocs), not also
+    scatter-add — double-counting would inflate utilization and cause
+    phantom capacity exhaustion."""
+    store, job, nodes, allocs, index = cluster
+    ClusterMatrix(store.snapshot(), job)
+
+    target = nodes[6]
+    stopped = next(a for a in allocs if a.node_id == target.id)
+    stopped.desired_status = consts.ALLOC_DESIRED_STOP
+    stopped.client_status = consts.ALLOC_CLIENT_COMPLETE
+    fresh = make_alloc(target, job, cpu=70)
+    index += 1
+    store.upsert_allocs(index, [stopped, fresh])
+    snap = store.snapshot()
+    m2 = ClusterMatrix(snap, job)
+    oracle = _ClusterBase(
+        m2.nodes, lambda nid: snap.allocs_by_node_terminal(nid, False))
+    assert_bases_equal(m2._cached_base(), oracle)

@@ -194,3 +194,155 @@ class TestFederation:
             hb.stop()
             a.shutdown()
             b.shutdown()
+
+
+def test_digest_diff_semantics():
+    """_diff_digest: newer-here rows become updates, newer-there rows
+    become wants, and a status edge at EQUAL incarnation still
+    propagates (failure detection is a status edge)."""
+    from nomad_tpu.server.serf import ALIVE, FAILED, Member, Serf
+
+    s = Serf("a")
+    with s._lock:
+        s._members["b"] = Member(name="b", incarnation=3, status=ALIVE)
+        s._members["c"] = Member(name="c", incarnation=1, status=FAILED)
+    updates, want = s._diff_digest({
+        "a": [0, ALIVE],         # equal: not sent
+        "b": [2, ALIVE],         # we are newer: update
+        "c": [1, ALIVE],         # equal incarnation, status differs: update
+        "d": [5, ALIVE],         # unknown here: want
+    })
+    assert sorted(m.name for m in updates) == ["b", "c"]
+    assert want == ["d"]
+
+
+def test_digest_push_pull_converges_and_sends_no_steady_state_records():
+    """Two members converge through the digest protocol, and once
+    converged a sync round ships ZERO member records either way —
+    the O(members^2)-state-per-round concern the full-table exchange
+    had."""
+    from nomad_tpu.server.serf import Serf
+
+    a = Serf("a", probe_interval=999)  # no background gossip: drive by hand
+    b = Serf("b", probe_interval=999)
+    addr_a = a.serve("127.0.0.1", 0)
+    addr_b = b.serve("127.0.0.1", 0)
+    try:
+        a.set_tags({"role": "server"})
+        assert a._push_pull(addr_b)
+        # The responder merges the initiator's reply frame in its
+        # handler thread; poll for the propagation.
+        assert wait_until(
+            lambda: {m.name for m in b.members()} == {"a", "b"})
+        assert any(m.tags.get("role") == "server"
+                   for m in b.members() if m.name == "a")
+        assert b._push_pull(addr_a)
+        assert wait_until(
+            lambda: {m.name for m in a.members()} == {"a", "b"})
+
+        # Converged: a further round must carry no records.
+        updates_ab, want_ab = b._diff_digest(a._digest())
+        assert updates_ab == [] and want_ab == []
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_digest_semantics_update_test_follows_status_rank():
+    """Equal-incarnation rules: FAILED/LEFT outrank ALIVE in both
+    directions — our terminal row is an update against their ALIVE,
+    their terminal row is a want against our ALIVE — and an ALIVE row
+    never pulls back a terminal one."""
+    from nomad_tpu.server.serf import ALIVE, FAILED, LEFT, Member, Serf
+
+    s = Serf("a")
+    with s._lock:
+        s._members["x"] = Member(name="x", incarnation=2, status=FAILED)
+        s._members["y"] = Member(name="y", incarnation=1, status=ALIVE)
+        s._members["z"] = Member(name="z", incarnation=1, status=ALIVE)
+    updates, want = s._diff_digest({
+        "a": [0, ALIVE],
+        "x": [2, ALIVE],   # our FAILED outranks their ALIVE: update
+        "y": [1, LEFT],    # their LEFT outranks our ALIVE: want
+        "z": [1, ALIVE],   # identical: silence
+    })
+    assert sorted(m.name for m in updates) == ["x"]
+    assert want == ["y"]
+
+
+def test_failed_status_propagates_and_is_not_reverted():
+    """A detector's FAILED marking must spread through gossip and must
+    NOT be erased by a peer still holding ALIVE at the same
+    incarnation (the regression a naive equal-incarnation
+    last-writer-wins merge reintroduces)."""
+    from nomad_tpu.server.serf import FAILED, Serf
+
+    a = Serf("a", probe_interval=999)
+    b = Serf("b", probe_interval=999)
+    addr_a = a.serve("127.0.0.1", 0)
+    addr_b = b.serve("127.0.0.1", 0)
+    c = Serf("c", probe_interval=999)
+    addr_c = c.serve("127.0.0.1", 0)
+    try:
+        a.join([addr_b, addr_c])
+        # Spread C to B (the B sync during join ran before A knew C).
+        assert a._push_pull(addr_b)
+        assert wait_until(lambda: len(b.members()) == 3
+                          and len(c.members()) == 3)
+        c.shutdown()
+        a._mark_failed("c")
+        assert a.member_status("c") == FAILED if hasattr(a, "member_status") \
+            else [m for m in a.members() if m.name == "c"][0].status == FAILED
+
+        # A -> B: the FAILED edge crosses at c's unchanged incarnation.
+        assert a._push_pull(addr_b)
+        assert wait_until(lambda: [
+            m for m in b.members() if m.name == "c"][0].status == FAILED)
+        # B -> A with B's (now shared) view: A's marking survives.
+        assert b._push_pull(addr_a)
+        assert [m for m in a.members()
+                if m.name == "c"][0].status == FAILED
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_legacy_peer_fallback_full_table():
+    """A digest initiator talking to a pre-digest responder falls back
+    to the full-table exchange instead of counting the peer failed."""
+    import socketserver
+    import threading
+
+    from nomad_tpu.server import serf as serf_mod
+    from nomad_tpu.server.serf import Member, Serf, _recv_frame, _send_frame
+
+    state = {"members": []}
+
+    class LegacyHandler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                msg = _recv_frame(self.request)
+                if msg is None:
+                    return
+                if msg.get("kind") == "push_pull":
+                    state["members"] = msg["members"]
+                    _send_frame(self.request, {"members": [
+                        Member(name="legacy", addr="x").to_wire()]})
+                # unknown kinds: drop, like the old implementation
+            except OSError:
+                pass
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), LegacyHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = "%s:%d" % srv.server_address
+    s = Serf("new", probe_interval=999)
+    s.serve("127.0.0.1", 0)
+    try:
+        assert s._push_pull(addr) is True
+        assert any(m.name == "legacy" for m in s.members())
+        assert any(m["name"] == "new" for m in state["members"])
+    finally:
+        s.shutdown()
+        srv.shutdown()
+        srv.server_close()
