@@ -85,15 +85,18 @@ class HTTPServer:
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
                  client=None, enable_debug: bool = False,
-                 ssl_context=None):
+                 ssl_context=None, forward_ssl_context=None):
         self.server = server
         self.client = client
         self.logger = logging.getLogger("nomad_tpu.http")
         # TLS termination (agent tls block; reference EnableHTTP,
         # nomad/structs/config/tls.go). The handshake happens in the
         # per-connection handler thread (Handler.setup), never in the
-        # accept loop.
+        # accept loop. forward_ssl_context is the CLIENT side for
+        # cross-region proxying to https peers (verified against the
+        # cluster CA, not system CAs).
         self.ssl_context = ssl_context
+        self.forward_ssl_context = forward_ssl_context
         # Gates the /debug/* introspection routes (the reference gates
         # pprof the same way, command/agent/http.go:135 enableDebug).
         self.enable_debug = enable_debug
@@ -697,8 +700,12 @@ class HTTPServer:
         try:
             # Outlive the longest server-side blocking query
             # (MAX_BLOCKING_WAIT) so forwarded long-polls don't 500.
+            # With cluster TLS the peer's advertised address is
+            # https://; verify against the cluster CA, not system CAs.
             with urllib.request.urlopen(
-                freq, timeout=MAX_BLOCKING_WAIT + 10.0
+                freq, timeout=MAX_BLOCKING_WAIT + 10.0,
+                context=(self.forward_ssl_context
+                         if url.startswith("https://") else None),
             ) as resp:
                 # Pass the remote reply through verbatim — content type
                 # (fs endpoints return octet-streams) and the remote

@@ -666,6 +666,15 @@ def cmd_agent(args) -> int:
             server_cfg.vault_addr = cfg.vault.address
             server_cfg.vault_token = cfg.vault.token
         server = Server(server_cfg)
+        # TLS material for the server's own outbound/inbound channels:
+        # the follower->leader HTTP forwards and cross-region proxying
+        # must verify against the cluster CA, and gossip terminates
+        # the same mTLS as raft (its member records carry the
+        # addresses forwarding trusts).
+        server.tls_client_ctx = tls_client_ctx if tls_http_ctx else None
+        server.tls_rpc_server_ctx = tls_rpc_ctx
+        server.tls_rpc_client_ctx = (
+            tls_client_ctx if tls_rpc_ctx else None)
         # bootstrap_expect > 1: real raft consensus over TCP; the
         # cluster forms once enough servers gossip a raft address
         # (server.go bootstrap_expect). Otherwise single-server mode.
@@ -694,7 +703,9 @@ def cmd_agent(args) -> int:
             server.start()
         http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http,
                           enable_debug=cfg.enable_debug,
-                          ssl_context=tls_http_ctx)
+                          ssl_context=tls_http_ctx,
+                          forward_ssl_context=(
+                              tls_client_ctx if tls_http_ctx else None))
         http.start()
         server_addr = http.addr
         # Gossip peers and federated regions must receive a routable
@@ -784,7 +795,9 @@ def cmd_agent(args) -> int:
             http = HTTPServer(None, host=cfg.bind_addr,
                               port=cfg.ports.http,
                               enable_debug=cfg.enable_debug,
-                              ssl_context=tls_http_ctx)
+                              ssl_context=tls_http_ctx,
+                              forward_ssl_context=(
+                                  tls_client_ctx if tls_http_ctx else None))
             http.start()
         # The node must register with a routable HTTP endpoint: peer
         # clients GET /v1/client/allocation/<id>/snapshot from it for

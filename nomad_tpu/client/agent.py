@@ -504,7 +504,13 @@ class ClientAgent:
         # gigabytes of ephemeral disk.
         shutil.rmtree(tmp, ignore_errors=True)
         try:
-            with urllib.request.urlopen(url, timeout=60.0) as resp:
+            # Peer nodes advertise https under cluster TLS: verify
+            # against the cluster CA (config.ssl_context), never the
+            # system store.
+            ctx = (self.config.ssl_context
+                   if url.startswith("https://") else None)
+            with urllib.request.urlopen(url, timeout=60.0,
+                                        context=ctx) as resp:
                 AllocDir.restore_snapshot_stream(resp, tmp)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)

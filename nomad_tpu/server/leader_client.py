@@ -31,12 +31,17 @@ class RemoteLeader:
     a process-wide pool keyed by address could hand a NEW leader's
     client a socket opened to a previous process on a reused port."""
 
-    def __init__(self, addr: str, timeout: float = 10.0):
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 ssl_context=None):
         self.addr = addr.rstrip("/")
         self.timeout = timeout
         # The dequeue long-poll passes per-call timeouts above
         # self.timeout; size the pool's ceiling for those.
-        self._pool = HTTPPool(self.addr, timeout=120.0)
+        # ssl_context: the cluster client context when the HTTP API
+        # runs under TLS — without it every follower->leader forward
+        # would fail verification against the cluster CA.
+        self._pool = HTTPPool(self.addr, timeout=120.0,
+                              ssl_context=ssl_context)
 
     def _call(self, path: str, body: dict, timeout: Optional[float] = None):
         try:

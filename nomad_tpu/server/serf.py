@@ -131,9 +131,18 @@ class Serf:
         on_event: Optional[Callable[[str, Member], None]] = None,
         probe_interval: float = 1.0,
         suspicion_probes: int = 3,
+        ssl_server_ctx=None,
+        ssl_client_ctx=None,
     ):
         self.logger = logging.getLogger("nomad_tpu.serf")
         self.name = name
+        # mTLS (agent tls block): gossip carries the addresses leader
+        # and cross-region forwarding dial, so an unauthenticated
+        # gossip port would let any network peer inject member records
+        # and redirect the very traffic the other channels' TLS
+        # protects. Plaintext or wrong-CA peers fail the handshake.
+        self.ssl_server_ctx = ssl_server_ctx
+        self.ssl_client_ctx = ssl_client_ctx
         self.on_event = on_event
         self.probe_interval = probe_interval
         self.suspicion_probes = suspicion_probes
@@ -154,12 +163,19 @@ class Serf:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
                 try:
                     # Bounded reads: the digest exchange has a second
                     # inbound frame, and an initiator dying mid-exchange
                     # must not pin this handler thread in recv forever.
-                    self.request.settimeout(CONNECT_TIMEOUT * 5)
-                    msg = _recv_frame(self.request)
+                    # Armed before the TLS handshake so a silent
+                    # connect can't pin the thread either.
+                    sock.settimeout(CONNECT_TIMEOUT * 5)
+                    if serf.ssl_server_ctx is not None:
+                        sock = serf.ssl_server_ctx.wrap_socket(
+                            sock, server_side=True)
+                    self.request = sock
+                    msg = _recv_frame(sock)
                     if msg is None:
                         return
                     if msg.get("kind") == "push_pull":
@@ -326,16 +342,22 @@ class Serf:
                     want.append(name)
         return updates, want
 
+    def _connect(self, addr: str) -> socket.socket:
+        host, port_s = addr.rsplit(":", 1)
+        sock = socket.create_connection(
+            (host, int(port_s)), timeout=CONNECT_TIMEOUT)
+        if self.ssl_client_ctx is not None:
+            sock = self.ssl_client_ctx.wrap_socket(
+                sock, server_hostname=host)
+        return sock
+
     def _push_pull(self, addr: str) -> bool:
         """Digest-based anti-entropy round (memberlist pushPull with a
         digest instead of the full state): exchange {name:
         incarnation/status} summaries, ship full member records only
         for the rows the summaries disagree on."""
         try:
-            host, port_s = addr.rsplit(":", 1)
-            with socket.create_connection(
-                (host, int(port_s)), timeout=CONNECT_TIMEOUT
-            ) as sock:
+            with self._connect(addr) as sock:
                 sock.settimeout(CONNECT_TIMEOUT)
                 _send_frame(sock, {"kind": "push_pull_digest",
                                    "digest": self._digest()})
@@ -362,10 +384,7 @@ class Serf:
     def _push_pull_full(self, addr: str) -> bool:
         """Legacy full-table exchange (pre-digest wire protocol)."""
         try:
-            host, port_s = addr.rsplit(":", 1)
-            with socket.create_connection(
-                (host, int(port_s)), timeout=CONNECT_TIMEOUT
-            ) as sock:
+            with self._connect(addr) as sock:
                 sock.settimeout(CONNECT_TIMEOUT)
                 with self._lock:
                     local = [m.to_wire() for m in self._members.values()]

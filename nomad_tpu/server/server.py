@@ -314,7 +314,8 @@ class Server:
 
         cached = getattr(self, "_remote_leader_cache", None)
         if cached is None or cached.addr != addr.rstrip("/"):
-            cached = RemoteLeader(addr)
+            cached = RemoteLeader(
+                addr, ssl_context=getattr(self, "tls_client_ctx", None))
             self._remote_leader_cache = cached
         return cached
 
@@ -386,6 +387,10 @@ class Server:
                 "bootstrap_expect": str(self.config.bootstrap_expect),
             },
             on_event=on_event,
+            # Gossip rides the same mTLS material as raft: its member
+            # records carry the addresses forwarding trusts.
+            ssl_server_ctx=getattr(self, "tls_rpc_server_ctx", None),
+            ssl_client_ctx=getattr(self, "tls_rpc_client_ctx", None),
         )
         return self.serf.serve(host, port)
 

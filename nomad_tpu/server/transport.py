@@ -96,6 +96,7 @@ class TCPTransport(Transport):
         # sequential heartbeats/appends instead of a dial per message.
         self._pools: Dict[str, List[socket.socket]] = {}
         self._pool_lock = threading.Lock()
+        self._closed = False
         self.dials = 0  # sockets ever opened (observability/tests)
 
     # ------------------------------------------------------- serving
@@ -153,6 +154,7 @@ class TCPTransport(Transport):
             self._server.shutdown()
             self._server.server_close()
         with self._pool_lock:
+            self._closed = True
             pools, self._pools = self._pools, {}
         for conns in pools.values():
             for sock in conns:
@@ -223,10 +225,14 @@ class TCPTransport(Transport):
 
     def _checkin(self, peer: str, sock: socket.socket) -> None:
         with self._pool_lock:
-            conns = self._pools.setdefault(peer, [])
-            if len(conns) < self.MAX_IDLE_PER_PEER:
-                conns.append(sock)
-                return
+            # An RPC in flight during close() must not park its socket
+            # in a pool nobody will drain again (httppool.py's _closed
+            # discipline).
+            if not self._closed:
+                conns = self._pools.setdefault(peer, [])
+                if len(conns) < self.MAX_IDLE_PER_PEER:
+                    conns.append(sock)
+                    return
         try:
             sock.close()
         except OSError:

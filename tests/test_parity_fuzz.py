@@ -1,0 +1,191 @@
+"""Randomized CPU/TPU differential parity (seeded, deterministic):
+for a spread of generated clusters and jobs, the host iterator factory
+and the dense factory must place the same NUMBER of allocations,
+queue the same remainders, and produce plans that survive the plan
+applier's AllocsFit verification — the BASELINE acceptance invariant
+("identical plan-apply success rate"), swept over shapes no
+hand-written scenario covers."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import Constraint, allocs_fit, consts, new_eval, remove_allocs
+
+
+def build_scenario(seed):
+    """A (node-set builder, job) pair from one RNG seed. The node list
+    (and any pre-existing load) is built ONCE and shared by both
+    harnesses — the store copies on upsert — so the two paths see
+    byte-identical clusters."""
+    rng = random.Random(seed)
+    n_nodes = rng.choice([3, 5, 9, 17, 33])
+    dc_count = rng.choice([1, 2])
+    use_networks = rng.random() < 0.5
+    use_racks = rng.random() < 0.4
+    distinct = rng.random() < 0.3
+    preload = rng.random() < 0.4  # existing allocs consuming capacity
+    job_type = rng.choice(["service", "batch"])
+    count = rng.choice([1, 2, 5, 12, 40])
+    cpu = rng.choice([100, 333, 900])
+    mem = rng.choice([64, 300, 700])
+
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.datacenter = f"dc{i % dc_count + 1}"
+        if use_racks:
+            node.meta["rack"] = f"r{i % 4}"
+        # Heterogeneous capacity: some nodes half-size.
+        if i % 3 == 0:
+            node.resources.cpu //= 2
+            node.resources.memory_mb //= 2
+        node.compute_class()
+        nodes.append(node)
+    filler_allocs = []
+    if preload:
+        filler = mock.job()
+        filler.id = "filler"
+        for i, node in enumerate(nodes):
+            if i % 2:
+                continue
+            a = mock.alloc()
+            a.node_id, a.job_id, a.job = node.id, filler.id, filler
+            a.desired_status = consts.ALLOC_DESIRED_RUN
+            a.client_status = consts.ALLOC_CLIENT_RUNNING
+            for tr in a.task_resources.values():
+                tr.cpu = rng.choice([200, 700])
+                tr.memory_mb = rng.choice([128, 512])
+                tr.networks = []
+            a.resources = None
+            filler_allocs.append(a)
+
+    def seed_state(h):
+        for node in nodes:
+            h.state.upsert_node(h.next_index(), node)
+        if filler_allocs:
+            h.state.upsert_allocs(h.next_index(), filler_allocs)
+
+    job = mock.job()
+    job.type = job_type
+    job.datacenters = [f"dc{d + 1}" for d in range(dc_count)]
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.resources.cpu = cpu
+    task.resources.memory_mb = mem
+    if not use_networks:
+        task.resources.networks = []
+    if use_racks and rng.random() < 0.5:
+        job.constraints.append(Constraint(
+            ltarget="${meta.rack}", operand="regexp", rtarget="^r[01]$"))
+    if distinct:
+        job.constraints.append(Constraint(operand="distinct_hosts"))
+    return seed_state, job
+
+
+def verify_plan(h, snap_before):
+    """Every node's proposed alloc set must fit — what the plan
+    applier checks before commit (plan_apply.go evaluateNodePlan)."""
+    for plan in h.plans:
+        for node_id, placed in plan.node_allocation.items():
+            node = snap_before.node_by_id(node_id)
+            existing = snap_before.allocs_by_node_terminal(node_id, False)
+            updates = plan.node_update.get(node_id, [])
+            proposed = remove_allocs(existing, updates) + placed
+            for a in proposed:
+                if a.job is None:
+                    a.job = plan.job
+            fit, dim, _ = allocs_fit(node, proposed)
+            assert fit, f"plan failed verification on {node_id}: {dim}"
+
+
+@pytest.mark.parametrize("seed", range(300, 316))
+def test_randomized_system_parity_with_drains(seed):
+    """System jobs (pinned placement) under random drains and loads:
+    host vs dense must place identical counts on identical node sets
+    and both verify."""
+    rng = random.Random(seed)
+    n_nodes = rng.choice([4, 8, 16])
+    use_racks = rng.random() < 0.5
+    drain_frac = rng.choice([0.0, 0.25, 0.5])
+
+    job = mock.system_job()
+    job.task_groups[0].tasks[0].resources.networks = []
+    job.task_groups[0].tasks[0].resources.cpu = rng.choice([50, 400])
+    if use_racks:
+        job.constraints.append(Constraint(
+            ltarget="${meta.rack}", operand="=", rtarget="r0"))
+
+    # ONE node list shared by both harnesses (the store copies on
+    # upsert): pinned system placement compares node-id SETS, so the
+    # clusters must be identical down to the ids.
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        if use_racks:
+            node.meta["rack"] = f"r{i % 2}"
+        node.compute_class()
+        nodes.append(node)
+    drained = [n.id for n in nodes[: int(n_nodes * drain_frac)]]
+
+    h_cpu, h_tpu = Harness(seed=seed), Harness(seed=seed)
+    for h in (h_cpu, h_tpu):
+        for node in nodes:
+            h.state.upsert_node(h.next_index(), node)
+        h.state.upsert_job(h.next_index(), job.copy())
+        for nid in drained:
+            h.state.update_node_drain(h.next_index(), nid, True)
+
+    snap_cpu = h_cpu.state.snapshot()
+    snap_tpu = h_tpu.state.snapshot()
+    h_cpu.process("system", new_eval(
+        h_cpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_NODE_UPDATE))
+    h_tpu.process("system-tpu", new_eval(
+        h_tpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_NODE_UPDATE))
+
+    cpu_allocs = h_cpu.state.allocs_by_job(job.id)
+    tpu_allocs = h_tpu.state.allocs_by_job(job.id)
+    assert len(cpu_allocs) == len(tpu_allocs), f"seed {seed}"
+    # System placement is pinned: the NODE SETS must match exactly.
+    assert ({a.node_id for a in cpu_allocs}
+            == {a.node_id for a in tpu_allocs}), f"seed {seed}"
+    verify_plan(h_cpu, snap_cpu)
+    verify_plan(h_tpu, snap_tpu)
+
+
+@pytest.mark.parametrize("seed", range(60, 84))
+def test_randomized_cpu_tpu_parity(seed):
+    seed_state, job = build_scenario(seed)
+    host = job.type  # "service" or "batch"
+    dense = f"{job.type}-tpu"
+
+    h_cpu, h_tpu = Harness(seed=seed), Harness(seed=seed)
+    for h in (h_cpu, h_tpu):
+        seed_state(h)
+        h.state.upsert_job(h.next_index(), job.copy())
+    snap_cpu = h_cpu.state.snapshot()
+    snap_tpu = h_tpu.state.snapshot()
+
+    h_cpu.process(host, new_eval(
+        h_cpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    h_tpu.process(dense, new_eval(
+        h_tpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    cpu_allocs = h_cpu.state.allocs_by_job(job.id)
+    tpu_allocs = h_tpu.state.allocs_by_job(job.id)
+    assert len(cpu_allocs) == len(tpu_allocs), (
+        f"seed {seed}: cpu placed {len(cpu_allocs)}, "
+        f"tpu placed {len(tpu_allocs)}")
+    assert ({a.name for a in cpu_allocs}
+            == {a.name for a in tpu_allocs}), f"seed {seed}"
+    cpu_q = h_cpu.evals[0].queued_allocations
+    tpu_q = h_tpu.evals[0].queued_allocations
+    assert cpu_q == tpu_q, f"seed {seed}: queued {cpu_q} vs {tpu_q}"
+    # Same blocked-eval behavior for the remainder.
+    assert len(h_cpu.create_evals) == len(h_tpu.create_evals), f"seed {seed}"
+    # Both plans pass the applier's per-node verification.
+    verify_plan(h_cpu, snap_cpu)
+    verify_plan(h_tpu, snap_tpu)

@@ -234,6 +234,45 @@ def test_transport_pools_connections_under_heartbeat_storm(certs):
         server_t.close()
 
 
+def test_gossip_over_mtls_rejects_plaintext(certs):
+    """Gossip carries the addresses forwarding trusts, so it rides the
+    same mTLS as raft: members sync over TLS, a plaintext peer's
+    push-pull is refused at the handshake."""
+    from nomad_tpu.server.serf import Serf
+
+    def tls_serf(name):
+        return Serf(
+            name, probe_interval=999,
+            ssl_server_ctx=tlsutil.server_context(
+                str(certs / "ca.pem"), str(certs / "node.pem"),
+                str(certs / "node.key")),
+            ssl_client_ctx=tlsutil.client_context(
+                str(certs / "ca.pem"), str(certs / "node.pem"),
+                str(certs / "node.key")),
+        )
+
+    a, b = tls_serf("a"), tls_serf("b")
+    a.serve("127.0.0.1", 0)
+    addr_b = b.serve("127.0.0.1", 0)
+    plain = Serf("intruder", probe_interval=999)
+    plain.serve("127.0.0.1", 0)
+    try:
+        assert a._push_pull(addr_b)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if {m.name for m in b.members()} == {"a", "b"}:
+                break
+            time.sleep(0.05)
+        assert {m.name for m in b.members()} == {"a", "b"}
+        # A plaintext member cannot inject itself.
+        assert plain._push_pull(addr_b) is False
+        assert "intruder" not in {m.name for m in b.members()}
+    finally:
+        a.shutdown()
+        b.shutdown()
+        plain.shutdown()
+
+
 def test_agent_tls_block_plumbs_to_http(certs, tmp_path):
     """A spawned `agent` with a tls{} config block serves https and
     refuses plaintext — the operator-facing config path, not just the
