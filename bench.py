@@ -290,16 +290,21 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
 
     pool = ThreadPoolExecutor(max_workers=workers)
 
-    def run_round(base_seed):
-        futs = [pool.submit(one_eval, base_seed + i) for i in range(batch)]
+    def run_round(base_seed, n=None):
+        futs = [pool.submit(one_eval, base_seed + i)
+                for i in range(n if n is not None else batch)]
         return [f.result() for f in futs]
 
-    # Warm twice: the first round compiles the primary B bucket, the
-    # second catches the straggler-sized respawn shapes the first
-    # round's ragged accumulation produced (each distinct padded size
-    # is a compile, and through a remote tunnel that is seconds).
-    run_round(10_000)
-    run_round(15_000)
+    # Warm EVERY batch bucket the dispatcher can produce (plus the
+    # full size twice): ragged accumulation means a measured round can
+    # fragment into any of the ladder sizes, and one unwarmed shape is
+    # a multi-second trace+compile through a remote tunnel — enough to
+    # wreck a p99 on its own.
+    from nomad_tpu.scheduler.batcher import BATCH_BUCKETS
+
+    for i, warm_n in enumerate((batch, batch) + tuple(BATCH_BUCKETS) + (1,)):
+        if warm_n <= batch:
+            run_round(10_000 + i * 1000, n=warm_n)
     latencies = []
     placed_total = 0
     start = time.perf_counter()
@@ -379,7 +384,9 @@ def config_4():
     job = service_job(networks=True, distinct_hosts=True)
     job.datacenters = ["dc1", "dc2"]
     job.task_groups[0].count = 8
-    cpu_rate, cpu_p99 = bench_cpu(store, job, 8, evals=5)
+    # 20 CPU evals: at 5 the column was so short (~0.15 s) that host
+    # load swung the headline ratio ±40% run to run.
+    cpu_rate, cpu_p99 = bench_cpu(store, job, 8, evals=20)
     tpu_rate, tpu_p99 = bench_tpu(store, job, 8, batch=512, rounds=4)
     e2e_rate, e2e_p99 = bench_tpu_e2e(store, job, 8, batch=32, rounds=4)
     return "10k nodes, 50k allocs, ports + distinct_hosts", cpu_rate, \

@@ -671,7 +671,11 @@ def cmd_agent(args) -> int:
         # must verify against the cluster CA, and gossip terminates
         # the same mTLS as raft (its member records carry the
         # addresses forwarding trusts).
-        server.tls_client_ctx = tls_client_ctx if tls_http_ctx else None
+        # Outbound contexts are passed UNGATED: the dial sites apply
+        # them only to https:// targets, so a mixed rolling-TLS cluster
+        # (this agent still plaintext, the leader already https) keeps
+        # verifying peers against the cluster CA.
+        server.tls_client_ctx = tls_client_ctx
         server.tls_rpc_server_ctx = tls_rpc_ctx
         server.tls_rpc_client_ctx = (
             tls_client_ctx if tls_rpc_ctx else None)
@@ -704,8 +708,7 @@ def cmd_agent(args) -> int:
         http = HTTPServer(server, host=cfg.bind_addr, port=cfg.ports.http,
                           enable_debug=cfg.enable_debug,
                           ssl_context=tls_http_ctx,
-                          forward_ssl_context=(
-                              tls_client_ctx if tls_http_ctx else None))
+                          forward_ssl_context=tls_client_ctx)
         http.start()
         server_addr = http.addr
         # Gossip peers and federated regions must receive a routable
@@ -796,8 +799,7 @@ def cmd_agent(args) -> int:
                               port=cfg.ports.http,
                               enable_debug=cfg.enable_debug,
                               ssl_context=tls_http_ctx,
-                              forward_ssl_context=(
-                                  tls_client_ctx if tls_http_ctx else None))
+                              forward_ssl_context=tls_client_ctx)
             http.start()
         # The node must register with a routable HTTP endpoint: peer
         # clients GET /v1/client/allocation/<id>/snapshot from it for

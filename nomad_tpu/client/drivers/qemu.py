@@ -79,11 +79,24 @@ class QemuDriver(Driver):
                 "-m", f"{mem_mb}M",
                 "-drive", f"file={image}",
                 "-nographic"]
-        # User-net port forwards: guest port ← host port from the task's
-        # allocated dynamic ports (qemu.go:160-190 hostfwd construction).
+        # User-net port forwards (qemu.go:193-213): port_map entries are
+        # {network label: guest port}; the HOST side comes from the
+        # task's ALLOCATED port carrying that label, tcp and udp both —
+        # e.g. hostfwd=tcp::22000-:22 for a dynamic "ssh" port mapped
+        # to guest 22.
+        allocated = {}
+        for net in ctx.networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                allocated[p.label] = p.value
         forwards = []
-        for guest, host in (cfg.get("port_map") or {}).items():
-            forwards.append(f"hostfwd=tcp::{host}-:{guest}")
+        for label, guest in (cfg.get("port_map") or {}).items():
+            host = allocated.get(str(label))
+            if host is None:
+                raise ValueError(
+                    f"qemu port_map references unknown port label "
+                    f"{label!r} (allocated: {sorted(allocated)})")
+            for proto in ("tcp", "udp"):
+                forwards.append(f"hostfwd={proto}::{host}-:{int(guest)}")
         if forwards:
             argv += ["-netdev", "user,id=user.0," + ",".join(forwards),
                      "-device", "virtio-net,netdev=user.0"]

@@ -45,6 +45,13 @@ class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
         self.logger = logging.getLogger("nomad_tpu.server")
+        # Cluster TLS material (set_tls_contexts): None = plaintext.
+        # Declared here so every construction path has the attributes —
+        # a missing attribute would silently downgrade gossip and
+        # leader forwarding to plaintext.
+        self.tls_client_ctx = None  # outbound HTTP (leader/region/peers)
+        self.tls_rpc_server_ctx = None  # gossip + raft mTLS, server side
+        self.tls_rpc_client_ctx = None  # gossip + raft mTLS, client side
 
         self.fsm = FSM()
         self.log = DevLog(self.fsm)
@@ -314,8 +321,7 @@ class Server:
 
         cached = getattr(self, "_remote_leader_cache", None)
         if cached is None or cached.addr != addr.rstrip("/"):
-            cached = RemoteLeader(
-                addr, ssl_context=getattr(self, "tls_client_ctx", None))
+            cached = RemoteLeader(addr, ssl_context=self.tls_client_ctx)
             self._remote_leader_cache = cached
         return cached
 
@@ -389,8 +395,8 @@ class Server:
             on_event=on_event,
             # Gossip rides the same mTLS material as raft: its member
             # records carry the addresses forwarding trusts.
-            ssl_server_ctx=getattr(self, "tls_rpc_server_ctx", None),
-            ssl_client_ctx=getattr(self, "tls_rpc_client_ctx", None),
+            ssl_server_ctx=self.tls_rpc_server_ctx,
+            ssl_client_ctx=self.tls_rpc_client_ctx,
         )
         return self.serf.serve(host, port)
 

@@ -217,13 +217,19 @@ def test_qemu_fingerprint(qemu_stub):
 
 
 def test_qemu_start_builds_command(qemu_stub, tmp_path):
+    from nomad_tpu.structs import NetworkResource, Port
+
     ctx = make_ctx(tmp_path)
+    # port_map is {label: guest port}; the HOST side is the allocated
+    # port carrying that label (qemu.go:193-213).
+    ctx.networks = [NetworkResource(
+        dynamic_ports=[Port(label="ssh", value=22022)])]
     (tmp_path / "task" / "local" / "img.qcow2").write_bytes(b"\x00")
     task = Task(
         name="vm", driver="qemu",
         config={"image_path": "local/img.qcow2",
                 "accelerator": "tcg",
-                "port_map": {"22": 22022}},
+                "port_map": {"ssh": 22}},
         resources=Resources(cpu=1000, memory_mb=384),
     )
     task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
@@ -235,9 +241,20 @@ def test_qemu_start_builds_command(qemu_stub, tmp_path):
         assert "-m 384M" in line
         assert "accel=tcg" in line
         assert "hostfwd=tcp::22022-:22" in line
+        assert "hostfwd=udp::22022-:22" in line
         assert "img.qcow2" in line
     finally:
         handle.kill(1.0)
+
+    # An unknown label is a config error, not a silent no-forward.
+    bad = Task(
+        name="vm2", driver="qemu",
+        config={"image_path": "local/img.qcow2", "port_map": {"web": 80}},
+        resources=Resources(cpu=500, memory_mb=128),
+    )
+    bad.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+    with pytest.raises(ValueError, match="unknown port label"):
+        QemuDriver().start(ctx, bad)
 
 
 def test_qemu_missing_image_rejected():
