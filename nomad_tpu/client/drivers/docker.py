@@ -203,7 +203,10 @@ class DockerDriver(Driver):
                 return
         loads = cfg.get("load") or []
         if loads:
-            base = ctx.task_dir or "."
+            # Resolve against the task ROOT: that's where fetch_artifact
+            # delivers downloads, so `artifact { ... } + load = [...]`
+            # composes (resolving against local/ broke that pairing).
+            base = ctx.task_root or ctx.task_dir or "."
             for archive in loads:
                 path = os.path.join(base, str(archive))
                 proc = _run([docker, "load", "-i", path], timeout=300.0)

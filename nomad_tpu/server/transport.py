@@ -192,12 +192,18 @@ class TCPTransport(Transport):
 
     # -------------------------------------------------------- client
 
-    def _checkout(self, peer: str) -> Tuple[Optional[socket.socket], bool]:
-        """Returns (conn, pooled); dials when the idle pool is empty."""
-        with self._pool_lock:
-            conns = self._pools.get(peer)
-            if conns:
-                return conns.pop(), True
+    def _checkout(self, peer: str,
+                  use_pool: bool = True) -> Tuple[Optional[socket.socket], bool]:
+        """Returns (conn, pooled); dials when the idle pool is empty
+        (or when the caller demands a fresh socket — the keep-alive
+        retry must not pop ANOTHER stale pooled socket, or a restarted
+        peer with several pooled sockets looks dead until the pool
+        drains)."""
+        if use_pool:
+            with self._pool_lock:
+                conns = self._pools.get(peer)
+                if conns:
+                    return conns.pop(), True
         host, port_s = peer.rsplit(":", 1)
         try:
             sock = socket.create_connection(
@@ -240,7 +246,7 @@ class TCPTransport(Transport):
 
     def _call(self, peer: str, msg: dict, timeout: float = RPC_TIMEOUT) -> Optional[dict]:
         for attempt in (0, 1):
-            sock, pooled = self._checkout(peer)
+            sock, pooled = self._checkout(peer, use_pool=attempt == 0)
             if sock is None:
                 return None
             try:

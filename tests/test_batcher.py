@@ -156,3 +156,32 @@ def test_overflow_beyond_max_batch_all_served():
     assert len(results) == 8
     assert batcher.batched_requests == 8
     assert batcher.dispatches >= 3  # ceil(8/3)
+
+
+def test_pad_ladders():
+    """Shape-bucket ladders: every distinct padded size is an XLA
+    program, so the ladders must be coarse and deterministic."""
+    import numpy as np
+
+    from nomad_tpu.scheduler.batcher import (
+        BATCH_BUCKETS,
+        ROW_BUCKETS,
+        _pad_batch,
+        _pad_rows,
+    )
+
+    for n in range(1, 65):
+        b = _pad_batch(n, 64)
+        assert b >= n and (b in BATCH_BUCKETS or b == 64)
+    assert _pad_batch(3, 64) == 4
+    assert _pad_batch(17, 64) == 64
+    assert _pad_batch(100, 64) == 64  # capped at max_batch
+
+    rows = _pad_rows([7, 3, 9])
+    assert len(rows) == ROW_BUCKETS[0]
+    assert rows.dtype == np.int32
+    assert list(rows[:3]) == [7, 3, 9]
+    assert (rows[3:] == 7).all()  # padding repeats the FIRST row
+    assert len(_pad_rows(list(range(300)))) == ROW_BUCKETS[1]
+    # Beyond the ladder: fall back to pow2.
+    assert len(_pad_rows(list(range(5000)))) == 8192

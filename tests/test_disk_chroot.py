@@ -131,3 +131,31 @@ def test_chroot_exec_runs_in_populated_root(tmp_path):
     rootlist = open(os.path.join(task_dir, "local", "rootlist")).read()
     assert "local" in rootlist and "bin" in rootlist
     assert "hostroot-canary" not in rootlist
+
+def test_disk_used_counts_each_inode_once_and_prunes_embeds(tmp_path):
+    """Accounting rules: a task's OWN hardlinks are charged once (not
+    zero — that would let a task dodge the quota; not twice — that
+    would overcharge), and the embedded chroot manifest subtrees are
+    excluded entirely."""
+    from nomad_tpu.client.allocdir import AllocDir, embed_chroot
+
+    ad = AllocDir(str(tmp_path / "alloc1"))
+    ad.build(["t"])
+    data = os.path.join(ad.shared_dir, "data")
+
+    big = os.path.join(data, "big")
+    with open(big, "wb") as f:
+        f.write(b"\x00" * (2 * 1024 * 1024))
+    os.link(big, os.path.join(data, "big-link"))  # same inode
+    # 2MB charged once, not 0 and not 4MB.
+    used = ad.disk_used_mb()
+    assert 1.9 < used < 2.5, used
+
+    # Embed a host tree into the task chroot: its manifest prunes it.
+    src = tmp_path / "hosttree"
+    src.mkdir()
+    (src / "toolchain").write_bytes(b"\x00" * (3 * 1024 * 1024))
+    embed_chroot(ad.task_dirs["t"], {str(src): "opt/tools"})
+    used_after = ad.disk_used_mb()
+    assert used_after < used + 0.5, (
+        f"embedded toolchain charged against the quota: {used_after}")

@@ -134,7 +134,9 @@ def test_load_archives_instead_of_pull(tmp_path, fake_docker):
     h = DockerDriver().start(make_ctx(tmp_path), task)
     h.kill()
     loads = [c["argv"] for c in fake_docker() if c["argv"][0] == "load"]
-    assert loads and loads[0][2].endswith("local/redis.tar")
+    # Resolved against the task ROOT — where fetch_artifact delivers —
+    # not local/ (artifact + load must compose).
+    assert loads and loads[0][2].endswith("task/redis.tar")
     assert not any(c["argv"][0] == "pull" for c in fake_docker())
 
 

@@ -165,3 +165,27 @@ def test_follower_dequeue_many_forwards_to_leader():
     finally:
         http.stop()
         server.shutdown()
+
+
+def test_closed_pool_refuses_checkin():
+    """A request in flight when close() runs must not park its socket
+    into the closed pool's idle list (the SDK swaps pools on address
+    change mid-request)."""
+    import socket
+    import threading
+
+    from nomad_tpu.utils.httppool import HTTPPool
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    pool = HTTPPool(f"http://127.0.0.1:{port}")
+
+    conn, _pooled = pool._checkout(5.0)
+    conn.connect()
+    pool.close()
+    pool._checkin(conn)
+    assert pool._idle == []
+    assert conn.sock is None  # closed, not pooled
+    srv.close()

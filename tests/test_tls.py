@@ -389,3 +389,46 @@ def test_http_api_over_tls_and_secret_gate(certs):
     finally:
         http.stop()
         srv.shutdown()
+
+
+def test_transport_retry_dials_fresh_after_peer_restart(certs):
+    """A peer restart leaves MULTIPLE stale pooled sockets; the
+    keep-alive retry must dial fresh rather than pop a second stale
+    socket and report a healthy peer dead (costing election rounds)."""
+    from nomad_tpu.server.transport import TCPTransport, fsm_payload_decoder
+
+    server_t = _tls_transport(certs)
+    addr = server_t.serve("127.0.0.1", 0)
+
+    class Echo:
+        def handle_request_vote(self, args):
+            return {"ok": True}
+
+    server_t.register(Echo())
+    client_t = _tls_transport(certs)
+    try:
+        # Pool several sockets via concurrent RPCs.
+        threads = [threading.Thread(
+            target=client_t.request_vote, args=(addr, {"t": 1}))
+            for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(len(v) for v in client_t._pools.values()) >= 2
+
+        # "Restart" the peer: old sockets die, a new listener appears
+        # on the same port.
+        host, port = addr.rsplit(":", 1)
+        server_t.close()
+        server_t2 = _tls_transport(certs)
+        server_t2.register(Echo())
+        assert server_t2.serve(host, int(port)) == addr
+        try:
+            # First call after the restart: stale pooled socket fails,
+            # the retry dials fresh and succeeds.
+            assert client_t.request_vote(addr, {"t": 2}) == {"ok": True}
+        finally:
+            server_t2.close()
+    finally:
+        client_t.close()
