@@ -189,3 +189,87 @@ def test_randomized_cpu_tpu_parity(seed):
     # Both plans pass the applier's per-node verification.
     verify_plan(h_cpu, snap_cpu)
     verify_plan(h_tpu, snap_tpu)
+
+
+@pytest.mark.parametrize("seed", range(500, 512))
+def test_randomized_update_parity(seed):
+    """Second eval after a JOB UPDATE (count change, resource bump, or
+    constraint tightening): the host and dense factories must agree on
+    placement/stop/migrate counts — the reconciler paths (diff_allocs,
+    inplace vs destructive update) under the dense backend."""
+    rng = random.Random(seed)
+    n_nodes = rng.choice([5, 9, 17])
+    count0 = rng.choice([3, 6, 10])
+    mutation = rng.choice(["grow", "shrink", "resources", "constraint"])
+
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.meta["rack"] = f"r{i % 3}"
+        node.compute_class()
+        nodes.append(node)
+
+    job = mock.job()
+    job.type = "service"
+    tg = job.task_groups[0]
+    tg.count = count0
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = 150
+    tg.tasks[0].resources.memory_mb = 64
+
+    h_cpu, h_tpu = Harness(seed=seed), Harness(seed=seed)
+    for h in (h_cpu, h_tpu):
+        for node in nodes:
+            h.state.upsert_node(h.next_index(), node)
+        h.state.upsert_job(h.next_index(), job.copy())
+
+    h_cpu.process("service", new_eval(
+        h_cpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    h_tpu.process("service-tpu", new_eval(
+        h_tpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert (len(h_cpu.state.allocs_by_job(job.id))
+            == len(h_tpu.state.allocs_by_job(job.id))), f"seed {seed} initial"
+
+    updated = job.copy()
+    utg = updated.task_groups[0]
+    if mutation == "grow":
+        utg.count = count0 + rng.choice([2, 5])
+    elif mutation == "shrink":
+        utg.count = max(1, count0 - 2)
+    elif mutation == "resources":
+        utg.tasks[0].resources.cpu = 400  # destructive update
+    else:
+        updated.constraints.append(Constraint(
+            ltarget="${meta.rack}", operand="=", rtarget="r0"))
+    for h in (h_cpu, h_tpu):
+        h.state.upsert_job(h.next_index(), updated.copy())
+
+    h_cpu.process("service", new_eval(
+        h_cpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    h_tpu.process("service-tpu", new_eval(
+        h_tpu.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    def live(h):
+        return [a for a in h.state.allocs_by_job(job.id)
+                if a.desired_status == consts.ALLOC_DESIRED_RUN]
+
+    cpu_live, tpu_live = live(h_cpu), live(h_tpu)
+    assert len(cpu_live) == len(tpu_live), (
+        f"seed {seed} ({mutation}): cpu {len(cpu_live)} vs "
+        f"tpu {len(tpu_live)}")
+    if mutation != "constraint":
+        # Stops are shape-determined for grow/shrink/resources. For a
+        # tightened constraint they depend on WHERE the random initial
+        # placements landed, which legitimately differs per harness.
+        cpu_stopped = [a for a in h_cpu.state.allocs_by_job(job.id)
+                       if a.desired_status == consts.ALLOC_DESIRED_STOP]
+        tpu_stopped = [a for a in h_tpu.state.allocs_by_job(job.id)
+                       if a.desired_status == consts.ALLOC_DESIRED_STOP]
+        assert len(cpu_stopped) == len(tpu_stopped), \
+            f"seed {seed} ({mutation})"
+    if mutation == "constraint":
+        # Every surviving alloc satisfies the tightened constraint —
+        # on BOTH factories.
+        r0 = {n.id for n in nodes if n.meta["rack"] == "r0"}
+        assert all(a.node_id in r0 for a in tpu_live), f"seed {seed}"
+        assert all(a.node_id in r0 for a in cpu_live), f"seed {seed}"

@@ -6,6 +6,7 @@ server peers maps (nomad/serf.go, server.go:100-104), region listing
 (nomad/rpc.go:178,263).
 """
 
+import random
 import time
 
 import pytest
@@ -346,3 +347,72 @@ def test_legacy_peer_fallback_full_table():
         s.shutdown()
         srv.shutdown()
         srv.server_close()
+
+
+def test_randomized_gossip_convergence():
+    """Seeded fuzz: N members with random join order, tag updates, one
+    graceful leave, and one hard kill (detected by a random survivor,
+    spread as a FAILED edge), driven by explicit push-pull rounds in
+    random directions — every surviving member must converge to the
+    same view (names, statuses, incarnations) within a bounded number
+    of rounds. Protocol-level confidence for the digest path's
+    update/want symmetry and dead-state dominance."""
+    rng = random.Random(1234)
+    n = 6
+    members = [Serf(f"m{i}", probe_interval=999) for i in range(n)]
+    addrs = [m.serve("127.0.0.1", 0) for m in members]
+    alive = set(range(n))
+    try:
+        # Random joins: each member syncs with a few random peers.
+        for i in range(n):
+            for j in rng.sample([x for x in range(n) if x != i], 2):
+                members[i]._push_pull(addrs[j])
+        # Random activity: tag bumps, one graceful leave, one hard
+        # kill detected by a random survivor.
+        for _ in range(4):
+            members[rng.choice(sorted(alive))].set_tags(
+                {"v": str(rng.randint(1, 9))})
+        leaver = rng.choice(sorted(alive - {0}))
+        members[leaver].leave()
+        alive.discard(leaver)
+        victim = rng.choice(sorted(alive - {0}))
+        members[victim].shutdown()
+        alive.discard(victim)
+        detector = rng.choice(sorted(alive))
+        members[detector]._mark_failed(f"m{victim}")
+
+        # Anti-entropy rounds in random directions until converged.
+        # The responder merges the final updates frame in its handler
+        # thread AFTER _push_pull returns: give each round a short
+        # settle so the check doesn't race that merge.
+        def views():
+            out = {}
+            for i in sorted(alive):
+                out[i] = {(m.name, m.status, m.incarnation)
+                          for m in members[i].members()}
+            return out
+
+        for _round in range(120):
+            i = rng.choice(sorted(alive))
+            targets = [j for j in alive if j != i]
+            members[i]._push_pull(addrs[rng.choice(targets)])
+            time.sleep(0.02)
+            v = views()
+            if len({frozenset(x) for x in v.values()}) == 1:
+                converged = v
+                break
+        else:
+            raise AssertionError(f"never converged: {views()}")
+
+        # The leaver is LEFT everywhere, the killed member FAILED
+        # everywhere (dead-state dominance spread one detector's
+        # marking), everyone else ALIVE.
+        sample = next(iter(converged.values()))
+        statuses = {name: status for name, status, _inc in sample}
+        assert statuses[f"m{leaver}"] == LEFT
+        assert statuses[f"m{victim}"] == FAILED
+        for i in sorted(alive):
+            assert statuses[f"m{i}"] == ALIVE
+    finally:
+        for i in sorted(alive):
+            members[i].shutdown()
