@@ -379,7 +379,15 @@ def test_randomized_gossip_convergence():
         members[victim].shutdown()
         alive.discard(victim)
         detector = rng.choice(sorted(alive))
+        # The detector must already KNOW the victim (handler-thread
+        # merges lag _push_pull), and the marking must visibly take —
+        # a silent no-op here would surface 120 rounds later as an
+        # inscrutable convergence failure.
+        assert wait_until(lambda: any(
+            m.name == f"m{victim}" for m in members[detector].members()))
         members[detector]._mark_failed(f"m{victim}")
+        assert [m for m in members[detector].members()
+                if m.name == f"m{victim}"][0].status == FAILED
 
         # Anti-entropy rounds in random directions until converged.
         # The responder merges the final updates frame in its handler
