@@ -37,6 +37,12 @@ class ServerBlock:
     # latency-aware routing sends evals to the host pipeline.
     eval_batch_size: Optional[int] = None
     dense_min_batch: Optional[int] = None
+    # Central dispatch pipeline knobs (server/config.py dispatch_*):
+    # enable/disable, batches in flight, and the device-side in-batch
+    # conflict pre-resolution toggle.
+    dispatch_pipeline: Optional[bool] = None
+    dispatch_max_inflight: Optional[int] = None
+    dense_pre_resolve: Optional[bool] = None
 
 
 @dataclass
@@ -50,6 +56,10 @@ class ClientBlock:
     meta: Dict[str, str] = field(default_factory=dict)
     network_speed: int = 0
     reserved: Dict[str, Any] = field(default_factory=dict)
+    # Operator chroot embed map for the exec driver (reference
+    # client-config chroot_env); empty = built-in defaults. Job specs
+    # cannot set this — the driver rejects chroot_env in task config.
+    chroot_env: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -186,6 +196,8 @@ _SCHEMA: Dict[str, Any] = {
     "server.node_gc_threshold": str, "server.heartbeat_grace": str,
     "server.retry_join": _str_list, "server.start_join": _str_list,
     "server.eval_batch_size": int, "server.dense_min_batch": int,
+    "server.dispatch_pipeline": bool, "server.dispatch_max_inflight": int,
+    "server.dense_pre_resolve": bool,
     "client.enabled": bool, "client.state_dir": str,
     "client.alloc_dir": str, "client.node_class": str,
     "client.servers": _str_list, "client.network_speed": int,
@@ -199,7 +211,7 @@ _SCHEMA: Dict[str, Any] = {
     "tls.key_file": str, "tls.rpc": bool, "tls.http": bool,
 }
 _MAP_KEYS = {"client.options", "client.meta", "client.reserved",
-             "server.scheduler_factories"}
+             "client.chroot_env", "server.scheduler_factories"}
 _BLOCKS = {"ports", "server", "client", "telemetry", "consul", "vault",
            "tls"}
 

@@ -660,6 +660,13 @@ def cmd_agent(args) -> int:
             server_cfg.eval_batch_size = cfg.server.eval_batch_size
         if cfg.server.dense_min_batch is not None:
             server_cfg.dense_min_batch = cfg.server.dense_min_batch
+        if cfg.server.dispatch_pipeline is not None:
+            server_cfg.dispatch_pipeline = cfg.server.dispatch_pipeline
+        if cfg.server.dispatch_max_inflight is not None:
+            server_cfg.dispatch_max_inflight = (
+                cfg.server.dispatch_max_inflight)
+        if cfg.server.dense_pre_resolve is not None:
+            server_cfg.dense_pre_resolve = cfg.server.dense_pre_resolve
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
         if cfg.vault.address:
@@ -768,6 +775,7 @@ def cmd_agent(args) -> int:
             consul_service=cfg.consul.server_service_name,
             network_speed=cfg.client.network_speed,
             ssl_context=tls_client_ctx,
+            chroot_env=dict(cfg.client.chroot_env) or None,
         )
         if cfg.client.reserved:
             from ..structs import Resources

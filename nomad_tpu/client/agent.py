@@ -372,6 +372,7 @@ class ClientAgent:
             template_kv=self._template_kv,
             vault_client=self.vault_client,
             previous_alloc_dir=prev_dir,
+            chroot_env=self.config.chroot_env,
         )
         self.alloc_runners[alloc.id] = runner
         runner.run()

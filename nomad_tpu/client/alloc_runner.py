@@ -35,6 +35,7 @@ class AllocRunner:
         template_kv=None,
         vault_client=None,
         previous_alloc_dir=None,
+        chroot_env=None,
     ):
         self.alloc = alloc
         self.sync_cb = sync_cb
@@ -51,6 +52,9 @@ class AllocRunner:
         self.persist_cb = persist_cb
         self.template_kv = template_kv
         self.vault_client = vault_client
+        # Operator chroot embed map (ClientConfig.chroot_env); None =
+        # allocdir defaults. Never sourced from the job spec.
+        self.chroot_env = chroot_env
         # Sticky-disk handoff: a previous allocation's AllocDir whose
         # data dirs this alloc adopts before tasks start
         # (client.go:1585 addAlloc prevAllocDir).
@@ -89,6 +93,7 @@ class AllocRunner:
                 persist_cb=self.persist_cb,
                 template_kv=self.template_kv,
                 vault_client=self.vault_client,
+                chroot_env=self.chroot_env,
             )
             self.task_runners[task.name] = runner
             runner.start()

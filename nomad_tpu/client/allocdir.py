@@ -55,21 +55,34 @@ def _link_or_copy(src: str, dst: str) -> None:
             pass  # unreadable host file: leave a hole, not a failure
 
 
-EMBED_MANIFEST = ".nomad-embed.json"
+# Agent-owned record of embedded chroot subtrees, at the alloc-dir
+# ROOT — outside every task-writable tree (task filesystem views are
+# confined to the task dir / shared alloc dir). The disk watcher's
+# prune list loads from here, never from inside a task dir: a manifest
+# the task can write would let the workload exempt its own writes from
+# (or sabotage) the ephemeral-disk quota it is policed by.
+EMBEDS_STATE = ".nomad-embeds.json"
 
 
-def embed_chroot(root: str, sources: Optional[Dict[str, str]] = None) -> None:
+def embed_rels(sources: Optional[Dict[str, str]] = None) -> List[str]:
+    """Top-level destination dirs an embed of `sources` will populate —
+    derivable BEFORE any linking happens, so the disk-accounting prune
+    list can be recorded up front (an embed of /usr can run for
+    minutes; the disk watcher must not count the half-built toolchain
+    meanwhile)."""
+    return sorted({rel.lstrip("/").split("/", 1)[0]
+                   for rel in (sources or CHROOT_ENV).values()})
+
+
+def embed_chroot(root: str,
+                 sources: Optional[Dict[str, str]] = None) -> List[str]:
     """Populate `root` as a chroot by hardlinking host paths into it
     (alloc_dir.go:348 Embed). `sources` maps host path -> relative
     destination; missing host paths are skipped (not every distro has
-    /lib32). A manifest of the embedded destinations is written so the
-    disk watcher can exclude them from ephemeral-disk accounting."""
-    import json as _json
-
-    rels = sorted({rel.lstrip("/").split("/", 1)[0]
-                   for rel in (sources or CHROOT_ENV).values()})
-    with open(os.path.join(root, EMBED_MANIFEST), "w") as f:
-        _json.dump(rels, f)
+    /lib32). Returns embed_rels(sources), for callers that record the
+    prune list themselves — AllocDir.embed_chroot records it BEFORE
+    invoking this."""
+    rels = embed_rels(sources)
     for src, rel in (sources or CHROOT_ENV).items():
         if not os.path.exists(src):
             continue
@@ -94,6 +107,7 @@ def embed_chroot(root: str, sources: Optional[Dict[str, str]] = None) -> None:
         else:
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             _link_or_copy(src, dst)
+    return rels
 
 
 class AllocDir:
@@ -101,6 +115,50 @@ class AllocDir:
         self.root = root
         self.shared_dir = os.path.join(root, SHARED_ALLOC_NAME)
         self.task_dirs: Dict[str, str] = {}
+        # task name -> top-level dirs embedded into its chroot. Agent
+        # state, persisted at the alloc root (EMBEDS_STATE) so a client
+        # restart + reattach keeps pruning the hardlinked toolchain
+        # from disk accounting instead of falsely killing the alloc.
+        self._embedded: Dict[str, List[str]] = {}
+        self._load_embedded()
+
+    def _load_embedded(self) -> None:
+        import json as _json
+
+        try:
+            with open(os.path.join(self.root, EMBEDS_STATE)) as f:
+                data = _json.load(f)
+        except (OSError, ValueError):
+            return
+        if isinstance(data, dict):
+            self._embedded = {
+                str(task): sorted(str(rel) for rel in rels)
+                for task, rels in data.items()
+                if isinstance(rels, list)
+            }
+
+    def embed_chroot(self, task_name: str,
+                     sources: Optional[Dict[str, str]] = None) -> None:
+        """Embed the chroot toolchain into `task_name`'s dir, recording
+        the embedded subtrees in agent-owned state (the prune list
+        disk_used_mb consumes) BEFORE the embed starts — embedding a
+        host /usr can take minutes and the disk watcher polls
+        meanwhile; counting the half-built toolchain would falsely
+        kill the alloc. The record persists at the alloc root — never
+        inside the task-writable tree."""
+        import json as _json
+
+        task_dir = self.task_dirs.get(task_name) or os.path.join(
+            self.root, task_name)
+        merged = set(self._embedded.get(task_name, ()))
+        merged.update(embed_rels(sources))
+        self._embedded[task_name] = sorted(merged)
+        try:
+            with open(os.path.join(self.root, EMBEDS_STATE), "w") as f:
+                _json.dump(self._embedded, f)
+        except OSError:
+            pass  # accounting degrades; the embed still proceeds
+        embed_chroot(task_dir, sources)
 
     def build(self, task_names: List[str]) -> None:
         os.makedirs(self.shared_dir, exist_ok=True)
@@ -301,20 +359,20 @@ class AllocDir:
 
     def disk_used_mb(self) -> float:
         """Bytes the ALLOCATION is charged for: everything under the
-        alloc dir except the embedded chroot toolchain (embed_chroot's
-        manifest — those hardlinks consume no new disk and would blow
-        any sane quota), with each inode counted once so a task can't
-        dodge (or double-pay) the quota through its own hardlinks."""
-        import json as _json
-
+        alloc dir except the embedded chroot toolchain (those hardlinks
+        consume no new disk and would blow any sane quota), with each
+        inode counted once so a task can't dodge (or double-pay) the
+        quota through its own hardlinks. The prune list comes from
+        AGENT-OWNED state recorded when embed_chroot ran — never from
+        anything inside the task-writable tree, which the policed
+        workload could edit to exempt its writes or trigger a false
+        kill."""
         pruned = set()
-        for task_dir in self.task_dirs.values():
-            try:
-                with open(os.path.join(task_dir, EMBED_MANIFEST)) as f:
-                    for rel in _json.load(f):
-                        pruned.add(os.path.join(task_dir, rel))
-            except (OSError, ValueError):
-                pass
+        for task_name, rels in self._embedded.items():
+            task_dir = self.task_dirs.get(task_name) or os.path.join(
+                self.root, task_name)
+            for rel in rels:
+                pruned.add(os.path.join(task_dir, rel))
         total = 0
         seen = set()
         for dirpath, dirnames, files in os.walk(self.root):

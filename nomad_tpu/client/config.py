@@ -48,3 +48,10 @@ class ClientConfig:
     # published on the node so peers can pull sticky-disk snapshots
     # from it (client.go:1481 migrates via the old node's HTTPAddr).
     http_addr: str = ""
+    # Host path -> chroot-relative destination map embedded into exec
+    # chroots (None = allocdir.CHROOT_ENV defaults). An OPERATOR
+    # setting, like the reference's client-config chroot_env
+    # (client/config/config.go ChrootEnv): job submitters must not
+    # choose which host paths get hardlinked into their root — the
+    # exec driver rejects chroot_env in task config.
+    chroot_env: Optional[Dict[str, str]] = None

@@ -29,6 +29,16 @@ class TaskContext:
     # rebuild log plumbing on reattach honor the configured limits
     log_max_files: int = 10
     log_max_file_size_mb: int = 10
+    # Agent-config chroot embed map (ClientConfig.chroot_env; None =
+    # allocdir.CHROOT_ENV defaults). Operator-owned — never sourced
+    # from task config.
+    chroot_env: Optional[Dict[str, str]] = None
+    # Callback that embeds the chroot toolchain into this task's dir
+    # AND records the embedded subtrees in agent-owned AllocDir state
+    # (the disk watcher's prune list). Wired by TaskRunner; a bare
+    # context (tests) leaves it None and drivers fall back to the
+    # module-level embed without accounting.
+    embed_chroot: Optional[object] = None
 
 
 @dataclass

@@ -24,11 +24,21 @@ class ExecDriver(Driver):
         "command": Field("string", required=True),
         "args": Field("list"),
         "chroot": Field("bool"),
-        # host path -> chroot-relative destination overrides; defaults
-        # to allocdir.CHROOT_ENV (client config chroot_env).
-        "chroot_env": Field("map"),
     })
 
+    def validate_config(self, task: Task) -> None:
+        # chroot_env is an OPERATOR setting (ClientConfig.chroot_env,
+        # matching the reference's client-config placement): a job
+        # submitter choosing which host paths get hardlinked into the
+        # chroot (/etc/shadow, /root/.ssh, ...) would silently undo
+        # chroot as an isolation boundary. Checked BEFORE the generic
+        # schema pass so the rejection names the client-config home of
+        # the knob instead of a generic unknown-key error.
+        if (task.config or {}).get("chroot_env") is not None:
+            raise ValueError(
+                "exec config: 'chroot_env' is a client agent setting "
+                "(client config chroot_env), not task config")
+        super().validate_config(task)
 
     def fingerprint(self, node: Node) -> bool:
         if node.attributes.get("kernel.name", "linux") != "linux":
@@ -45,13 +55,20 @@ class ExecDriver(Driver):
         # Chroot only on explicit opt-in while running as root: embed
         # the host toolchain into the task dir (alloc_dir.go:348 Embed
         # + exec_linux.go:48) so the chrooted binary finds its loader
-        # and libraries, then ask the executor to chroot there.
+        # and libraries, then ask the executor to chroot there. The
+        # embed map comes from CLIENT config (ctx.chroot_env; None =
+        # allocdir defaults), and the embed registers its subtrees in
+        # agent-owned AllocDir state via ctx.embed_chroot so the disk
+        # watcher prunes them.
         chroot = None
         if (task.config or {}).get("chroot") and os.geteuid() == 0:
-            from ..allocdir import embed_chroot
-
             chroot = ctx.task_root or ctx.task_dir
-            embed_chroot(chroot, (task.config or {}).get("chroot_env"))
+            if ctx.embed_chroot is not None:
+                ctx.embed_chroot(ctx.chroot_env)
+            else:
+                from ..allocdir import embed_chroot
+
+                embed_chroot(chroot, ctx.chroot_env)
         return launch_executor(ctx, task, rlimit_as=mem_bytes, chroot=chroot)
 
     def open(self, ctx: TaskContext, handle_id: str) -> Optional[DriverHandle]:

@@ -42,6 +42,7 @@ class TaskRunner:
         persist_cb: Optional[Callable[[], None]] = None,
         template_kv: Optional[Callable[[str], Optional[str]]] = None,
         vault_client=None,
+        chroot_env=None,
     ):
         self.alloc = alloc
         self.task = task
@@ -72,6 +73,9 @@ class TaskRunner:
         # Vault token manager (client/vaultclient); None when the task
         # has no vault block or the client runs without vault.
         self.vault_client = vault_client
+        # Operator chroot embed map (ClientConfig.chroot_env via
+        # AllocRunner); rides the TaskContext into the exec driver.
+        self.chroot_env = chroot_env
         self._vault_token = ""
         self._kill = threading.Event()
         self._destroy_event: Optional[TaskEvent] = None
@@ -148,6 +152,9 @@ class TaskRunner:
                            if self.task.log_config else 10),
             log_max_file_size_mb=(self.task.log_config.max_file_size_mb
                                   if self.task.log_config else 10),
+            chroot_env=self.chroot_env,
+            embed_chroot=lambda sources=None: self.alloc_dir.embed_chroot(
+                self.task.name, sources),
         )
 
         try:

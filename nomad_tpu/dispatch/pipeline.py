@@ -1,0 +1,491 @@
+"""The central placement pipeline for dense-path evaluations.
+
+Three stages, pipelined the way the plan applier pipelines verify and
+commit (reference nomad/plan_apply.go:19-39), applied one layer up to
+device dispatch:
+
+- **central drain** — every worker that dequeues a dense-factory eval
+  hands it here instead of draining its own slice of the broker; the
+  dispatcher tops the accumulating batch up with ONE
+  broker.dequeue_many across everything ready, so a storm packs toward
+  MAX_BATCH lanes instead of fragmenting into per-worker groups
+  (measured r05: 9.4 of 64 lanes per dispatch).
+- **pipelined launch** — a closed batch is fanned out to the stage
+  pool and the dispatcher immediately resumes accumulating; up to
+  `dispatch_max_inflight` batches run concurrently, so the next
+  batch's evals build matrices and upload overlays WHILE the previous
+  batch's device sync and plan submits are still in flight. Plan
+  submission + ack runs on the stage/result threads, never on the
+  dispatcher.
+- **conflict requeue** — a plan the applier partially rejects
+  (RefreshIndex) does not replan alone on a fresh snapshot (a 1-3
+  alloc retry that pays a full round-trip, r05's retry tax); the eval
+  is folded back into the ACCUMULATING batch and replans with the next
+  full dispatch. In-batch collisions are already pre-resolved on
+  device (ops/binpack.py PlacementConfig.pre_resolve), so requeues are
+  the cross-batch residue only.
+
+The pipeline preserves the worker path's contracts: per-job broker
+serialization (a drained batch is always over distinct jobs), the
+latency-aware host routing for sub-`dense_min_batch` batches, eval
+ack/nack with the original broker token, and the nack-clock pause
+while a plan waits in the plan queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..scheduler import new_scheduler
+from ..server.worker import EvalSession
+from ..structs import Evaluation, Plan, PlanResult, consts
+from ..utils import metrics
+
+DEQUEUE_TOPUP_SLICE = 0.002  # cond-wait granularity while accumulating
+SLOT_WAIT_SLICE = 0.02  # cond-wait granularity while all slots busy
+WAIT_INDEX_TIMEOUT = 5.0
+
+
+class _RequeueConflict(Exception):
+    """Raised out of PipelineSession.submit_plan to abort the eval's
+    current scheduling attempt: the plan was (partially) rejected and
+    the eval should replan as part of the pipeline's accumulating
+    batch instead of alone on a fresh snapshot."""
+
+
+class _Pending:
+    __slots__ = ("eval", "token", "requeues", "enqueued_at", "min_index")
+
+    def __init__(self, ev: Evaluation, token: str, requeues: int = 0):
+        self.eval = ev
+        self.token = token
+        self.requeues = requeues
+        self.enqueued_at = time.monotonic()
+        # Lowest FSM index this entry may replan against: a conflict
+        # requeue records its plan's RefreshIndex here, so the relaunch
+        # snapshot provably includes the eval's OWN partial commit (a
+        # follower's FSM can lag the leader commit; replanning before
+        # it replicates would double-place the committed allocs).
+        self.min_index = 0
+
+
+class PipelineSession(EvalSession):
+    """Per-eval Planner for pipeline-processed evals. Inherits the
+    whole Planner contract (pause-nack framing, eval updates, reblock,
+    pre_resolve wiring) from server/worker.py EvalSession — one
+    implementation to keep in sync — and overrides only the
+    plan-conflict handling: refreshes raise _RequeueConflict (bounded,
+    side-effect-guarded) so the retry rides the ACCUMULATING batch
+    instead of replanning alone."""
+
+    def __init__(self, pipeline: "DispatchPipeline", entry: _Pending,
+                 announced: bool = False):
+        # EvalSession only needs `.server` and `._wait_for_index` from
+        # its worker — the pipeline provides both.
+        super().__init__(pipeline, entry.eval, entry.token)
+        self.pipeline = pipeline
+        self.entry = entry
+        # True while this eval is counted in the batcher's announced
+        # cohort (add_cohort); consumed at place() time or repaid on
+        # host fallback (scheduler/tpu.py) / eval completion
+        # (_repay_unconsumed).
+        self.announced_cohort = announced
+        # Evals created this attempt (blocked / rolling follow-ups):
+        # once any exist, aborting the attempt would re-create them on
+        # the requeued run — fall back to the inline retry instead.
+        self.created_evals = 0
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        start = time.monotonic()
+        plan.eval_token = self.token
+        try:
+            self.server.eval_pause_nack(self.eval.id, self.token)
+        except ValueError:
+            pass
+        try:
+            result = self.server.plan_submit(plan)
+        finally:
+            try:
+                self.server.eval_resume_nack(self.eval.id, self.token)
+            except ValueError:
+                pass
+        self.pipeline._note_submit(start)
+        if result.refresh_index:
+            self.pipeline._note_conflict()
+            if (self.created_evals == 0
+                    and self.entry.requeues < self.pipeline.max_requeues):
+                # Replan as part of the next packed batch — which must
+                # snapshot at or past this plan's partial commit.
+                self.entry.min_index = max(self.entry.min_index,
+                                           result.refresh_index)
+                raise _RequeueConflict()
+            # Bounded out (or side effects exist): classic inline
+            # retry — catch local state up, hand back a fresh snapshot.
+            self.pipeline._note_inline_retry()
+            self.pipeline._wait_for_index(
+                result.refresh_index, WAIT_INDEX_TIMEOUT)
+            return result, self.server.fsm.state.snapshot()
+        return result, None
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.created_evals += 1
+        super().create_eval(ev)
+
+
+class DispatchPipeline:
+    def __init__(self, server):
+        self.server = server
+        cfg = server.config
+        self.logger = logging.getLogger("nomad_tpu.dispatch")
+        self.max_batch = max(1, cfg.eval_batch_size)
+        self.max_inflight = max(1, cfg.dispatch_max_inflight)
+        self.window = cfg.dispatch_window
+        self.idle_grace = cfg.dispatch_idle_grace
+        self.max_requeues = cfg.dispatch_max_requeues
+        self.pre_resolve = cfg.dense_pre_resolve
+        # The eval types whose factories are dense — what the central
+        # drain pulls from the broker.
+        from ..server.worker import is_dense_factory
+
+        self.types: List[str] = [
+            t for t in cfg.enabled_schedulers
+            if is_dense_factory(cfg.factory_for(t))
+        ]
+        self.enabled = bool(
+            cfg.dispatch_pipeline and self.types and cfg.eval_batch_size > 1
+        )
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_Pending] = []
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # ---- stats (all mutated under self._lock) -------------------
+        self.evals_in = 0  # handed off / requeued into the accumulator
+        self.batches = 0  # batches launched
+        self.dispatched_evals = 0  # sum of launched batch sizes
+        self.largest_batch = 0
+        self.routed_host = 0  # evals sent to the host factory
+        self.acked = 0
+        self.nacked = 0
+        self.plan_conflicts = 0  # plans handed a RefreshIndex
+        self.requeues = 0  # conflict retries folded into the accumulator
+        self.requeues_batched = 0  # ...that launched alongside other evals
+        self.inline_retries = 0  # conflict retries run the classic way
+        self.t_drain = 0.0  # eval time spent in the accumulator
+        self.t_process = 0.0  # scheduler invoke (matrix+dispatch+plan)
+        self.t_submit = 0.0  # plan queue + applier + commit wait
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="dispatch-pipeline", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------ admission
+
+    def submit(self, ev: Evaluation, token: str) -> None:
+        """Hand a dequeued dense-path eval to the pipeline (worker
+        handoff, and the conflict-requeue re-entry)."""
+        self._admit(_Pending(ev, token))
+
+    def _admit(self, entry: _Pending) -> None:
+        entry.enqueued_at = time.monotonic()
+        with self._cond:
+            self._pending.append(entry)
+            self.evals_in += 1
+            self._cond.notify_all()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------ dispatcher
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._accumulate()
+            if batch:
+                self._launch(batch)
+
+    def _accumulate(self) -> List[_Pending]:
+        """Pack the next batch: wait for a seed eval, then top up with
+        one central broker drain per pass. Close rules: a FULL batch
+        closes immediately; an idle pipeline closes after `idle_grace`
+        (a lone interactive eval must not marinate); while batches are
+        in flight the accumulator keeps filling for `window` — the
+        in-flight round-trip is exactly the budget this wait amortizes
+        — and when every slot is busy it simply keeps accumulating
+        until one frees."""
+        with self._cond:
+            while not self._pending and not self._stop.is_set():
+                self._cond.wait(0.25)
+            if not self._pending:
+                return []
+        start = time.monotonic()
+        while not self._stop.is_set():
+            with self._lock:
+                room = self.max_batch - len(self._pending)
+            if room > 0:
+                # The central drain: everything ready across the
+                # broker, not one worker's slice.
+                got = self.server.eval_dequeue_many(self.types, room)
+                if got:
+                    now = time.monotonic()
+                    with self._cond:
+                        for ev, token in got:
+                            entry = _Pending(ev, token)
+                            entry.enqueued_at = now
+                            self._pending.append(entry)
+                            self.evals_in += 1
+            with self._cond:
+                elapsed = time.monotonic() - start
+                if len(self._pending) >= self.max_batch:
+                    break
+                if self._inflight == 0:
+                    if elapsed >= self.idle_grace:
+                        break
+                elif (self._inflight < self.max_inflight
+                      and elapsed >= self.window):
+                    break
+                self._cond.wait(DEQUEUE_TOPUP_SLICE)
+        # Wait for an in-flight slot; late arrivals keep joining the
+        # pending list while we wait (that IS the adaptive window).
+        with self._cond:
+            while (self._inflight >= self.max_inflight
+                   and not self._stop.is_set()):
+                self._cond.wait(SLOT_WAIT_SLICE)
+            batch = self._pending[: self.max_batch]
+            del self._pending[: len(batch)]
+            if not batch:
+                return []
+            self._inflight += 1
+            self.batches += 1
+            self.dispatched_evals += len(batch)
+            self.largest_batch = max(self.largest_batch, len(batch))
+            now = time.monotonic()
+            for entry in batch:
+                self.t_drain += now - entry.enqueued_at
+                if entry.requeues and len(batch) > 1:
+                    self.requeues_batched += 1
+        metrics.add_sample(("dispatch", "batch_size"), len(batch))
+        return batch
+
+    def _launch(self, batch: List[_Pending]) -> None:
+        cfg = self.server.config
+        # Latency-aware routing, centralized: a batch too small to
+        # amortize the device dispatch runs on the host factories with
+        # identical placement semantics (parity-tested).
+        route_host = len(batch) < cfg.dense_min_batch
+        if route_host:
+            with self._lock:
+                self.routed_host += len(batch)
+            metrics.incr_counter(("dispatch", "route_host"), len(batch))
+        # One MVCC snapshot for the whole batch: every member plans
+        # against the same cluster state so their ClusterMatrix bases
+        # share one token, one device upload, and (pre_resolve) one
+        # serialized claim scan. Same invariant as the worker drain
+        # path; optimistic concurrency keeps it safe.
+        max_index = max(max(e.eval.modify_index, e.min_index)
+                        for e in batch)
+        if not self._wait_for_index(max_index, WAIT_INDEX_TIMEOUT):
+            for entry in batch:
+                self._finish(entry, acked=False)
+            # _accumulate took an in-flight slot for this batch; a
+            # leaked slot here would wedge the accumulator once
+            # max_inflight aborted batches pile up.
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+            return
+        snapshot = self.server.fsm.state.snapshot()
+        if not route_host:
+            # Announce the fan-out to the batcher: its dispatch window
+            # then waits for this whole batch's place() calls (their
+            # matrix builds stagger under the GIL) instead of shipping
+            # fragmented, third-full device dispatches. System-dense
+            # evals are excluded — DenseSystemScheduler's vectorized
+            # pass never touches the batcher, so announcing them would
+            # only stretch the window (the hint self-heals either way,
+            # COHORT_WAIT_MAX). Generic dense evals that fall back to
+            # the host path repay their announcement in
+            # scheduler/tpu.py.
+            announce = sum(
+                1 for e in batch
+                if e.eval.type != consts.JOB_TYPE_SYSTEM)
+            if announce:
+                from ..scheduler.batcher import get_batcher
+
+                get_batcher().add_cohort(announce)
+        remaining = [len(batch)]
+        for entry in batch:
+            self.server.eval_pool.submit(
+                self._process_entry, entry, snapshot, route_host, remaining)
+
+    # ---------------------------------------------------------- stages
+
+    def _process_entry(self, entry: _Pending, snapshot, route_host: bool,
+                       remaining: List[int]) -> None:
+        ev, token = entry.eval, entry.token
+        start = time.monotonic()
+        session = PipelineSession(
+            self, entry,
+            announced=(not route_host
+                       and ev.type != consts.JOB_TYPE_SYSTEM))
+        try:
+            factory = self.server.config.factory_for(ev.type)
+            if route_host:
+                from ..server.worker import host_factory
+
+                factory = host_factory(factory)
+            # Independent PRNG per eval (see worker.py: correlated
+            # tie-break streams spike plan conflicts).
+            rng = random.Random(int.from_bytes(os.urandom(8), "little"))
+            sched = new_scheduler(
+                factory, self.logger, snapshot, session, rng=rng)
+            sched.process_eval(ev)
+        except _RequeueConflict:
+            with self._lock:
+                self.requeues += 1
+                self.t_process += time.monotonic() - start
+            metrics.incr_counter(("dispatch", "requeue"))
+            self._repay_unconsumed(session)
+            # Back into the ACCUMULATING batch; the broker token stays
+            # outstanding, so per-job serialization still holds.
+            entry.requeues += 1
+            self._release_slot(remaining)
+            self._admit(entry)
+            return
+        except Exception:
+            self.logger.exception("pipeline eval %s failed", ev.id)
+            with self._lock:
+                self.t_process += time.monotonic() - start
+            self._repay_unconsumed(session)
+            self._finish(entry, acked=False)
+            self._release_slot(remaining)
+            return
+        with self._lock:
+            self.t_process += time.monotonic() - start
+        self._repay_unconsumed(session)
+        self._finish(entry, acked=True)
+        self._release_slot(remaining)
+
+    def _repay_unconsumed(self, session: PipelineSession) -> None:
+        """Repay a cohort unit this eval announced but never consumed:
+        placement-less evals (job stop, scale-down, in-place-only
+        update) and failed schedulers never reach the batcher, and an
+        unrepaid announcement stretches every subsequent partial
+        dispatch toward COHORT_WAIT_MAX. The dense scheduler flips
+        announced_cohort off right before its place() call, so a
+        consumed announcement is never repaid twice."""
+        if session.announced_cohort:
+            session.announced_cohort = False
+            from ..scheduler.batcher import get_batcher
+
+            get_batcher().cohort_cancel(1)
+
+    def _finish(self, entry: _Pending, acked: bool) -> None:
+        try:
+            if acked:
+                self.server.eval_ack(entry.eval.id, entry.token)
+            else:
+                self.server.eval_nack(entry.eval.id, entry.token)
+        except ValueError:
+            pass  # nack timer fired concurrently
+        with self._lock:
+            if acked:
+                self.acked += 1
+            else:
+                self.nacked += 1
+
+    def _release_slot(self, remaining: List[int]) -> None:
+        with self._cond:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    # ------------------------------------------------------- plumbing
+
+    def _wait_for_index(self, index: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        backoff = 0.001
+        while self.server.fsm.state.latest_index() < index:
+            if self._stop.is_set() or time.monotonic() > deadline:
+                return False
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
+        return True
+
+    def _note_submit(self, start: float) -> None:
+        dt = time.monotonic() - start
+        with self._lock:
+            self.t_submit += dt
+        metrics.measure_since(("dispatch", "submit_plan"), start)
+
+    def _note_conflict(self) -> None:
+        with self._lock:
+            self.plan_conflicts += 1
+        metrics.incr_counter(("dispatch", "plan_conflict"))
+
+    def _note_inline_retry(self) -> None:
+        with self._lock:
+            self.inline_retries += 1
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            batches = self.batches
+            dispatched = self.dispatched_evals
+            done = self.acked + self.nacked
+            retries = self.requeues + self.inline_retries
+            return {
+                "enabled": self.enabled,
+                "max_batch": self.max_batch,
+                "batches": batches,
+                "dispatched_evals": dispatched,
+                # Lanes filled per launched batch (the r05 headline
+                # bottleneck: 9.4/64).
+                "occupancy": round(dispatched / batches, 2) if batches else 0.0,
+                "occupancy_frac": round(
+                    dispatched / (batches * self.max_batch), 4
+                ) if batches else 0.0,
+                "largest_batch": self.largest_batch,
+                "in_flight": self._inflight,
+                "pending": len(self._pending),
+                "evals_in": self.evals_in,
+                "acked": self.acked,
+                "nacked": self.nacked,
+                "routed_host": self.routed_host,
+                "plan_conflicts": self.plan_conflicts,
+                "requeues": self.requeues,
+                "requeues_batched": self.requeues_batched,
+                "inline_retries": self.inline_retries,
+                "retries_per_eval": round(retries / done, 4) if done else 0.0,
+                # Cumulative stage latencies (divide by the matching
+                # counters for per-unit): microseconds, like the
+                # batcher's breakdown.
+                "drain_us": int(self.t_drain * 1e6),
+                "process_us": int(self.t_process * 1e6),
+                "submit_us": int(self.t_submit * 1e6),
+            }

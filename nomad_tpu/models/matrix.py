@@ -395,6 +395,40 @@ def compute_class_index(nodes) -> Tuple[np.ndarray, List[int]]:
 _CLASS_INDEX_CACHE: Dict[Tuple, Tuple[np.ndarray, List[int]]] = {}
 _CLASS_INDEX_MAX = 4
 
+# Ready-node LIST cached per (snapshot nodes-index, dc set): the
+# central dispatch pipeline fans a full 64-eval batch out against one
+# snapshot, and each eval's ClusterMatrix would otherwise re-walk all
+# N node objects (ready_nodes_in_dcs is an O(N) python scan — 64 x 10k
+# attribute reads per batch, all under the GIL while the batcher's
+# accumulation window is ticking). Readiness depends only on the nodes
+# table, so the nodes index keys it exactly. Callers treat the cached
+# (nodes, by_dc) pair as immutable.
+_READY_NODES_CACHE: Dict[Tuple, Tuple[List[Node], Dict[str, int]]] = {}
+_READY_NODES_MAX = 4
+
+
+def ready_nodes_cached(state, datacenters):
+    """ready_nodes_in_dcs with a per-snapshot memo (see note above).
+    Falls through to the plain scan for stateless snapshots (tests,
+    shadow stores)."""
+    key = None
+    if hasattr(state, "index") and getattr(state, "store_id", ""):
+        key = (state.store_id, state.index("nodes"),
+               tuple(sorted(datacenters or [])))
+        with _BASE_CACHE_LOCK:
+            hit = _READY_NODES_CACHE.get(key)
+        if hit is not None:
+            return hit
+    from ..scheduler.util import ready_nodes_in_dcs
+
+    out = ready_nodes_in_dcs(state, datacenters)
+    if key is not None:
+        with _BASE_CACHE_LOCK:
+            while len(_READY_NODES_CACHE) >= _READY_NODES_MAX:
+                _READY_NODES_CACHE.pop(next(iter(_READY_NODES_CACHE)))
+            _READY_NODES_CACHE[key] = out
+    return out
+
 
 def ready_class_index(state, nodes, dcs) -> Tuple[np.ndarray, List[int]]:
     key = None
@@ -555,9 +589,7 @@ class ClusterMatrix:
         self.plan = plan
         self._explicit_nodes = nodes is not None
         if nodes is None:
-            from ..scheduler.util import ready_nodes_in_dcs
-
-            nodes, by_dc = ready_nodes_in_dcs(state, job.datacenters)
+            nodes, by_dc = ready_nodes_cached(state, job.datacenters)
             self.nodes_by_dc = by_dc
         else:
             self.nodes_by_dc = {}

@@ -47,6 +47,18 @@ class PlacementConfig(NamedTuple):
     """Static (compile-time) knobs."""
 
     anti_affinity_penalty: float  # 10 service / 5 batch (stack.go:14-18)
+    # In-batch conflict pre-resolution: serialize the EVAL axis of a
+    # shared-base batch on device (lax.scan instead of vmap) so eval
+    # i+1 plans against the capacity/bandwidth/ports that evals 0..i
+    # already claimed — the in-batch analog of the plan applier's
+    # serialization (plan_apply.go:194). Without it, B evals planning
+    # against one snapshot argmax toward the same headroom and the
+    # applier rejects the collisions, each rejection costing a full
+    # dispatch round-trip to replan. Per-JOB state (job_count/tg_count)
+    # stays per-eval — distinct jobs never share anti-affinity. Only
+    # the shared-base paths honor this; the mixed-base stacked path has
+    # no shared capacity to carry.
+    pre_resolve: bool = False
     # Per-eval tie-break noise, in FITNESS units. This is the dense
     # analog of the reference's shuffled power-of-two-choices
     # (stack.go:120-132 LimitIterator): concurrent evals planning
@@ -320,6 +332,34 @@ _OVERLAY_ASKS_AXES = Asks(
 )
 
 
+def _overlay_seq(state: NodeState, asks: Asks, keys,
+                 config: PlacementConfig):
+    """Pre-resolving variant of the overlay batch: a lax.scan over the
+    EVAL axis whose carry is the shared mutable cluster state (util,
+    bw_used, ports_free), so each eval's placements see every earlier
+    eval's claims — conflicts are resolved inside the dispatch instead
+    of by plan-applier rejection + replan round-trips. The per-job
+    overlay fields (job_count/tg_count/feasible) stay per-eval: they
+    describe the eval's OWN job. Batch-padding rows scan AFTER the real
+    rows, so their phantom claims never affect a real output."""
+
+    def body(carry, xs):
+        util, bw_used, ports_free = carry
+        (job_count, tg_count, feasible), a, k = xs
+        s = state._replace(
+            util=util, bw_used=bw_used, ports_free=ports_free,
+            job_count=job_count, tg_count=tg_count, feasible=feasible,
+        )
+        choices, scores, final = placement_program(s, a, k, config)
+        return ((final.util, final.bw_used, final.ports_free),
+                (choices, scores))
+
+    carry0 = (state.util, state.bw_used, state.ports_free)
+    xs = ((state.job_count, state.tg_count, state.feasible), asks, keys)
+    carry, (choices, scores) = jax.lax.scan(body, carry0, xs)
+    return choices, scores, carry
+
+
 @functools.partial(jax.jit, static_argnames=("config",))
 def batched_placement_program_overlay(
     state: NodeState, asks: Asks, keys, config: PlacementConfig
@@ -329,7 +369,13 @@ def batched_placement_program_overlay(
     snapshot, cached on device by the batcher), while job_count [B,N],
     tg_count/feasible [B,N,G], asks, and keys carry the batch axis.
     This is what makes live broker-drain batches cheap: per dispatch
-    only the small per-job overlays move host->device."""
+    only the small per-job overlays move host->device.
+
+    With config.pre_resolve the eval axis runs as a sequential scan
+    carrying claimed capacity (see _overlay_seq) instead of a vmap —
+    the in-batch analog of the plan applier's serialization."""
+    if config.pre_resolve:
+        return _overlay_seq(state, asks, keys, config)
     return jax.vmap(
         lambda s, a, k: placement_program(s, a, k, config),
         in_axes=(_OVERLAY_STATE_AXES, _OVERLAY_ASKS_AXES, 0),
@@ -378,6 +424,28 @@ def _compact_batch(capacity, sched_capacity, util, bw_avail, bw_used,
                    config):
     n = util.shape[0]
     g = overlays.verdicts.shape[-1]
+
+    if config.pre_resolve:
+        # Sequential eval axis carrying claimed capacity (the compact
+        # twin of _overlay_seq); overlays still expand on device.
+        def body(carry, xs):
+            u, bw, pf = carry
+            ov, a, k = xs
+            feasible, job_count, tg_count = _expand_overlay(
+                class_ids, ov, n, g)
+            s = NodeState(
+                capacity=capacity, sched_capacity=sched_capacity, util=u,
+                bw_avail=bw_avail, bw_used=bw, ports_free=pf,
+                job_count=job_count, tg_count=tg_count, feasible=feasible,
+                node_ok=node_ok,
+            )
+            choices, scores, final = placement_program(s, a, k, config)
+            return ((final.util, final.bw_used, final.ports_free),
+                    (choices, scores))
+
+        carry, (choices, scores) = jax.lax.scan(
+            body, (util, bw_used, ports_free), (overlays, asks, keys))
+        return choices, scores, carry
 
     def one(ov, a, k):
         feasible, job_count, tg_count = _expand_overlay(class_ids, ov, n, g)

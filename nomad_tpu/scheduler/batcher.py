@@ -65,6 +65,13 @@ DEVICE_BASE_CACHE = 8
 # tunnel) behind the next batch's accumulation. XLA serializes the
 # programs on-device; overlap buys transfer/queueing concurrency.
 MAX_INFLIGHT = 3
+# Hard ceiling on cohort-extended accumulation (add_cohort): the
+# window stretches while ANNOUNCED requests are still on their way —
+# their matrix builds are GIL-serialized host work the RTT-driven
+# window cannot see — but an announced eval that never places (host
+# fallback, no-op plan) must not wedge the dispatcher. On expiry the
+# outstanding count zeroes (the hint lied; self-heal).
+COHORT_WAIT_MAX = 1.0
 
 
 class _Request:
@@ -161,6 +168,8 @@ class PlacementBatcher:
         self.base_delta_updates = 0  # bases derived on-device from a parent
         self.overlay_dispatches = 0  # dispatches via the shared-base path
         self.compact_dispatches = 0  # overlays expanded on device
+        self.pre_resolve_dispatches = 0  # eval axis serialized on device
+        # (PlacementConfig.pre_resolve: in-batch conflict pre-resolution)
         # Per-dispatch cost breakdown (seconds/bytes, cumulative): the
         # judge-facing proof of where a storm's wall-clock goes —
         # host-side stacking, host->device payload size, dispatch
@@ -174,6 +183,38 @@ class PlacementBatcher:
         self.bytes_upload = 0.0  # base upload payload
         # EMA of the dispatch round-trip, drives the adaptive window.
         self._sync_ema = 0.0
+        # Requests ANNOUNCED but not yet arrived (add_cohort): the
+        # central dispatch pipeline fans a known batch out and tells
+        # the batcher how many place() calls are coming, so dispatch
+        # accumulation waits for the stragglers instead of shipping
+        # 1/3-full lanes (measured r05: 9.4/64). _cohort_gen bumps on
+        # every cohort mutation: an expiring dispatcher only zeroes a
+        # cohort that has been completely INERT through its whole wait
+        # — zeroing an active counter would clobber a fresh batch's
+        # announcement and re-fragment its dispatch.
+        self._cohort = 0
+        self._cohort_gen = 0
+
+    def add_cohort(self, n: int) -> None:
+        """Announce that `n` place() calls are on their way (the
+        dispatch pipeline calls this as it fans a batch out). Dispatch
+        accumulation extends past its RTT-driven window while announced
+        requests are outstanding — bounded by COHORT_WAIT_MAX."""
+        if n <= 0:
+            return
+        with self._full:
+            self._cohort += n
+            self._cohort_gen += 1
+            self._full.notify_all()
+
+    def cohort_cancel(self, n: int = 1) -> None:
+        """Repay an announced place() that will never arrive (an
+        announced eval fell back to the host path). Floor at zero: a
+        double repayment only un-stretches the window, never wedges."""
+        with self._full:
+            self._cohort = max(0, self._cohort - n)
+            self._cohort_gen += 1
+            self._full.notify_all()
 
     def place(self, state, asks, rng_key, config):
         """Submit one eval's placement; blocks until its batch's device
@@ -219,6 +260,9 @@ class PlacementBatcher:
                        compact=compact)
         run_dispatch = False
         with self._lock:
+            if self._cohort > 0:
+                self._cohort -= 1
+                self._cohort_gen += 1
             q = self._queues.setdefault(shape_key, [])
             q.append(req)
             if len(q) >= self.max_batch:
@@ -537,6 +581,9 @@ class PlacementBatcher:
             # GIL switch.
             self.compact_dispatches += compact_dispatch
             self.overlay_dispatches += overlay_dispatch
+            self.pre_resolve_dispatches += (
+                overlay_dispatch and bool(getattr(config, "pre_resolve",
+                                                  False)))
             sync = t3 - t2
             self._sync_ema = (sync if self._sync_ema == 0.0
                               else 0.7 * self._sync_ema + 0.3 * sync)
@@ -550,16 +597,41 @@ class PlacementBatcher:
         queued nothing more can join this dispatch, and through a
         remote tunnel the window is a large fraction of the round-trip
         itself. Sleeps on a condition place() signals at max_batch —
-        no lock-polling on the scheduler hot path."""
+        no lock-polling on the scheduler hot path.
+
+        A live cohort (add_cohort: announced requests still on their
+        way, typically mid-matrix-build under the GIL) extends the
+        wait past the RTT-driven window, bounded by COHORT_WAIT_MAX —
+        shipping a third-full dispatch while the rest of the batch is
+        provably coming wastes a full round-trip per fragment."""
         import time as _time
 
-        deadline = _time.monotonic() + window
+        start = _time.monotonic()
+        deadline = start + window
+        hard = start + COHORT_WAIT_MAX
+        gen_seen = None  # cohort generation when we began extending
         with self._full:
             while len(self._queues.get(shape_key, ())) < self.max_batch:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    return
-                self._full.wait(remaining)
+                now = _time.monotonic()
+                if now >= deadline:
+                    if self._cohort <= 0:
+                        return
+                    if gen_seen is None:
+                        gen_seen = self._cohort_gen
+                    if now >= hard:
+                        # This dispatcher waited the cap out. Zero the
+                        # hint only if it was INERT the whole time — an
+                        # active counter belongs to some other batch
+                        # whose announcements arrived/changed during
+                        # our wait, and clobbering it would re-fragment
+                        # that batch's dispatch.
+                        if self._cohort_gen == gen_seen:
+                            self._cohort = 0
+                            self._cohort_gen += 1
+                        return
+                    self._full.wait(min(0.002, hard - now))
+                    continue
+                self._full.wait(deadline - now)
 
     def _spawn_dispatcher(self, shape_key, config) -> None:
         threading.Thread(
@@ -663,6 +735,7 @@ class PlacementBatcher:
             "base_delta_updates": self.base_delta_updates,
             "overlay_dispatches": self.overlay_dispatches,
             "compact_dispatches": self.compact_dispatches,
+            "pre_resolve_dispatches": self.pre_resolve_dispatches,
             "sharded_bases": self.sharded_bases,
             # Cost breakdown (cumulative; divide by `dispatches` for
             # per-dispatch): microseconds so the config-6 delta print

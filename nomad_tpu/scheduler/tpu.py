@@ -123,6 +123,7 @@ class BatchedTPUScheduler(GenericScheduler):
                 remaining.append(missing)
         bulk = remaining
         if not bulk:
+            self._repay_cohort()
             return
         if len(bulk) <= 3:
             # Too few placements to amortize a dispatch — typical for
@@ -131,6 +132,7 @@ class BatchedTPUScheduler(GenericScheduler):
             # would also pay a new matrix + base token). The host
             # iterators place a handful in low-ms with identical
             # semantics.
+            self._repay_cohort()
             super()._compute_placements(bulk)
             return
 
@@ -144,12 +146,26 @@ class BatchedTPUScheduler(GenericScheduler):
             if self.batch
             else SERVICE_JOB_ANTI_AFFINITY_PENALTY
         )
-        config = PlacementConfig(anti_affinity_penalty=penalty)
+        # In-batch conflict pre-resolution rides the Planner (worker /
+        # dispatch-pipeline sessions set it from server config): batch
+        # members of one shared-snapshot dispatch then see each other's
+        # capacity claims on device instead of colliding at the plan
+        # applier. Harness/test planners without the attr stay on the
+        # independent (vmapped) path.
+        config = PlacementConfig(
+            anti_affinity_penalty=penalty,
+            pre_resolve=bool(getattr(self.planner, "pre_resolve", False)),
+        )
         # Host-side key: a device PRNGKey here would cost a tunnel
         # round-trip per eval and force the batcher to pull keys back
         # for stacking.
         key = host_prng_key(self.rng.getrandbits(31))
 
+        # The announced place() call is about to arrive: mark the
+        # cohort unit consumed so the pipeline doesn't also repay it
+        # (place() itself decrements the batcher's counter).
+        if getattr(self.planner, "announced_cohort", False):
+            self.planner.announced_cohort = False
         # The drain-to-batch shim (BASELINE north star): concurrent
         # workers' same-shaped placement programs coalesce into one
         # vmapped device dispatch instead of N serial calls, and evals
@@ -193,6 +209,18 @@ class BatchedTPUScheduler(GenericScheduler):
 
             self.plan.append_alloc(_build_allocation(
                 self, missing, node, task_resources, metrics))
+
+    def _repay_cohort(self) -> None:
+        """Un-announce this eval's place() call: the dispatch pipeline
+        told the batcher a dispatch was coming (add_cohort), but this
+        eval took a host path instead — without the repayment the
+        batcher's window would stretch COHORT_WAIT_MAX for a request
+        that never arrives."""
+        if getattr(self.planner, "announced_cohort", False):
+            from .batcher import get_batcher
+
+            self.planner.announced_cohort = False
+            get_batcher().cohort_cancel(1)
 
     # ------------------------------------------------------------------
 

@@ -51,6 +51,34 @@ class ServerConfig:
     # test invariant), millisecond latency. 1 forces dense always.
     dense_min_batch: int = 2
 
+    # Central dispatch pipeline (nomad_tpu/dispatch): dense-path evals
+    # from EVERY worker flow into one leader-side accumulator that
+    # packs full device batches, launches them pipelined (next batch
+    # accumulates during the in-flight device sync + plan submits),
+    # and requeues plan-conflict retries into the ACCUMULATING batch.
+    # False reverts to the per-worker drain-then-place loop.
+    dispatch_pipeline: bool = True
+    # Batches allowed in flight at once: overlap hides the device
+    # round-trip + plan-submit tail behind the next accumulation.
+    dispatch_max_inflight: int = 2
+    # Accumulation window while another batch is in flight (its
+    # round-trip is the budget being amortized); the idle grace is all
+    # a batch waits when nothing is in flight — a lone interactive
+    # eval pays only this before routing to the host path.
+    dispatch_window: float = 0.05
+    dispatch_idle_grace: float = 0.004
+    # Conflict-rejected evals rejoin the accumulating batch at most
+    # this many times before falling back to the scheduler's own
+    # inline retry loop (bounded like MAX_SERVICE_SCHEDULE_ATTEMPTS).
+    dispatch_max_requeues: int = 3
+
+    # In-batch conflict pre-resolution: serialize the eval axis of a
+    # shared-base device dispatch so batch members see each other's
+    # capacity claims (ops/binpack.py PlacementConfig.pre_resolve) —
+    # cuts plan-applier rejections, each of which costs a replan +
+    # dispatch round-trip. False = independent (vmapped) evals.
+    dense_pre_resolve: bool = True
+
     # Telemetry gauge emission period (command.go:570 setupTelemetry)
     telemetry_interval: float = 10.0
     statsd_addr: str = ""

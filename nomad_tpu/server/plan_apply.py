@@ -113,6 +113,12 @@ class PlanApplier:
         self._thread: Optional[threading.Thread] = None
         self._lifecycle = threading.Lock()  # start/stop can race on
         # leadership flaps (raft elections)
+        # Conflict observability (feeds the dispatch pipeline's
+        # retries-per-eval accounting and the bench's A/B column):
+        # counters only ever touched on the applier thread.
+        self.plans_evaluated = 0
+        self.plans_rejected = 0  # plans that lost >= 1 node (refresh)
+        self.nodes_rejected = 0  # node verifications that failed
 
     def start(self) -> None:
         with self._lifecycle:
@@ -231,20 +237,39 @@ class PlanApplier:
             node_id: self.pool.submit(evaluate_node_plan, snapshot, plan, node_id)
             for node_id in node_ids
         }
+        self.plans_evaluated += 1
+        rejected = 0
         for node_id, fut in futures.items():
             if fut.result():
                 continue
             # This node's changes don't fit anymore.
+            rejected += 1
+            metrics.incr_counter(("plan", "node_rejected"))
             if plan.all_at_once:
                 # Gang commit: reject everything, force a refresh.
                 result.node_update = {}
                 result.node_allocation = {}
                 result.refresh_index = snapshot.latest_index()
+                self.plans_rejected += 1
+                self.nodes_rejected += rejected
                 return result
             result.node_update.pop(node_id, None)
             result.node_allocation.pop(node_id, None)
             result.refresh_index = snapshot.latest_index()
+        if rejected:
+            self.plans_rejected += 1
+            self.nodes_rejected += rejected
         return result
+
+    def stats(self) -> dict:
+        """Conflict counters: how often optimistic plans lost node
+        verifications (each rejection is a replan round-trip somewhere
+        upstream — the dispatch pipeline's A/B measures these)."""
+        return {
+            "plans_evaluated": self.plans_evaluated,
+            "plans_rejected": self.plans_rejected,
+            "nodes_rejected": self.nodes_rejected,
+        }
 
     def _commit(self, plan: Plan, result: PlanResult) -> int:
         start = time.monotonic()

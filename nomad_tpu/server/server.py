@@ -76,9 +76,22 @@ class Server:
         # workers' batches would hold its broker lease past the nack
         # clock and miss its batch's dispatch window.
         self.eval_pool = WorkPool(
-            max(2, min(128, self.config.num_schedulers
-                       * max(1, self.config.eval_batch_size - 1))),
+            max(2, min(192, max(
+                self.config.num_schedulers
+                * max(1, self.config.eval_batch_size - 1),
+                # The dispatch pipeline fans a full batch out per
+                # in-flight slot; a pool smaller than that would strand
+                # batch members behind their own batch's dispatch.
+                self.config.eval_batch_size
+                * max(1, self.config.dispatch_max_inflight)))),
             name="eval-batch")
+        # Central dispatch pipeline for dense-path evals (dispatch/):
+        # workers hand dense evals here; the pipeline drains the rest
+        # of the broker centrally, packs full device batches, and
+        # folds plan-conflict retries back into the accumulating batch.
+        from ..dispatch import DispatchPipeline
+
+        self.dispatch = DispatchPipeline(self)
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -157,6 +170,7 @@ class Server:
             worker = Worker(self, i)
             self.workers.append(worker)
             worker.start()
+        self.dispatch.start()
         self.establish_leadership()
         self._start_telemetry()
 
@@ -172,6 +186,21 @@ class Server:
         def emit():
             while not self._telemetry_stop.wait(self.config.telemetry_interval):
                 try:
+                    # Dispatch-pipeline gauges are per-server (the
+                    # pipeline runs on followers too, forwarding plans
+                    # to the leader), so they emit before the
+                    # leader-only gate below.
+                    if self.dispatch.enabled:
+                        d = self.dispatch.stats()
+                        metrics.set_gauge(
+                            ("dispatch", "occupancy"), d["occupancy"])
+                        metrics.set_gauge(
+                            ("dispatch", "retries_per_eval"),
+                            d["retries_per_eval"])
+                        metrics.set_gauge(
+                            ("dispatch", "in_flight"), d["in_flight"])
+                        metrics.set_gauge(
+                            ("dispatch", "pending"), d["pending"])
                     if not self._leader:
                         # Broker/plan-queue/heartbeats are leader-only
                         # (eval_broker.go:650 runs in the leader loop);
@@ -233,6 +262,7 @@ class Server:
             worker = Worker(self, i)
             self.workers.append(worker)
             worker.start()
+        self.dispatch.start()
         self.raft.start()
         threading.Thread(target=self._membership_reconcile_loop,
                          name="raft-membership-sweep", daemon=True).start()
@@ -348,6 +378,7 @@ class Server:
             self.serf.shutdown()
         if self.raft is not None:
             self.raft.stop()
+        self.dispatch.stop()
         for w in self.workers:
             w.stop()
         if self.vault is not None and hasattr(self.vault, "stop"):
@@ -1145,6 +1176,8 @@ class Server:
             "plan_queue_depth": self.plan_queue.depth(),
             "heartbeat_timers": self.heartbeats.count(),
             "num_workers": len(self.workers),
+            "dispatch_pipeline": self.dispatch.stats(),
+            "plan_applier": self.plan_applier.stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

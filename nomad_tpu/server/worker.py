@@ -54,6 +54,10 @@ class EvalSession:
         self.server = worker.server
         self.eval = ev
         self.token = token
+        # The dense kernel's in-batch conflict pre-resolution flag
+        # (scheduler/tpu.py reads it off its Planner): worker-drained
+        # batches share a snapshot exactly like pipeline batches do.
+        self.pre_resolve = worker.server.config.dense_pre_resolve
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         start = time.monotonic()
@@ -147,6 +151,19 @@ class Worker:
             group = [(ev, token)]
             factory = self.server.config.factory_for(ev.type)
             batch_max = self.server.config.eval_batch_size
+            pipeline = getattr(self.server, "dispatch", None)
+            if (pipeline is not None and pipeline.enabled
+                    and is_dense_factory(factory)):
+                # Central dispatch pipeline (nomad_tpu/dispatch): hand
+                # the eval to the leader-side accumulator instead of
+                # draining a per-worker slice — ONE drain packs full
+                # batches across all workers, submits run pipelined,
+                # and conflict retries rejoin the accumulating batch.
+                # This worker immediately returns to the broker for
+                # more (host-path evals keep flowing meanwhile).
+                pipeline.submit(ev, token)
+                metrics.incr_counter(("worker", "pipeline_handoff"))
+                continue
             if batch_max > 1 and is_dense_factory(factory):
                 # Drain-to-batch: siblings of the same type ride one
                 # device dispatch. Non-blocking — whatever is ready now.

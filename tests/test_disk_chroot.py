@@ -98,6 +98,9 @@ def test_embed_chroot_links_files_and_symlinks(tmp_path):
     assert not (chroot / "x").exists()
 
 
+@pytest.mark.slow  # embeds the entire host toolchain (/usr, /lib, ...)
+# by hardlink-or-copy: on overlayfs containers the copy fallback alone
+# runs for minutes — a real-chroot integration test, not a unit test.
 @pytest.mark.skipif(os.geteuid() != 0, reason="chroot requires root")
 def test_chroot_exec_runs_in_populated_root(tmp_path):
     """A chrooted exec task runs /bin/sh from the EMBEDDED toolchain
@@ -135,9 +138,9 @@ def test_chroot_exec_runs_in_populated_root(tmp_path):
 def test_disk_used_counts_each_inode_once_and_prunes_embeds(tmp_path):
     """Accounting rules: a task's OWN hardlinks are charged once (not
     zero — that would let a task dodge the quota; not twice — that
-    would overcharge), and the embedded chroot manifest subtrees are
-    excluded entirely."""
-    from nomad_tpu.client.allocdir import AllocDir, embed_chroot
+    would overcharge), and the embedded chroot subtrees recorded in
+    AGENT-owned state are excluded entirely."""
+    from nomad_tpu.client.allocdir import AllocDir
 
     ad = AllocDir(str(tmp_path / "alloc1"))
     ad.build(["t"])
@@ -151,11 +154,79 @@ def test_disk_used_counts_each_inode_once_and_prunes_embeds(tmp_path):
     used = ad.disk_used_mb()
     assert 1.9 < used < 2.5, used
 
-    # Embed a host tree into the task chroot: its manifest prunes it.
+    # Embed a host tree into the task chroot through the AllocDir API:
+    # the agent-recorded subtree prunes from accounting.
     src = tmp_path / "hosttree"
     src.mkdir()
     (src / "toolchain").write_bytes(b"\x00" * (3 * 1024 * 1024))
-    embed_chroot(ad.task_dirs["t"], {str(src): "opt/tools"})
+    ad.embed_chroot("t", {str(src): "opt/tools"})
     used_after = ad.disk_used_mb()
     assert used_after < used + 0.5, (
         f"embedded toolchain charged against the quota: {used_after}")
+
+    # The prune record persists at the alloc ROOT (outside every
+    # task-writable tree) and survives a client restart: a fresh
+    # AllocDir over the same tree keeps pruning.
+    ad2 = AllocDir(ad.root)
+    ad2.task_dirs = dict(ad.task_dirs)
+    assert ad2.disk_used_mb() < used + 0.5
+
+
+def test_embed_records_prune_before_linking(tmp_path, monkeypatch):
+    """The prune list must be registered BEFORE the embed starts: a
+    host-toolchain embed can run for minutes and the disk watcher polls
+    meanwhile — counting the half-built toolchain would falsely kill
+    the alloc."""
+    from nomad_tpu.client import allocdir as ad_mod
+    from nomad_tpu.client.allocdir import AllocDir
+
+    ad = AllocDir(str(tmp_path / "alloc1"))
+    ad.build(["t"])
+    seen = {}
+
+    def fake_embed(root, sources=None):
+        # At embed time the agent state must already prune the target.
+        seen["recorded"] = list(ad._embedded.get("t", ()))
+        return ad_mod.embed_rels(sources)
+
+    monkeypatch.setattr(ad_mod, "embed_chroot", fake_embed)
+    ad.embed_chroot("t", {"/bin": "opt/tools"})
+    assert seen["recorded"] == ["opt"], seen
+
+
+def test_exec_driver_rejects_task_config_chroot_env():
+    """chroot_env is an operator (client config) setting; the exec
+    driver must reject it in task config with a message that names the
+    right home for the knob."""
+    from nomad_tpu import mock
+    from nomad_tpu.client.drivers.base import new_driver
+
+    task = mock.job().task_groups[0].tasks[0]
+    task.driver = "exec"
+    task.config = {"command": "/bin/true",
+                   "chroot_env": {"/etc/shadow": "etc/shadow"}}
+    with pytest.raises(ValueError, match="client agent setting"):
+        new_driver("exec").validate_config(task)
+
+
+def test_disk_used_ignores_task_written_manifest(tmp_path):
+    """ADVICE r5 (medium): the disk watcher must not trust ANY file the
+    task can write. A task forging an embed manifest inside its own dir
+    (the pre-fix mechanism) gets charged anyway — only the agent's own
+    embed_chroot registration prunes."""
+    import json
+
+    from nomad_tpu.client.allocdir import AllocDir
+
+    ad = AllocDir(str(tmp_path / "alloc1"))
+    ad.build(["t"])
+    hog_dir = os.path.join(ad.task_dirs["t"], "local", "cache")
+    os.makedirs(hog_dir)
+    with open(os.path.join(hog_dir, "hog"), "wb") as f:
+        f.write(b"\x00" * (4 * 1024 * 1024))
+    # The task tries to exempt its writes the way the old manifest
+    # reader would have allowed.
+    with open(os.path.join(ad.task_dirs["t"], ".nomad-embed.json"),
+              "w") as f:
+        json.dump(["local"], f)
+    assert ad.disk_used_mb() > 3.5, "task-forged manifest dodged the quota"

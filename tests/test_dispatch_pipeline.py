@@ -1,0 +1,423 @@
+"""Central dispatch pipeline (nomad_tpu/dispatch): occupancy under a
+multi-worker drain storm, device-side in-batch conflict pre-resolution
+parity vs serial placement, conflict requeues landing in the
+ACCUMULATING batch, and the stats surface through the agent metrics
+endpoint."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+
+
+def wait_until(fn, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def seed_nodes(server, n=8, cpu=None, mem=None):
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        if cpu is not None:
+            node.resources.cpu = cpu
+        if mem is not None:
+            node.resources.memory_mb = mem
+        node.compute_class()
+        server.node_register(node)
+        nodes.append(node)
+    return nodes
+
+
+def make_server(**over):
+    defaults = dict(
+        num_schedulers=4,
+        scheduler_factories={"service": "service-tpu"},
+        eval_batch_size=16,
+        eval_nack_timeout=60.0,
+    )
+    defaults.update(over)
+    server = Server(ServerConfig(**defaults))
+    server.start()
+    return server
+
+
+def quiesce(server):
+    """Pause every worker and wait out any in-flight blocking dequeue
+    (DEQUEUE_TIMEOUT) so a storm registered next stays in the broker
+    until release."""
+    from nomad_tpu.server.worker import DEQUEUE_TIMEOUT
+
+    for w in server.workers:
+        w.set_pause(True)
+    time.sleep(DEQUEUE_TIMEOUT + 0.3)
+
+
+# ---------------------------------------------------------------------
+# occupancy: the storm regime the pipeline exists for
+
+
+def test_storm_packs_toward_full_batches():
+    """A multi-worker drain storm must coalesce into FEW, FULL batches:
+    the central drain packs every ready eval across all workers into
+    one accumulator instead of per-worker fragments (r05: 9.4/64
+    lanes)."""
+    server = make_server()
+    try:
+        seed_nodes(server, 8)
+        quiesce(server)
+        jobs = []
+        for _ in range(16):
+            job = mock.job()
+            job.task_groups[0].count = 5  # >3 so the dense path engages
+            job.task_groups[0].tasks[0].resources.cpu = 20
+            job.task_groups[0].tasks[0].resources.memory_mb = 16
+            server.job_register(job)
+            jobs.append(job)
+        assert wait_until(lambda: server.broker.ready_count() >= 16, 10.0)
+        for w in server.workers:
+            w.set_pause(False)
+        assert wait_until(
+            lambda: all(
+                len(server.fsm.state.allocs_by_job(j.id)) == 5
+                for j in jobs),
+            timeout=120.0)
+        stats = server.dispatch.stats()
+        assert stats["dispatched_evals"] == 16
+        assert stats["acked"] == 16
+        # The whole storm was ready at release: it must ride a handful
+        # of packed batches, not 16 fragments.
+        assert stats["largest_batch"] >= 12, stats
+        assert stats["occupancy"] >= 8.0, stats
+        assert stats["batches"] <= 4, stats
+    finally:
+        server.shutdown()
+
+
+def test_lone_eval_routes_host_and_pipeline_counts_it():
+    """Latency-aware routing moved into the pipeline: a lone eval on an
+    idle accumulator runs the host path (no device traffic) and is
+    counted in routed_host."""
+    from nomad_tpu.scheduler.batcher import get_batcher
+
+    server = make_server(num_schedulers=1)
+    try:
+        seed_nodes(server, 8)
+        before = get_batcher().batched_requests
+        job = mock.job()
+        job.task_groups[0].count = 4
+        server.job_register(job)
+        assert wait_until(
+            lambda: len(server.fsm.state.allocs_by_job(job.id)) == 4)
+        assert get_batcher().batched_requests == before
+        stats = server.dispatch.stats()
+        assert stats["routed_host"] >= 1, stats
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# in-batch conflict pre-resolution (device-side eval-axis scan)
+
+
+def _shared_batch_inputs(n, k, g, b, node_cpu=1000.0, ask_cpu=400.0):
+    from nomad_tpu.ops.binpack import make_asks, make_node_state
+
+    state = make_node_state(
+        capacity=np.tile([node_cpu, 8192, 100000, 150], (n, 1)),
+        sched_capacity=np.tile([node_cpu, 8192, 100000, 150], (n, 1)),
+        util=np.zeros((n, 4)),
+        bw_avail=np.full(n, 1000.0),
+        bw_used=np.zeros(n),
+        ports_free=np.full(n, 100.0),
+        job_count=np.zeros((b, n), np.int32),
+        tg_count=np.zeros((b, n, g), np.int32),
+        feasible=np.ones((b, n, g), bool),
+        node_ok=np.ones(n, bool),
+    )
+    asks = make_asks(
+        resources=np.tile([ask_cpu, 64, 100, 0], (b, k, 1)),
+        bw=np.full((b, k), 10.0),
+        ports=np.full((b, k), 1.0),
+        tg_index=np.zeros((b, k), np.int32),
+        active=np.ones((b, k), bool),
+        job_distinct_hosts=np.zeros(b, bool),
+        tg_distinct_hosts=np.zeros((b, g), bool),
+    )
+    return state, asks
+
+
+def test_pre_resolve_parity_vs_serial_placement():
+    """The device-side eval-axis scan must equal placing the evals one
+    at a time while carrying the shared capacity state host-side — the
+    exact serialization the plan applier would impose."""
+    import jax
+
+    from nomad_tpu.ops.binpack import (
+        NodeState,
+        PlacementConfig,
+        batched_placement_program_overlay,
+        host_prng_key,
+        placement_program_jit,
+    )
+
+    b, n, k, g = 6, 16, 4, 1
+    state, asks = _shared_batch_inputs(n, k, g, b)
+    keys = np.stack([host_prng_key(i) for i in range(b)])
+    cfg = PlacementConfig(anti_affinity_penalty=10.0, pre_resolve=True)
+
+    choices, scores, _ = batched_placement_program_overlay(
+        state, asks, keys, cfg)
+    choices, scores = np.asarray(choices), np.asarray(scores)
+
+    util, bw, pf = state.util, state.bw_used, state.ports_free
+    serial_choices, serial_scores = [], []
+    for i in range(b):
+        s = NodeState(
+            capacity=state.capacity, sched_capacity=state.sched_capacity,
+            util=util, bw_avail=state.bw_avail, bw_used=bw,
+            ports_free=pf, job_count=state.job_count[i],
+            tg_count=state.tg_count[i], feasible=state.feasible[i],
+            node_ok=state.node_ok)
+        a = jax.tree.map(lambda x: x[i], asks)
+        c, sc, fin = placement_program_jit(s, a, keys[i], cfg)
+        util = np.asarray(fin.util)
+        bw = np.asarray(fin.bw_used)
+        pf = np.asarray(fin.ports_free)
+        serial_choices.append(np.asarray(c))
+        serial_scores.append(np.asarray(sc))
+    assert (choices == np.stack(serial_choices)).all()
+    assert np.allclose(scores, np.stack(serial_scores))
+
+
+def test_pre_resolve_eliminates_in_batch_overcommit():
+    """A/B at the kernel: vmapped (independent) evals over a tight
+    cluster overcommit node capacity — every overcommit is a plan the
+    applier would reject, i.e. a retry round-trip. The pre-resolving
+    scan produces claims that ALL verify, so in-batch retries drop to
+    zero."""
+    from nomad_tpu.ops.binpack import (
+        PlacementConfig,
+        batched_placement_program_overlay,
+        host_prng_key,
+    )
+
+    # 8 evals x 2 asks x 400 cpu over 8 nodes of 800: demand exactly
+    # equals capacity (16 asks, 16 slots), so a PERFECT serialization
+    # places everything — but independent evals tie-break over
+    # identical empty nodes and collide (every claim that fails the
+    # applier-style sequential re-check is a retry round-trip).
+    b, n, k, g = 8, 8, 2, 1
+    node_cpu, ask_cpu = 800.0, 400.0
+    state, asks = _shared_batch_inputs(n, k, g, b, node_cpu=node_cpu,
+                                       ask_cpu=ask_cpu)
+    keys = np.stack([host_prng_key(100 + i) for i in range(b)])
+
+    def overcommits(cfg):
+        choices, _, _ = batched_placement_program_overlay(
+            state, asks, keys, cfg)
+        choices = np.asarray(choices)
+        claimed = np.zeros(n)
+        rejected = 0
+        for i in range(b):
+            bad = False
+            for j in range(k):
+                c = int(choices[i, j])
+                if c < 0:
+                    bad = True  # a serialized pass would have placed it
+                    continue
+                if claimed[c] + ask_cpu > node_cpu:
+                    bad = True
+                    continue
+                claimed[c] += ask_cpu
+            rejected += bad
+        return rejected
+
+    off = overcommits(PlacementConfig(anti_affinity_penalty=10.0))
+    on = overcommits(
+        PlacementConfig(anti_affinity_penalty=10.0, pre_resolve=True))
+    # BestFit steers independent evals to the same packed nodes: the
+    # vmapped batch must show the collision pathology for the A/B to
+    # mean anything.
+    assert off > 0, "expected in-batch overcommit with pre_resolve off"
+    assert on == 0, f"pre-resolve left {on} in-batch overcommits"
+
+
+# ---------------------------------------------------------------------
+# conflict requeue: rejected evals rejoin the ACCUMULATING batch
+
+
+def test_requeue_joins_accumulating_batch():
+    """A conflict-requeued eval must land in the batch that is
+    CURRENTLY accumulating (and launch alongside new evals), not in a
+    fresh lone dispatch. Exercised at the accumulator level on an
+    UNSTARTED pipeline (no dispatcher thread to race): while every
+    in-flight slot is busy, a requeued entry and fresh evals arrive;
+    the close that happens when a slot frees must contain all of
+    them."""
+    import threading
+
+    from nomad_tpu.dispatch import DispatchPipeline
+    from nomad_tpu.dispatch.pipeline import _Pending
+
+    server = make_server(num_schedulers=0)
+    try:
+        pipe = DispatchPipeline(server)  # not started: we drive it
+        assert pipe.enabled
+        with pipe._cond:
+            pipe._inflight = pipe.max_inflight  # all slots busy
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(pipe._accumulate()), daemon=True)
+
+        requeued = _Pending(mock.eval(), "tok-requeue", requeues=1)
+        pipe._admit(requeued)
+        t.start()
+        time.sleep(0.3)  # accumulator is open, waiting on a slot
+        fresh = [_Pending(mock.eval(), f"tok-{i}") for i in range(3)]
+        for entry in fresh:
+            pipe._admit(entry)
+        time.sleep(0.2)
+        assert not got, "batch closed while every slot was busy"
+        with pipe._cond:
+            pipe._inflight = 0  # the in-flight batch completed
+            pipe._cond.notify_all()
+        t.join(timeout=5.0)
+        assert got, "accumulator never closed after the slot freed"
+        ids = {e.eval.id for e in got[0]}
+        assert requeued.eval.id in ids, "requeue missed the accumulating batch"
+        for entry in fresh:
+            assert entry.eval.id in ids
+        stats = pipe.stats()
+        assert stats["requeues_batched"] == 1, stats
+    finally:
+        server.shutdown()
+
+
+def test_plan_conflicts_requeue_and_resolve_live():
+    """Live conflict path: 4 single-node-sized jobs racing over 2 nodes
+    in ONE batch (pre-resolve off) must produce plan-applier rejections
+    whose retries are requeued through the pipeline — and the cluster
+    still converges (2 jobs placed, 2 blocked)."""
+    server = make_server(dense_pre_resolve=False, dense_min_batch=2)
+    try:
+        seed_nodes(server, 2, cpu=500, mem=4096)
+        quiesce(server)
+        jobs = []
+        for _ in range(4):
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 4
+            tg.tasks[0].resources.cpu = 100  # 4x100: one job per node
+            tg.tasks[0].resources.memory_mb = 64
+            tg.tasks[0].resources.networks = []
+            server.job_register(job)
+            jobs.append(job)
+        assert wait_until(lambda: server.broker.ready_count() >= 4, 10.0)
+        for w in server.workers:
+            w.set_pause(False)
+
+        def placed_jobs():
+            return sum(
+                1 for j in jobs
+                if len(server.fsm.state.allocs_by_job(j.id)) == 4)
+
+        assert wait_until(lambda: placed_jobs() >= 2, timeout=120.0)
+        # Give the losers time to finish their requeued replans.
+        assert wait_until(
+            lambda: server.dispatch.stats()["pending"] == 0
+            and server.dispatch.stats()["in_flight"] == 0,
+            timeout=60.0)
+        stats = server.dispatch.stats()
+        applier = server.plan_applier.stats()
+        # 4 plans over 2 one-job nodes in one batch: the applier MUST
+        # have rejected some, and those retries must have ridden the
+        # pipeline's requeue (or, past the bound, its inline path).
+        assert applier["plans_rejected"] >= 1, (stats, applier)
+        assert stats["plan_conflicts"] >= 1, stats
+        assert stats["requeues"] + stats["inline_retries"] >= 1, stats
+        assert stats["retries_per_eval"] > 0.0, stats
+        assert placed_jobs() == 2
+    finally:
+        server.shutdown()
+
+
+def test_pre_resolve_cuts_live_conflicts():
+    """Same race with pre-resolve ON: the in-batch serialization should
+    keep applier rejections at (near) zero — the A/B twin of the
+    kernel-level test, through the REAL control plane."""
+    server = make_server(dense_pre_resolve=True, dense_min_batch=2)
+    try:
+        seed_nodes(server, 4, cpu=500, mem=4096)
+        quiesce(server)
+        jobs = []
+        for _ in range(4):
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 4
+            tg.tasks[0].resources.cpu = 100
+            tg.tasks[0].resources.memory_mb = 64
+            tg.tasks[0].resources.networks = []
+            server.job_register(job)
+            jobs.append(job)
+        assert wait_until(lambda: server.broker.ready_count() >= 4, 10.0)
+        for w in server.workers:
+            w.set_pause(False)
+        assert wait_until(
+            lambda: all(
+                len(server.fsm.state.allocs_by_job(j.id)) == 4
+                for j in jobs),
+            timeout=120.0)
+        stats = server.dispatch.stats()
+        # One batch, serialized claims: every plan verifies, no retry
+        # round-trips. (Batch fragmentation could allow a stray
+        # conflict; zero requeued evals is the contract that matters.)
+        assert stats["retries_per_eval"] <= 0.25, stats
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# stats surface
+
+
+def test_agent_metrics_endpoint_exposes_pipeline_stats():
+    """/v1/agent/self must carry the pipeline stats (occupancy,
+    retries/eval, in-flight batches, stage latencies) — the acceptance
+    surface for the dispatch subsystem."""
+    from nomad_tpu.api import Client, HTTPServer
+
+    server = make_server(num_schedulers=1)
+    http = HTTPServer(server)
+    http.start()
+    try:
+        seed_nodes(server, 4)
+        job = mock.job()
+        job.task_groups[0].count = 5
+        server.job_register(job)
+        assert wait_until(
+            lambda: len(server.fsm.state.allocs_by_job(job.id)) == 5)
+        client = Client(http.addr, timeout=10.0)
+        out = client.agent.self()
+        pipe = out.get("dispatch_pipeline")
+        assert pipe is not None, sorted(out)
+        for key in ("occupancy", "occupancy_frac", "retries_per_eval",
+                    "in_flight", "batches", "dispatched_evals",
+                    "drain_us", "process_us", "submit_us"):
+            assert key in pipe, (key, pipe)
+        assert pipe["enabled"] is True
+        # The server-stats block carries them too (plus the applier's
+        # conflict counters).
+        assert "dispatch_pipeline" in out["stats"]
+        assert "plans_rejected" in out["stats"]["plan_applier"]
+    finally:
+        http.stop()
+        server.shutdown()
