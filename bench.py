@@ -922,6 +922,37 @@ def run_config(n, reps=DEFAULT_REPS):
     return out
 
 
+def ntalint_purity_gate():
+    """Trace-purity findings in the kernel path (ops/, scheduler/)
+    invalidate dense-path numbers BY CONSTRUCTION: an impure call or a
+    host sync inside a jitted program means the benchmark measured a
+    host fallback or a trace-time constant, not the device path it
+    claims to. Returns the non-baselined findings."""
+    import os
+
+    from nomad_tpu.analysis import (
+        analyze_paths,
+        apply_baseline,
+        load_baseline,
+    )
+    from nomad_tpu.analysis import purity
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    # The checker's own constants, not string copies: a renamed rule id
+    # must break this gate loudly, not silently filter every finding.
+    # parse-error rides along: a file the analyzer could not parse got
+    # ZERO purity analysis — "gate clean" would be a lie for it.
+    purity_rules = {purity.RULE_IMPURE, purity.RULE_HOST_SYNC,
+                    purity.RULE_CLOSURE_MUT, purity.RULE_BRANCH,
+                    purity.RULE_STATIC, "parse-error"}
+    findings = analyze_paths(
+        [os.path.join(root, "nomad_tpu", "ops"),
+         os.path.join(root, "nomad_tpu", "scheduler")],
+        rules=purity_rules)
+    new, _stale = apply_baseline(findings, load_baseline())
+    return new
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=HEADLINE_CONFIG,
@@ -930,7 +961,23 @@ def main():
     parser.add_argument("--reps", type=int, default=DEFAULT_REPS,
                         help="interleaved CPU/TPU repetitions per config;"
                              " medians + IQR are reported")
+    parser.add_argument("--check", action="store_true",
+                        help="run the ntalint trace-purity gate over "
+                             "ops/ and scheduler/ first; refuse to "
+                             "report dense-path numbers on findings")
     args = parser.parse_args()
+
+    if args.check:
+        bad = ntalint_purity_gate()
+        if bad:
+            for f in bad:
+                print(f.render(), file=sys.stderr)
+            print(f"bench: REFUSING to report dense-path numbers: "
+                  f"{len(bad)} trace-purity finding(s) in ops//"
+                  f"scheduler/ (fix them or run without --check)",
+                  file=sys.stderr)
+            sys.exit(2)
+        print("bench: ntalint trace-purity gate clean", file=sys.stderr)
 
     if args.all:
         for n in sorted(CONFIGS):

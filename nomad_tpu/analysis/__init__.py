@@ -1,0 +1,34 @@
+"""ntalint: AST-based static analysis specialized to this codebase's
+concurrency and JAX-purity invariants (see analysis/README.md).
+
+Three checker families, run over `nomad_tpu/` as a tier-1 test
+(tests/test_static_analysis.py) and from the CLI (tools/ntalint.py):
+
+- ``locks``    — lock-discipline: `# guarded-by:` attributes, blocking
+  calls under locks, and never-block dispatcher-thread entrypoints.
+- ``purity``   — JAX trace-purity: impure/host calls, closure
+  mutation, Python branching on traced values, unhashable static args.
+- ``snapshot`` — scheduler/dispatch modules read cluster state only
+  through StateStore.snapshot() handles, never the live store.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+ALL_RULES = (
+    "parse-error",
+    "guarded-by",
+    "lock-blocking-call",
+    "dispatcher-blocking-call",
+    "trace-impure-call",
+    "trace-host-sync",
+    "trace-closure-mutation",
+    "trace-python-branch",
+    "jit-unhashable-static",
+    "live-state-read",
+)

@@ -1,0 +1,309 @@
+"""ntalint driver: module parsing, suppressions, baseline machinery.
+
+Pure stdlib (`ast` + `tokenize`-free line scans): the suite must run in
+the tier-1 path on any box the tests run on, with zero dependencies
+beyond the interpreter.
+
+Baseline entries match findings by (rule, path, symbol) — line numbers
+drift with every edit, while the enclosing def/class is stable across
+reformatting. An entry carries a ``count`` so N pre-existing findings
+in one function stay N: an N+1th is a NEW finding, and an entry whose
+symbol no longer produces a finding is STALE (the non-growing-baseline
+test fails on it — fixed findings must leave the baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+_DISABLE_RE = re.compile(r"#\s*nta:\s*disable=([A-Za-z0-9_,\- ]+)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+class Finding:
+    """One rule violation at one site."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "symbol")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, symbol: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.symbol = symbol  # enclosing Class.method / function
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}{sym}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Finding {self.render()}>"
+
+
+class Module:
+    """One parsed source file plus the per-line metadata every checker
+    needs: raw lines (for `# guarded-by:` / `# nta: disable=` comment
+    scans — ast drops comments) and a child->parent node map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel  # repo-relative, forward slashes (baseline key)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def guarded_comment(self, lineno: int) -> Optional[str]:
+        m = _GUARDED_RE.search(self.line_text(lineno))
+        return m.group(1) if m else None
+
+    def disabled_rules(self, lineno: int) -> set:
+        """Rules disabled on this line (or 'all')."""
+        m = _DISABLE_RE.search(self.line_text(lineno))
+        if not m:
+            return set()
+        return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def statement_line(self, node: ast.AST) -> int:
+        """Line of the statement enclosing `node` (suppressions placed
+        on a multi-line statement's first line cover the whole
+        statement)."""
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        return getattr(cur, "lineno", getattr(node, "lineno", 0))
+
+    def symbol_of(self, node: ast.AST) -> str:
+        """Dotted Class.method / function name enclosing `node`."""
+        parts: List[str] = []
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) if parts else "<module>"
+
+
+def _iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    # de-dup, stable order
+    seen = set()
+    uniq = []
+    for f in out:
+        a = os.path.abspath(f)
+        if a not in seen:
+            seen.add(a)
+            uniq.append(f)
+    return uniq
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _rel_path(path: str) -> str:
+    root = repo_root()
+    ap = os.path.abspath(path)
+    if ap.startswith(root + os.sep):
+        ap = ap[len(root) + 1:]
+    return ap.replace(os.sep, "/")
+
+
+def load_modules(
+    paths: List[str],
+) -> Tuple[List[Module], List[Finding]]:
+    """(parsed modules, parse-error findings). A file that does not
+    parse — common for --diff against a mid-edit working tree — is
+    reported as a `parse-error` finding, not a crash: scripted
+    consumers must be able to tell "findings" from "tool blew up"."""
+    mods: List[Module] = []
+    errors: List[Finding] = []
+    for f in _iter_py_files(paths):
+        with open(f, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            mods.append(Module(f, _rel_path(f), source))
+        except SyntaxError as e:
+            errors.append(Finding(
+                "parse-error", _rel_path(f), e.lineno or 0,
+                (e.offset or 1) - 1,
+                f"file does not parse: {e.msg}", "<module>"))
+    return mods, errors
+
+
+def analyze_paths(paths: List[str],
+                  rules: Optional[set] = None) -> List[Finding]:
+    """Run every checker over `paths`; returns findings with inline
+    `# nta: disable=` suppressions already applied, sorted by
+    (path, line, rule)."""
+    from . import locks, purity, snapshot
+
+    modules, parse_errors = load_modules(paths)
+    registry = purity.build_jit_registry(modules)
+    findings: List[Finding] = list(parse_errors)
+    for mod in modules:
+        findings.extend(locks.check(mod))
+        findings.extend(purity.check(mod, registry))
+        findings.extend(snapshot.check(mod))
+    by_rel = {m.rel: m for m in modules}
+    kept = []
+    for f in findings:
+        if rules is not None and f.rule not in rules:
+            continue
+        mod = by_rel.get(f.path)
+        if mod is not None:
+            # Union, not fallback: a suppression on the opening line of
+            # a multi-line simple statement covers findings anywhere
+            # inside it, even when an inner line carries its own
+            # (different-rule) disable comment.
+            disabled = mod.disabled_rules(f.line) | mod.disabled_rules(
+                _enclosing_stmt_line(mod, f.line))
+            if "all" in disabled or f.rule in disabled:
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+def _enclosing_stmt_line(mod: Module, lineno: int) -> int:
+    """Opening line of the innermost SIMPLE statement spanning
+    `lineno`. Compound statements (with/if/for/def...) are excluded on
+    purpose: a suppression on `with lock:` must not blanket the whole
+    body."""
+    best = None
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.stmt) or isinstance(
+                node, (ast.With, ast.If, ast.For, ast.While, ast.Try,
+                       ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            continue
+        start = getattr(node, "lineno", None)
+        end = getattr(node, "end_lineno", None)
+        if start is None or end is None:
+            continue
+        # Innermost span wins = the latest opening line that still
+        # covers the finding.
+        if start <= lineno <= end and (best is None or start > best):
+            best = start
+    return best if best is not None else lineno
+
+
+# ---------------------------------------------------------------- baseline
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> List[dict]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("findings", []))
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: List[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """Split `findings` against the baseline. Returns
+    (new_findings, stale_entries): a baseline entry absorbs up to
+    `count` (default 1) findings with its (rule, path, symbol); entries
+    that absorb nothing are STALE — the finding they recorded was fixed
+    and the entry must be deleted (non-growing baseline)."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for ent in baseline:
+        key = (ent["rule"], ent["path"], ent.get("symbol", ""))
+        budget[key] = budget.get(key, 0) + int(ent.get("count", 1))
+    used: Dict[Tuple[str, str, str], int] = {k: 0 for k in budget}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > used.get(k, 0):
+            used[k] += 1
+        else:
+            new.append(f)
+    # Staleness is judged per KEY (entries sharing a key pooled their
+    # counts above), reported once on the key's first entry — judging
+    # per entry would call a sibling stale when the first one already
+    # accounted for the key's findings.
+    stale: List[dict] = []
+    reported = set()
+    for ent in baseline:
+        key = (ent["rule"], ent["path"], ent.get("symbol", ""))
+        if key in reported:
+            continue
+        reported.add(key)
+        have = used.get(key, 0)
+        want = budget.get(key, 0)
+        if have == 0:
+            stale.append(ent)
+        elif want > have:
+            # partial staleness: more budget than findings
+            over = dict(ent)
+            over["stale_count"] = want - have
+            stale.append(over)
+    return new, stale
+
+
+def write_baseline(findings: List[Finding],
+                   path: Optional[str] = None) -> str:
+    """Serialize current findings as the new baseline (counts folded
+    per (rule, path, symbol))."""
+    path = path or default_baseline_path()
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": r, "path": p, "symbol": s, "count": c}
+        for (r, p, s), c in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path

@@ -1,0 +1,402 @@
+"""Lock-discipline checker.
+
+Three rules:
+
+- ``guarded-by`` — an attribute whose ``__init__`` assignment carries a
+  ``# guarded-by: <lock>`` comment may only be read or written while
+  that lock is held (lexically inside a ``with self.<lock>`` /
+  ``with self.<cond-on-that-lock>`` block). Constructor assignments are
+  exempt (single-threaded by construction).
+
+- ``lock-blocking-call`` — no blocking call (``time.sleep``,
+  ``.result()``, ``urlopen``, ``block_until_ready``, ``submit_plan``,
+  ``.join()``, foreign ``.wait()``) lexically inside a with-lock body.
+  ``cond.wait(...)`` on a condition WHOSE OWN LOCK is held is exempt —
+  a condition wait releases the lock, it cannot convoy other holders.
+
+- ``dispatcher-blocking-call`` — functions reachable from the
+  dispatcher-thread entrypoints a module declares in
+  ``NTA_DISPATCHER_ENTRYPOINTS = ("Class.method", ...)`` must contain
+  no blocking call at all. The only exemption is a BOUNDED
+  ``cond.wait(timeout)`` on a condition whose lock is held — that is
+  the dispatcher's scheduling primitive, not a foreign dependency.
+  Reachability follows direct intra-module calls (``self.m()``,
+  module-level ``f()``); references handed to thread pools or
+  ``Thread(target=...)`` run on OTHER threads and are not followed —
+  that is exactly the sanctioned fix for a finding.
+
+Locks are recognized from ``threading.Lock()/RLock()/Condition()``
+construction: module-level names and ``self.<attr>`` assignments in
+``__init__``. ``Condition(self._lock)`` aliases the condition to its
+lock, so holding either name satisfies a guard on the other.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module
+
+RULE_GUARDED = "guarded-by"
+RULE_LOCK_BLOCKING = "lock-blocking-call"
+RULE_DISPATCHER_BLOCKING = "dispatcher-blocking-call"
+
+# Attribute names whose call is blocking regardless of receiver.
+BLOCKING_ATTRS = {"block_until_ready", "result", "urlopen",
+                  "submit_plan", "sleep", "join", "wait"}
+# Bare-name calls that are blocking.
+BLOCKING_NAMES = {"urlopen", "sleep"}
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+
+# Canonical lock id: ("self", attr) for instance locks (per class),
+# ("mod", name) for module-level locks.
+LockId = Tuple[str, str]
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        # attr -> canonical LockId of the lock itself (conds resolve to
+        # their backing lock).
+        self.locks: Dict[str, LockId] = {}
+        self.conds: Set[str] = set()  # attrs that are Condition objects
+        self.guarded: Dict[str, LockId] = {}  # attr -> required lock
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    """'lock' / 'cond' when `call` constructs a threading primitive —
+    matched on the constructor NAME so both `threading.Lock()` and
+    `__import__("threading").Lock()` register."""
+    func = call.func
+    name = None
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    if name in _LOCK_CTORS:
+        return "lock"
+    if name in _COND_CTORS:
+        return "cond"
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ModuleIndex:
+    """Pass 1: lock registry, guarded attrs, dispatcher manifest, and
+    the intra-module call graph."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.module_locks: Dict[str, LockId] = {}  # name -> LockId
+        self.module_conds: Set[str] = set()
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.entrypoints: List[str] = []
+        # qualname -> FunctionDef for every def (methods qualified as
+        # Class.method, module funcs bare).
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        # qualname -> set of directly-called qualnames
+        self.calls: Dict[str, Set[str]] = {}
+        self._build()
+
+    def _build(self) -> None:
+        tree = self.mod.tree
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                self._module_assign(node)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        # manifest
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id == "NTA_DISPATCHER_ENTRYPOINTS"):
+                        self.entrypoints.extend(
+                            self._string_elems(node.value))
+        # call graph
+        for qual, fn in self.functions.items():
+            self.calls[qual] = self._direct_calls(qual, fn)
+
+    def _string_elems(self, node: ast.AST) -> List[str]:
+        out = []
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str):
+                    out.append(el.value)
+        return out
+
+    def _module_assign(self, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        kind = _ctor_kind(node.value)
+        if kind is None:
+            return
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.module_locks[tgt.id] = ("mod", tgt.id)
+                if kind == "cond":
+                    self.module_conds.add(tgt.id)
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        info = _ClassInfo(cls.name)
+        self.classes[cls.name] = info
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{cls.name}.{node.name}"
+                self.functions[qual] = node
+                if node.name == "__init__":
+                    self._scan_init(info, node)
+
+    def _scan_init(self, info: _ClassInfo, init: ast.FunctionDef) -> None:
+        for stmt in ast.walk(init):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            value = stmt.value
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    kind = _ctor_kind(value)
+                    if kind == "lock":
+                        info.locks[attr] = ("self", attr)
+                        continue
+                    if kind == "cond":
+                        info.conds.add(attr)
+                        # Condition(self.X) aliases to X; bare
+                        # Condition() backs its own lock.
+                        backing = None
+                        if value.args:
+                            backing = _self_attr(value.args[0])
+                        if backing is not None:
+                            info.locks[attr] = ("self", backing)
+                            info.locks.setdefault(
+                                backing, ("self", backing))
+                        else:
+                            info.locks[attr] = ("self", attr)
+                        continue
+                guard = self.mod.guarded_comment(stmt.lineno)
+                if guard is not None:
+                    if guard in info.locks:
+                        info.guarded[attr] = info.locks[guard]
+                    elif guard in self.module_locks:
+                        info.guarded[attr] = self.module_locks[guard]
+                    else:
+                        # Forward reference: the lock may be declared
+                        # later in __init__; resolve best-effort to a
+                        # self attr.
+                        info.guarded[attr] = ("self", guard)
+        # Second pass: guards that referenced a lock declared later.
+        for attr, lock in list(info.guarded.items()):
+            kind, name = lock
+            if kind == "self" and name in info.locks:
+                info.guarded[attr] = info.locks[name]
+
+    def _direct_calls(self, qual: str, fn: ast.FunctionDef) -> Set[str]:
+        cls = qual.split(".")[0] if "." in qual else None
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = _self_attr(func)
+            if attr is not None and cls is not None:
+                cand = f"{cls}.{attr}"
+                if cand in self.functions:
+                    out.add(cand)
+            elif isinstance(func, ast.Name) and func.id in self.functions:
+                out.add(func.id)
+        return out
+
+    # ------------------------------------------------------ resolution
+
+    def resolve_lock_expr(self, expr: ast.AST,
+                          cls: Optional[str]) -> Optional[LockId]:
+        """LockId for a with-target / wait-receiver expression, if it
+        names a registered lock or condition."""
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None:
+            info = self.classes.get(cls)
+            if info is not None and attr in info.locks:
+                return info.locks[attr]
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return self.module_locks[expr.id]
+        return None
+
+    def is_condition(self, expr: ast.AST, cls: Optional[str]) -> bool:
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None:
+            info = self.classes.get(cls)
+            return info is not None and attr in info.conds
+        if isinstance(expr, ast.Name):
+            return expr.id in self.module_conds
+        return False
+
+
+def _dispatcher_reachable(index: _ModuleIndex) -> Set[str]:
+    seen: Set[str] = set()
+    todo = [e for e in index.entrypoints if e in index.functions]
+    while todo:
+        cur = todo.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        todo.extend(index.calls.get(cur, ()))
+    return seen
+
+
+class _FunctionWalker:
+    """Pass 2: walk one function's statements tracking held locks."""
+
+    def __init__(self, index: _ModuleIndex, mod: Module, qual: str,
+                 fn: ast.FunctionDef, dispatcher: bool,
+                 findings: List[Finding]):
+        self.index = index
+        self.mod = mod
+        self.qual = qual
+        self.cls = qual.split(".")[0] if "." in qual else None
+        self.method = qual.split(".")[-1]
+        self.fn = fn
+        self.dispatcher = dispatcher
+        self.findings = findings
+
+    def run(self) -> None:
+        self._stmts(self.fn.body, frozenset())
+
+    # ------------------------------------------------------- traversal
+
+    def _stmts(self, body: List[ast.stmt], held: frozenset) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: frozenset) -> None:
+        if isinstance(stmt, ast.With):
+            acquired = set()
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                lock = self.index.resolve_lock_expr(
+                    item.context_expr, self.cls)
+                if lock is not None:
+                    acquired.add(lock)
+            self._stmts(stmt.body, held | frozenset(acquired))
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs run later, on whatever thread calls them:
+            # locks held HERE are not held THERE.
+            self._stmts(stmt.body, frozenset())
+        elif isinstance(stmt, ast.ClassDef):
+            pass
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held)
+            self._expr(stmt.target, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                self._expr(child, held)
+
+    def _expr(self, node: ast.AST, held: frozenset) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, held)
+            elif isinstance(sub, ast.Attribute):
+                self._check_guarded(sub, held)
+
+    # ---------------------------------------------------------- checks
+
+    def _check_guarded(self, node: ast.Attribute, held: frozenset) -> None:
+        attr = _self_attr(node)
+        if attr is None or self.cls is None:
+            return
+        info = self.index.classes.get(self.cls)
+        if info is None or attr not in info.guarded:
+            return
+        if self.method == "__init__":
+            return  # construction is single-threaded
+        lock = info.guarded[attr]
+        if lock in held:
+            return
+        self.findings.append(Finding(
+            RULE_GUARDED, self.mod.rel, node.lineno, node.col_offset,
+            f"attribute '{attr}' is guarded by "
+            f"'{lock[1]}' but accessed without it",
+            self.qual))
+
+    def _check_call(self, call: ast.Call, held: frozenset) -> None:
+        func = call.func
+        name = None
+        receiver = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            receiver = func.value
+        elif isinstance(func, ast.Name):
+            name = func.id
+        blocking = (
+            (isinstance(func, ast.Attribute) and name in BLOCKING_ATTRS)
+            or (isinstance(func, ast.Name) and name in BLOCKING_NAMES)
+        )
+        if not blocking:
+            return
+
+        # cond.wait on a condition whose own lock is held: releases the
+        # lock while parked — never a convoy. Bounded (has a timeout
+        # arg) additionally satisfies the dispatcher rule.
+        own_cond_wait = False
+        bounded = bool(call.args or call.keywords)
+        if name == "wait" and receiver is not None:
+            lock = self.index.resolve_lock_expr(receiver, self.cls)
+            if (lock is not None and lock in held
+                    and self.index.is_condition(receiver, self.cls)):
+                own_cond_wait = True
+
+        if held and not own_cond_wait:
+            self.findings.append(Finding(
+                RULE_LOCK_BLOCKING, self.mod.rel, call.lineno,
+                call.col_offset,
+                f"blocking call '{name}' inside a with-lock body "
+                f"(holding {', '.join(sorted(l[1] for l in held))})",
+                self.qual))
+        if self.dispatcher and not (own_cond_wait and bounded):
+            self.findings.append(Finding(
+                RULE_DISPATCHER_BLOCKING, self.mod.rel, call.lineno,
+                call.col_offset,
+                f"blocking call '{name}' reachable from dispatcher "
+                f"entrypoint (manifest NTA_DISPATCHER_ENTRYPOINTS); "
+                f"move it to a stage thread",
+                self.qual))
+
+
+def check(mod: Module) -> List[Finding]:
+    index = _ModuleIndex(mod)
+    reachable = _dispatcher_reachable(index)
+    findings: List[Finding] = []
+    for qual, fn in index.functions.items():
+        _FunctionWalker(index, mod, qual, fn,
+                        dispatcher=qual in reachable,
+                        findings=findings).run()
+    return findings

@@ -1,0 +1,73 @@
+"""Snapshot-discipline checker.
+
+Scheduler and dispatch code must plan against an immutable
+``StateStore.snapshot()`` handle, never the live store: the live store
+mutates under the FSM apply thread mid-eval, so reads through it tear
+across raft indexes (a placement computed half-before, half-after an
+apply is exactly the inconsistency optimistic concurrency exists to
+catch — but only if every eval's reads come from ONE snapshot).
+
+Rule ``live-state-read`` (modules under ``scheduler/`` and
+``dispatch/`` only):
+
+- calling any read method on ``<...>.fsm.state`` other than
+  ``snapshot()`` / ``latest_index()`` (the index probe does not read
+  table state and the catch-up loops need it);
+- binding ``<...>.fsm.state`` itself to a name / argument / container —
+  aliasing the live store smuggles it past the call-site check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Module
+
+RULE_LIVE_READ = "live-state-read"
+
+SCOPE_DIR_MARKERS = ("/scheduler/", "/dispatch/")
+ALLOWED_METHODS = {"snapshot", "latest_index"}
+
+
+def _is_fsm_state(node: ast.AST) -> bool:
+    """True for an Attribute chain ending ``.fsm.state``."""
+    return (isinstance(node, ast.Attribute) and node.attr == "state"
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "fsm")
+
+
+def in_scope(rel_path: str) -> bool:
+    p = "/" + rel_path
+    return any(m in p for m in SCOPE_DIR_MARKERS)
+
+
+def check(mod: Module) -> List[Finding]:
+    if not in_scope(mod.rel):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not _is_fsm_state(node):
+            continue
+        parent = mod.parents.get(node)
+        # Allowed shape: Call(func=Attribute(value=<fsm.state>,
+        # attr in ALLOWED_METHODS))
+        if isinstance(parent, ast.Attribute):
+            grand = mod.parents.get(parent)
+            if (parent.attr in ALLOWED_METHODS
+                    and isinstance(grand, ast.Call)
+                    and grand.func is parent):
+                continue
+            findings.append(Finding(
+                RULE_LIVE_READ, mod.rel, node.lineno, node.col_offset,
+                f"live-store read '.fsm.state.{parent.attr}' — "
+                f"scheduler/dispatch code must read through a "
+                f"StateStore.snapshot() handle",
+                mod.symbol_of(node)))
+        else:
+            findings.append(Finding(
+                RULE_LIVE_READ, mod.rel, node.lineno, node.col_offset,
+                "aliasing the live store ('.fsm.state') — take a "
+                ".snapshot() and pass that instead",
+                mod.symbol_of(node)))
+    return findings

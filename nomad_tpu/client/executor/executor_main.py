@@ -286,6 +286,11 @@ class Executor:
             return {"ok": False, "error": str(e)}
 
     def rpc_kill(self, req: dict) -> dict:
+        # The lock covers only the signal sends; the done-event waits
+        # happen OUTSIDE it, so a second killer (or a status RPC taking
+        # the lock) never convoys behind a full grace period. Both
+        # escalation steps re-check done under the lock, and a
+        # double-SIGKILL of a dead process group is a caught OSError.
         timeout = float(req.get("timeout", 5.0))
         with self._kill_lock:
             if not self.done.is_set():
@@ -293,7 +298,9 @@ class Executor:
                     os.killpg(self.proc.pid, signal.SIGINT)
                 except OSError:
                     pass
-                if not self.done.wait(timeout):
+        if not self.done.wait(timeout):
+            with self._kill_lock:
+                if not self.done.is_set():
                     try:
                         os.killpg(self.proc.pid, signal.SIGKILL)
                     except OSError:
@@ -301,7 +308,7 @@ class Executor:
                             self.proc.kill()
                         except OSError:
                             pass
-                    self.done.wait(5.0)
+            self.done.wait(5.0)
         return {"done": self.done.is_set(), "result": self.result}
 
     def _exit_now(self) -> None:

@@ -50,6 +50,15 @@ DEQUEUE_TOPUP_SLICE = 0.002  # cond-wait granularity while accumulating
 SLOT_WAIT_SLICE = 0.02  # cond-wait granularity while all slots busy
 WAIT_INDEX_TIMEOUT = 5.0
 
+# ntalint lock-discipline manifest: functions reachable from these
+# entrypoints run on the dispatcher thread and must never block (the
+# accumulator IS the pipeline's clock — a blocked dispatcher stops
+# batches from closing for every worker at once). Bounded cond-waits on
+# the pipeline's own lock are the sanctioned scheduling primitive;
+# everything slow (FSM catch-up, snapshotting, plan submit, device
+# sync) belongs on the stage threads.
+NTA_DISPATCHER_ENTRYPOINTS = ("DispatchPipeline._run",)
+
 
 class _RequeueConflict(Exception):
     """Raised out of PipelineSession.submit_plan to abort the eval's
@@ -162,26 +171,26 @@ class DispatchPipeline:
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: List[_Pending] = []
-        self._inflight = 0
+        self._pending: List[_Pending] = []  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-        # ---- stats (all mutated under self._lock) -------------------
-        self.evals_in = 0  # handed off / requeued into the accumulator
-        self.batches = 0  # batches launched
-        self.dispatched_evals = 0  # sum of launched batch sizes
-        self.largest_batch = 0
-        self.routed_host = 0  # evals sent to the host factory
-        self.acked = 0
-        self.nacked = 0
-        self.plan_conflicts = 0  # plans handed a RefreshIndex
-        self.requeues = 0  # conflict retries folded into the accumulator
-        self.requeues_batched = 0  # ...that launched alongside other evals
-        self.inline_retries = 0  # conflict retries run the classic way
-        self.t_drain = 0.0  # eval time spent in the accumulator
-        self.t_process = 0.0  # scheduler invoke (matrix+dispatch+plan)
-        self.t_submit = 0.0  # plan queue + applier + commit wait
+        # ---- stats ----
+        self.evals_in = 0  # guarded-by: _lock (handed off / requeued)
+        self.batches = 0  # guarded-by: _lock (batches launched)
+        self.dispatched_evals = 0  # guarded-by: _lock (sum batch sizes)
+        self.largest_batch = 0  # guarded-by: _lock
+        self.routed_host = 0  # guarded-by: _lock (sent to host factory)
+        self.acked = 0  # guarded-by: _lock
+        self.nacked = 0  # guarded-by: _lock
+        self.plan_conflicts = 0  # guarded-by: _lock (RefreshIndex'd)
+        self.requeues = 0  # guarded-by: _lock (retries via accumulator)
+        self.requeues_batched = 0  # guarded-by: _lock (joined a batch)
+        self.inline_retries = 0  # guarded-by: _lock (classic retries)
+        self.t_drain = 0.0  # guarded-by: _lock (time in accumulator)
+        self.t_process = 0.0  # guarded-by: _lock (scheduler invoke)
+        self.t_submit = 0.0  # guarded-by: _lock (plan queue + commit)
 
     # ------------------------------------------------------- lifecycle
 
@@ -225,7 +234,18 @@ class DispatchPipeline:
         while not self._stop.is_set():
             batch = self._accumulate()
             if batch:
-                self._launch(batch)
+                # The launch prologue BLOCKS — _wait_for_index
+                # sleep-polls the FSM for up to WAIT_INDEX_TIMEOUT and
+                # snapshotting walks every table — so it runs on a
+                # stage thread. The dispatcher goes straight back to
+                # accumulating: the next batch keeps filling while this
+                # one catches up to its snapshot index (previously a
+                # follower lagging the leader commit froze ALL lanes
+                # for the duration, not just this batch's).
+                # _accumulate already took the in-flight slot, so the
+                # pipelining bound still holds while the launch is in
+                # hand-off.
+                self.server.eval_pool.submit(self._launch, batch)
 
     def _accumulate(self) -> List[_Pending]:
         """Pack the next batch: wait for a seed eval, then top up with
@@ -291,6 +311,52 @@ class DispatchPipeline:
         return batch
 
     def _launch(self, batch: List[_Pending]) -> None:
+        # The whole prologue is guarded: it runs on a pool thread now,
+        # where an escaped exception dies into an unread PoolFuture —
+        # and the slot _accumulate took would leak, wedging the
+        # accumulator once max_inflight failed launches pile up.
+        try:
+            prologue = self._launch_prologue(batch)
+        except Exception:
+            self.logger.exception(
+                "batch launch failed; nacking %d evals", len(batch))
+            prologue = None
+        # Single abort call site: an abort raising INSIDE the try must
+        # never be re-entered by the except path (double slot release).
+        if prologue is None:
+            self._abort_batch(batch)
+            return
+        # Fan-out needs no guard: WorkPool.submit enqueues then NEVER
+        # raises (a failed worker spawn is swallowed and retried on the
+        # next submit — utils/pool.py), so every entry is handed off
+        # exactly once and releases the slot via `remaining`. A
+        # partial-fan-out cleanup here would double-finish entries the
+        # pool still runs.
+        snapshot, route_host = prologue
+        remaining = [len(batch)]
+        for entry in batch:
+            self.server.eval_pool.submit(
+                self._process_entry, entry, snapshot, route_host,
+                remaining)
+
+    def _abort_batch(self, batch: List[_Pending]) -> None:
+        """Nack every entry and release the in-flight slot
+        _accumulate took for this batch. The release is in a finally:
+        aborts run exactly when the leader is unreachable, so the
+        nacks themselves may fail — a slot leak here would wedge the
+        accumulator after max_inflight failed aborts."""
+        try:
+            for entry in batch:
+                self._finish(entry, acked=False)
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def _launch_prologue(self, batch: List[_Pending]):
+        """(snapshot, route_host) for a launchable batch, None when the
+        FSM never caught up to the batch's snapshot index. Returned,
+        not stored: concurrent launches each carry their own."""
         cfg = self.server.config
         # Latency-aware routing, centralized: a batch too small to
         # amortize the device dispatch runs on the host factories with
@@ -308,15 +374,7 @@ class DispatchPipeline:
         max_index = max(max(e.eval.modify_index, e.min_index)
                         for e in batch)
         if not self._wait_for_index(max_index, WAIT_INDEX_TIMEOUT):
-            for entry in batch:
-                self._finish(entry, acked=False)
-            # _accumulate took an in-flight slot for this batch; a
-            # leaked slot here would wedge the accumulator once
-            # max_inflight aborted batches pile up.
-            with self._cond:
-                self._inflight -= 1
-                self._cond.notify_all()
-            return
+            return None
         snapshot = self.server.fsm.state.snapshot()
         if not route_host:
             # Announce the fan-out to the batcher: its dispatch window
@@ -336,10 +394,7 @@ class DispatchPipeline:
                 from ..scheduler.batcher import get_batcher
 
                 get_batcher().add_cohort(announce)
-        remaining = [len(batch)]
-        for entry in batch:
-            self.server.eval_pool.submit(
-                self._process_entry, entry, snapshot, route_host, remaining)
+        return snapshot, route_host
 
     # ---------------------------------------------------------- stages
 
@@ -411,6 +466,15 @@ class DispatchPipeline:
                 self.server.eval_nack(entry.eval.id, entry.token)
         except ValueError:
             pass  # nack timer fired concurrently
+        except Exception:
+            # On a follower the ack/nack is an RPC to the leader and
+            # fails exactly when aborts happen (leader flap). The
+            # broker's nack timer reclaims the eval either way; raising
+            # out of a stage thread would leak slot accounting instead.
+            self.logger.warning(
+                "eval %s %s failed; nack timer will reclaim",
+                entry.eval.id, "ack" if acked else "nack",
+                exc_info=True)
         with self._lock:
             if acked:
                 self.acked += 1

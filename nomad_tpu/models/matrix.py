@@ -101,7 +101,8 @@ class _ClusterBase:
         # cheap per-job overlay counts
         self.alloc_groups: List[List[Tuple[str, str]]] = []
         self._init_class_index(nodes)
-        self._positions = None  # job_id -> {tg: row indices}, lazy
+        # job_id -> {tg: row indices}, built lazily
+        self._positions = None  # guarded-by: _positions_lock
         self._positions_lock = __import__("threading").Lock()
         self._fill_all(nodes, proposed_fn)
 
@@ -367,7 +368,12 @@ class _ClusterBase:
                 patched[jid] = per
             else:
                 patched.pop(jid, None)
-        self._positions = patched
+        # Publish under the lock: `self` is freshly built and unshared
+        # in the current delta path, but the guarded-by contract on
+        # _positions is unconditional — a future caller patching a
+        # LIVE base would otherwise race job_positions' lazy build.
+        with self._positions_lock:
+            self._positions = patched
 
 
 def compute_class_index(nodes) -> Tuple[np.ndarray, List[int]]:

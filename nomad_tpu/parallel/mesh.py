@@ -56,7 +56,11 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh
     total = devices.size
     if dp is None:
         dp = 1
-    assert total % dp == 0, f"{total} devices not divisible by dp={dp}"
+    if total % dp:
+        # A real error, not an assert: asserts vanish under `python
+        # -O` and a silently ragged reshape would shard the node axis
+        # unevenly.
+        raise ValueError(f"{total} devices not divisible by dp={dp}")
     return Mesh(devices.reshape(dp, total // dp), (DP_AXIS, NODE_AXIS))
 
 
@@ -109,16 +113,21 @@ def shard_placement_inputs(
 ) -> Tuple[NodeState, Asks, object]:
     """Place the inputs on the mesh with the canonical shardings. The
     node count must divide the nodes-axis size (callers bucket to
-    multiples of 128, models/matrix.py)."""
-    state_sh = jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+    multiples of 128, models/matrix.py).
+
+    ONE device_put per pytree (the shardings ride as a matching
+    pytree), not one per leaf: jax batches the transfer into a single
+    commit, where the per-leaf tree.map paid one host->device RPC per
+    array — 10 RPCs per NodeState through a remote-device tunnel."""
+    state_sh = jax.device_put(
         state,
-        _node_state_specs(batched),
+        jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                     _node_state_specs(batched)),
     )
-    asks_sh = jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+    asks_sh = jax.device_put(
         asks,
-        _asks_specs(batched),
+        jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                     _asks_specs(batched)),
     )
     key_spec = P(DP_AXIS) if batched else P()
     keys_sh = jax.device_put(keys, NamedSharding(mesh, key_spec))

@@ -65,9 +65,14 @@ class Harness:
     def submit_plan(self, plan: Plan):
         with self._plan_lock:
             self.plans.append(plan)
-            if self.planner is not None:
-                return self.planner.submit_plan(plan)
-
+            delegate = self.planner
+        if delegate is not None:
+            # Delegate OUTSIDE the harness lock: a custom planner may
+            # block (a real plan queue), and holding _plan_lock across
+            # it would serialize every concurrent eval of the test
+            # behind one submit instead of just the bookkeeping append.
+            return delegate.submit_plan(plan)
+        with self._plan_lock:
             index = self.next_index()
             result = PlanResult(
                 node_update=plan.node_update,

@@ -111,6 +111,9 @@ class PlanApplier:
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # The outgoing generation's thread, kept so start() can wait
+        # out its final in-flight commit before spawning a successor.
+        self._draining: Optional[threading.Thread] = None
         self._lifecycle = threading.Lock()  # start/stop can race on
         # leadership flaps (raft elections)
         # Conflict observability (feeds the dispatch pipeline's
@@ -122,24 +125,65 @@ class PlanApplier:
 
     def start(self) -> None:
         with self._lifecycle:
-            self._stop.clear()
+            # Idempotent: a re-confirmed leadership (start without an
+            # intervening stop) must not spawn a second loop — with
+            # per-generation stop events the first one would become
+            # permanently unstoppable.
+            if self._thread is not None and self._thread.is_alive():
+                return
+            draining, self._draining = self._draining, None
+        if draining is not None and draining.is_alive():
+            # Wait out the predecessor's final in-flight commit OUTSIDE
+            # the lock: two live loops would verify plans against
+            # snapshots that miss each other's commits — the serial
+            # verification invariant the single applier exists for.
+            draining.join(timeout=5.0)
+            if draining.is_alive():
+                # Still wedged past the bound: REFUSE to spawn a
+                # concurrent successor. One missing applier stalls the
+                # plan queue visibly; two live ones double-place
+                # silently. The next leadership confirmation retries.
+                with self._lifecycle:
+                    self._draining = draining
+                self.logger.error(
+                    "plan applier predecessor still draining after "
+                    "5s; refusing to start a concurrent loop")
+                return
+        with self._lifecycle:
+            if self._thread is not None and self._thread.is_alive():
+                return  # lost a start/start race while joining
+            # Fresh Event PER thread generation: clearing a shared
+            # event here could race a stop()'s set before the outgoing
+            # thread observed it (stop joins OUTSIDE the lock), leaving
+            # two _run loops alive after a leadership flap.
+            stop = threading.Event()
+            self._stop = stop
             thread = threading.Thread(
-                target=self._run, name="plan-applier", daemon=True
+                target=self._run, args=(stop,), name="plan-applier",
+                daemon=True
             )
             thread.start()
             self._thread = thread
 
     def stop(self) -> None:
+        # Detach under the lock, join outside it: holding _lifecycle
+        # across the join would block a concurrent start() for the
+        # whole drain instead of serializing just the handoff. The
+        # detached thread is remembered in _draining so a prompt
+        # restart waits for its final commit.
         with self._lifecycle:
             self._stop.set()
-            if self._thread is not None:
-                self._thread.join(timeout=5.0)
-                self._thread = None
+            thread, self._thread = self._thread, None
+            if thread is not None:
+                self._draining = thread
+        if thread is not None:
+            thread.join(timeout=5.0)
 
-    def _run(self) -> None:
+    def _run(self, stop: Optional[threading.Event] = None) -> None:
+        stop = stop if stop is not None else self._stop
         inflight = None  # (future, pending) of the in-flight commit
         optimistic: Optional[OptimisticSnapshot] = None
-        while not self._stop.is_set():
+        while not stop.is_set():
             pending = self.plan_queue.dequeue(
                 timeout=0.02 if inflight else 0.25)
             if pending is None:

@@ -81,8 +81,12 @@ class Server:
                 * max(1, self.config.eval_batch_size - 1),
                 # The dispatch pipeline fans a full batch out per
                 # in-flight slot; a pool smaller than that would strand
-                # batch members behind their own batch's dispatch.
-                self.config.eval_batch_size
+                # batch members behind their own batch's dispatch. +1
+                # per slot for the launch prologue itself — it runs on
+                # this pool too (the dispatcher thread must never
+                # block), and its FSM catch-up may stall the full
+                # wait-for-index timeout.
+                (self.config.eval_batch_size + 1)
                 * max(1, self.config.dispatch_max_inflight)))),
             name="eval-batch")
         # Central dispatch pipeline for dense-path evals (dispatch/):

@@ -103,7 +103,7 @@ class Worker:
         self.id = worker_id
         self.logger = logging.getLogger(f"nomad_tpu.worker.{worker_id}")
         self._stop = threading.Event()
-        self._paused = False
+        self._paused = False  # guarded-by: _pause_lock
         self._pause_lock = threading.Lock()
         self._pause_cond = threading.Condition(self._pause_lock)
         self._thread: Optional[threading.Thread] = None

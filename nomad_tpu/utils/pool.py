@@ -72,11 +72,37 @@ class WorkPool:
             # is safe — the ceiling bounds it.
             self._threads = [t for t in self._threads if t.is_alive()]
             if self._queue.qsize() > self._idle and len(self._threads) < self.size:
-                t = threading.Thread(
-                    target=self._work, name=f"{self.name}-{len(self._threads)}",
-                    daemon=True)
-                self._threads.append(t)
-                t.start()
+                # Thread.start can fail under OS thread pressure —
+                # AFTER the item was enqueued. Raising would hand
+                # callers an item that is both "failed" and still due
+                # to run (double accounting in callers' in-flight
+                # tracking); running it inline would block submitters
+                # that must never block (the dispatch pipeline hands
+                # off EXACTLY to avoid that). So: retry once for
+                # transient pressure, else leave the item queued —
+                # qsize() reports it honestly, live workers drain it,
+                # and EVERY future submit re-fires this spawn trigger.
+                for attempt in (0, 1):
+                    t = threading.Thread(
+                        target=self._work,
+                        name=f"{self.name}-{len(self._threads)}",
+                        daemon=True)
+                    try:
+                        t.start()
+                    except RuntimeError:
+                        if attempt:
+                            logger.warning(
+                                "%s: worker spawn failed twice "
+                                "(%d live, %d queued); queued work "
+                                "waits for the next submit's retry",
+                                self.name, len(self._threads),
+                                self._queue.qsize(), exc_info=True)
+                    else:
+                        # Appended only on success: a never-started
+                        # Thread would count toward the size ceiling
+                        # until the next is_alive() prune.
+                        self._threads.append(t)
+                        break
         return fut
 
     def _work(self) -> None:

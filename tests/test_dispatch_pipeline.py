@@ -90,14 +90,28 @@ def test_storm_packs_toward_full_batches():
                 len(server.fsm.state.allocs_by_job(j.id)) == 5
                 for j in jobs),
             timeout=120.0)
+        # Allocs become visible at plan COMMIT, the eval's ack lands
+        # moments later on the stage thread — settle before reading.
+        assert wait_until(
+            lambda: (lambda s: s["acked"] + s["nacked"] == 16
+                     and s["in_flight"] == 0)(server.dispatch.stats()),
+            timeout=10.0), server.dispatch.stats()
         stats = server.dispatch.stats()
-        assert stats["dispatched_evals"] == 16
         assert stats["acked"] == 16
+        # Launch prologues run on stage threads, so an early partial
+        # batch can snapshot before a prior batch's commit lands — a
+        # bounded conflict requeue re-dispatches its eval (exactly once
+        # per requeue), which pre-resolve keeps rare.
+        assert stats["dispatched_evals"] == 16 + stats["requeues"], stats
+        assert stats["requeues"] <= 3, stats
         # The whole storm was ready at release: it must ride a handful
-        # of packed batches, not 16 fragments.
+        # of packed batches, not 16 fragments. Occupancy is the
+        # headline metric (r05 baseline: 9.4 lanes) — asserted
+        # directly, degraded proportionally when a requeue adds a
+        # small follow-up batch (requeues=0 keeps the strict >= 8).
         assert stats["largest_batch"] >= 12, stats
-        assert stats["occupancy"] >= 8.0, stats
-        assert stats["batches"] <= 4, stats
+        assert stats["batches"] <= 4 + stats["requeues"], stats
+        assert stats["occupancy"] >= 16 / (2 + stats["requeues"]), stats
     finally:
         server.shutdown()
 
@@ -421,3 +435,100 @@ def test_agent_metrics_endpoint_exposes_pipeline_stats():
     finally:
         http.stop()
         server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# dispatcher never blocks (ntalint dispatcher-blocking-call regression)
+
+
+def test_dispatcher_keeps_accumulating_while_launch_blocks():
+    """The launch prologue (FSM catch-up via _wait_for_index, up to
+    WAIT_INDEX_TIMEOUT of sleep-polling, then snapshotting) runs on a
+    STAGE thread, never the dispatcher: with the first batch's launch
+    wedged on a lagging follower, the accumulator must keep packing and
+    launching further batches into the remaining in-flight slots.
+
+    Regression for the ntalint `dispatcher-blocking-call` finding: the
+    dispatcher used to call _launch inline, so one stalled catch-up
+    froze every lane for the full timeout."""
+    import threading
+
+    from nomad_tpu.dispatch.pipeline import DispatchPipeline
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.structs import Evaluation
+    from nomad_tpu.utils.pool import WorkPool
+
+    release = threading.Event()
+    stalled = threading.Event()
+
+    class FakeStore:
+        def latest_index(self):
+            return 0
+
+        def snapshot(self):
+            raise AssertionError("snapshot before catch-up released")
+
+    class FakeFSM:
+        state = FakeStore()
+
+    class FakeServer:
+        config = ServerConfig(
+            scheduler_factories={"service": "service-tpu"},
+            eval_batch_size=2,
+            dispatch_max_inflight=2,
+            dispatch_idle_grace=0.002,
+            dispatch_window=0.005,
+        )
+        fsm = FakeFSM()
+        eval_pool = WorkPool(4, name="test-dispatch")
+
+        def __init__(self):
+            self.nacked = []
+
+        def eval_dequeue_many(self, types, max_n):
+            return []
+
+        def eval_ack(self, eval_id, token):
+            pass
+
+        def eval_nack(self, eval_id, token):
+            self.nacked.append(eval_id)
+
+    server = FakeServer()
+    pipeline = DispatchPipeline(server)
+    assert pipeline.enabled
+
+    # Wedge every launch in its FSM catch-up until released (the
+    # follower-lag scenario _wait_for_index exists for).
+    def stalled_wait(index, timeout):
+        stalled.set()
+        release.wait(20.0)
+        return False  # timed out: batch naks, slot frees
+
+    pipeline._wait_for_index = stalled_wait
+    pipeline.start()
+    try:
+        for i in range(4):
+            ev = Evaluation(id=f"ev-{i}", type="service",
+                            job_id=f"job-{i}")
+            ev.modify_index = 7  # ahead of the fake FSM: forces catch-up
+            pipeline.submit(ev, token=f"tok-{i}")
+        assert wait_until(lambda: stalled.is_set(), timeout=5.0)
+        # Both batches must LAUNCH while the first launch is still
+        # blocked: the dispatcher handed off and kept accumulating.
+        assert wait_until(
+            lambda: pipeline.stats()["batches"] == 2, timeout=5.0), \
+            pipeline.stats()
+        assert pipeline.stats()["in_flight"] == 2
+        assert not server.nacked  # still wedged, nothing given up yet
+    finally:
+        # Cleanup ONLY: an assert here would mask the body's failure
+        # and skip stop(), leaking the dispatcher into later tests.
+        release.set()
+        pipeline.stop()
+    # Timed-out catch-up naks all four evals and frees both slots.
+    assert wait_until(
+        lambda: len(server.nacked) == 4
+        and pipeline.stats()["in_flight"] == 0, timeout=10.0), \
+        (server.nacked, pipeline.stats())
+

@@ -47,7 +47,13 @@ def test_delivery_limit_eval_reaped_as_failed(server):
         got, token = server.broker.dequeue([ev.type], timeout=2.0)
         assert got is not None and got.id == ev.id
         server.broker.nack(got.id, token)
-    assert [e.id for e in server.broker.failed_evals()] == [ev.id]
+    # Either still parked in the broker's failed queue, or the reap
+    # loop already won the race and pulled it (that loop IS the thing
+    # under test — it can fire between the last nack and this line).
+    assert wait_until(
+        lambda: [e.id for e in server.broker.failed_evals()] == [ev.id]
+        or ((e2 := server.fsm.state.eval_by_id(ev.id)) is not None
+            and e2.status == consts.EVAL_STATUS_FAILED))
 
     # The reap loop marks it failed through raft and acks it out.
     assert wait_until(

@@ -1,0 +1,648 @@
+"""ntalint (nomad_tpu/analysis): per-rule fixture tests — each rule
+fires exactly where expected (true positive) and stays quiet on the
+sanctioned pattern (true negative) — plus the tier-1 gate: the whole
+`nomad_tpu/` tree must be clean modulo the committed baseline, the
+baseline must be non-growing (no stale entries), and the dirs the
+concurrency core lives in (dispatch/, scheduler/, ops/, parallel/)
+must carry NO baseline entries at all: findings there are fixed, not
+recorded."""
+
+import json
+import os
+import subprocess
+import sys
+
+from nomad_tpu.analysis import (
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+)
+from nomad_tpu.analysis.core import repo_root
+
+REPO = repo_root()
+
+
+def run_on(tmp_path, source, name="mod.py", subdir=""):
+    d = tmp_path / subdir if subdir else tmp_path
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(source)
+    return analyze_paths([str(f)])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def lines_of(findings, rule):
+    return [f.line for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------
+# lock discipline: guarded-by
+
+
+GUARDED_BAD = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.count = 0  # guarded-by: _lock
+        self.free = 0
+
+    def bump(self):
+        self.count += 1
+
+    def peek(self):
+        return self.count
+"""
+
+GUARDED_GOOD = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.count = 0  # guarded-by: _lock
+        self.free = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+        self.free += 1
+
+    def bump_via_cond(self):
+        # Condition(self._lock) aliases the lock: holding the cond IS
+        # holding the lock.
+        with self._cond:
+            self.count += 1
+"""
+
+
+def test_guarded_by_fires_on_unlocked_access(tmp_path):
+    findings = run_on(tmp_path, GUARDED_BAD)
+    assert rules_of(findings) == ["guarded-by", "guarded-by"]
+    assert lines_of(findings, "guarded-by") == [11, 14]
+
+
+def test_guarded_by_quiet_under_lock_and_cond_alias(tmp_path):
+    assert run_on(tmp_path, GUARDED_GOOD) == []
+
+
+def test_guarded_by_inline_suppression(tmp_path):
+    src = GUARDED_BAD.replace(
+        "        self.count += 1",
+        "        self.count += 1  # nta: disable=guarded-by", 1)
+    findings = run_on(tmp_path, src)
+    assert lines_of(findings, "guarded-by") == [14]
+
+
+# ---------------------------------------------------------------------
+# lock discipline: blocking call under a lock
+
+
+LOCK_BLOCKING_BAD = """\
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Event()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def foreign_wait(self):
+        with self._lock:
+            self._other.wait()
+"""
+
+LOCK_BLOCKING_GOOD = """\
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def parked(self):
+        # cond.wait on the HELD cond's own lock releases it: exempt.
+        with self._cond:
+            self._cond.wait(0.5)
+
+    def slow(self):
+        time.sleep(0.5)  # no lock held: fine
+"""
+
+
+def test_lock_blocking_fires_inside_lock(tmp_path):
+    findings = run_on(tmp_path, LOCK_BLOCKING_BAD)
+    assert rules_of(findings) == ["lock-blocking-call"] * 2
+    assert lines_of(findings, "lock-blocking-call") == [11, 15]
+
+
+def test_lock_blocking_quiet_on_own_cond_wait(tmp_path):
+    assert run_on(tmp_path, LOCK_BLOCKING_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# lock discipline: dispatcher-thread entrypoints never block
+
+
+DISPATCHER_BAD = """\
+import threading
+import time
+
+NTA_DISPATCHER_ENTRYPOINTS = ("Pipe._run",)
+
+class Pipe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def _run(self):
+        while True:
+            self._accumulate()
+            self._launch()
+
+    def _accumulate(self):
+        with self._cond:
+            self._cond.wait(0.1)
+
+    def _launch(self):
+        self._wait_for_index(7)
+
+    def _wait_for_index(self, index):
+        time.sleep(0.01)
+"""
+
+DISPATCHER_GOOD = """\
+import threading
+import time
+
+NTA_DISPATCHER_ENTRYPOINTS = ("Pipe._run",)
+
+class Pipe:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.pool = pool
+
+    def _run(self):
+        while True:
+            self._accumulate()
+            # handed to a stage thread, not called: not followed
+            self.pool.submit(self._launch)
+
+    def _accumulate(self):
+        with self._cond:
+            self._cond.wait(0.1)
+
+    def _launch(self):
+        self._wait_for_index(7)
+
+    def _wait_for_index(self, index):
+        time.sleep(0.01)
+"""
+
+
+def test_dispatcher_blocking_fires_through_call_chain(tmp_path):
+    findings = run_on(tmp_path, DISPATCHER_BAD)
+    assert rules_of(findings) == ["dispatcher-blocking-call"]
+    # the sleep inside _wait_for_index, reached via _run -> _launch
+    assert findings[0].symbol == "Pipe._wait_for_index"
+    assert findings[0].line == 24
+
+
+def test_dispatcher_quiet_when_blocking_moves_to_stage_thread(tmp_path):
+    assert run_on(tmp_path, DISPATCHER_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# trace purity: impure calls
+
+
+IMPURE_BAD = """\
+import random
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x * random.random() + time.time()
+"""
+
+IMPURE_GOOD = """\
+import random
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x, key):
+    return x + jax.random.uniform(key, x.shape)
+
+def host(rng):
+    # not traced: host-side RNG is fine
+    return random.Random(7).random() + rng.getrandbits(31)
+"""
+
+
+def test_impure_call_fires_in_traced_fn(tmp_path):
+    findings = run_on(tmp_path, IMPURE_BAD)
+    assert rules_of(findings) == ["trace-impure-call"] * 2
+
+
+def test_impure_quiet_on_jax_random_and_host_code(tmp_path):
+    assert run_on(tmp_path, IMPURE_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# trace purity: host sync
+
+
+HOST_SYNC_BAD = """\
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    y = np.asarray(x)
+    return float(x) + y.sum()
+"""
+
+HOST_SYNC_GOOD = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def f(x):
+    n = float(x.shape[0])  # shape is static under trace
+    return jnp.asarray(x) * n
+
+def host(x):
+    return np.asarray(x)  # not traced
+"""
+
+
+def test_host_sync_fires_on_numpy_and_float(tmp_path):
+    findings = run_on(tmp_path, HOST_SYNC_BAD)
+    assert rules_of(findings) == ["trace-host-sync"] * 2
+
+
+def test_host_sync_quiet_on_shapes_and_host_code(tmp_path):
+    assert run_on(tmp_path, HOST_SYNC_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# trace purity: closure mutation
+
+
+CLOSURE_BAD = """\
+import jax
+
+class Kernel:
+    def run(self, xs):
+        hits = []
+
+        def body(carry, x):
+            hits.append(x)
+            self.calls = 1
+            return carry + x, x
+
+        return jax.lax.scan(body, 0.0, xs)
+"""
+
+CLOSURE_GOOD = """\
+import jax
+
+def run(xs):
+    def body(carry, x):
+        acc = []
+        acc.append(x)  # local: trace-time only but self-contained
+        return carry + x, x
+
+    return jax.lax.scan(body, 0.0, xs)
+"""
+
+
+def test_closure_mutation_fires(tmp_path):
+    findings = run_on(tmp_path, CLOSURE_BAD)
+    assert sorted(rules_of(findings)) == ["trace-closure-mutation"] * 2
+
+
+def test_closure_mutation_quiet_on_locals(tmp_path):
+    assert run_on(tmp_path, CLOSURE_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# trace purity: python branch on traced values
+
+
+BRANCH_BAD = """\
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+"""
+
+BRANCH_GOOD = """\
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    if cfg:  # static arg: branch resolves at trace time
+        return jnp.where(x > 0, x, -x)
+    n = x.shape[0]
+    if n > 2:  # shape-derived: static under trace
+        return x
+    return -x
+"""
+
+
+def test_branch_fires_on_traced_test(tmp_path):
+    findings = run_on(tmp_path, BRANCH_BAD)
+    assert rules_of(findings) == ["trace-python-branch"]
+
+
+def test_branch_quiet_on_static_and_shape_tests(tmp_path):
+    assert run_on(tmp_path, BRANCH_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# trace purity: unhashable static args at jit call sites
+
+
+STATIC_BAD = """\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    return x
+
+def caller(x):
+    return f(x, cfg=[1, 2])
+"""
+
+STATIC_GOOD = """\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg):
+    return x
+
+def caller(x, cfg):
+    f(x, cfg=(1, 2))
+    return f(x, cfg)
+"""
+
+
+def test_unhashable_static_fires_on_list_literal(tmp_path):
+    findings = run_on(tmp_path, STATIC_BAD)
+    assert rules_of(findings) == ["jit-unhashable-static"]
+
+
+def test_unhashable_static_quiet_on_tuple_and_names(tmp_path):
+    assert run_on(tmp_path, STATIC_GOOD) == []
+
+
+# ---------------------------------------------------------------------
+# snapshot discipline
+
+
+SNAPSHOT_BAD = """\
+class Sched:
+    def plan(self):
+        nodes = self.server.fsm.state.nodes()
+        store = self.server.fsm.state
+        return nodes, store
+"""
+
+SNAPSHOT_GOOD = """\
+class Sched:
+    def plan(self):
+        snap = self.server.fsm.state.snapshot()
+        idx = self.server.fsm.state.latest_index()
+        return snap.nodes(), idx
+"""
+
+
+def test_live_state_read_fires_in_scheduler_dir(tmp_path):
+    findings = run_on(tmp_path, SNAPSHOT_BAD, subdir="scheduler")
+    assert rules_of(findings) == ["live-state-read"] * 2
+
+
+def test_live_state_quiet_on_snapshot_handles(tmp_path):
+    assert run_on(tmp_path, SNAPSHOT_GOOD, subdir="dispatch") == []
+
+
+def test_live_state_out_of_scope_dirs_ignored(tmp_path):
+    # the rule is scoped: server-side code MAY touch the live store
+    assert run_on(tmp_path, SNAPSHOT_BAD, subdir="server") == []
+
+
+# ---------------------------------------------------------------------
+# baseline machinery
+
+
+def test_apply_baseline_absorbs_and_reports_stale(tmp_path):
+    findings = run_on(tmp_path, GUARDED_BAD)
+    assert len(findings) == 2
+    baseline = [
+        {"rule": "guarded-by", "path": findings[0].path,
+         "symbol": "C.bump", "count": 1},
+        {"rule": "guarded-by", "path": findings[0].path,
+         "symbol": "C.gone_function", "count": 1},
+    ]
+    new, stale = apply_baseline(findings, baseline)
+    # C.bump absorbed; C.peek is new; C.gone_function is stale
+    assert [f.symbol for f in new] == ["C.peek"]
+    assert [e["symbol"] for e in stale] == ["C.gone_function"]
+
+
+def test_apply_baseline_count_is_a_ceiling(tmp_path):
+    findings = run_on(tmp_path, GUARDED_BAD)
+    path = findings[0].path
+    baseline = [{"rule": "guarded-by", "path": path, "symbol": "C.bump",
+                 "count": 3}]
+    new, stale = apply_baseline(findings, baseline)
+    assert [f.symbol for f in new] == ["C.peek"]
+    # over-budgeted entry is partially stale (2 of 3 unused)
+    assert stale and stale[0].get("stale_count") == 2
+
+
+# ---------------------------------------------------------------------
+# the tier-1 gate: whole tree clean modulo baseline, baseline
+# non-growing, concurrency-core dirs baseline-free
+
+
+CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
+             "nomad_tpu/ops/", "nomad_tpu/parallel/")
+
+
+def _tree_findings():
+    return analyze_paths([os.path.join(REPO, "nomad_tpu")])
+
+
+def test_tree_is_clean_modulo_baseline():
+    findings = _tree_findings()
+    new, _stale = apply_baseline(findings, load_baseline())
+    assert not new, "ntalint findings (fix or baseline):\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_baseline_is_non_growing():
+    """Every committed baseline entry must still match a real finding:
+    fixing a finding must delete its entry, or the baseline quietly
+    becomes a grant of future regressions."""
+    findings = _tree_findings()
+    _new, stale = apply_baseline(findings, load_baseline())
+    assert not stale, f"stale baseline entries (delete them): {stale}"
+
+
+def test_concurrency_core_has_no_baseline_entries():
+    """dispatch/, scheduler/, ops/, parallel/ — where the dispatcher
+    threads, the batcher, and the jitted kernels live — must be
+    actually clean: no recorded debt, no inline suppressions hiding
+    real findings behind the baseline."""
+    for ent in load_baseline():
+        assert not ent["path"].startswith(CORE_DIRS), (
+            f"baseline entry in a must-be-clean dir: {ent}")
+
+
+# ---------------------------------------------------------------------
+# CLI
+
+
+def test_cli_json_mode(tmp_path):
+    f = tmp_path / "fix.py"
+    f.write_text(GUARDED_BAD)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ntalint.py"),
+         "--json", "--no-baseline", str(f)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1, res.stderr
+    out = json.loads(res.stdout)
+    assert [e["rule"] for e in out["findings"]] == ["guarded-by"] * 2
+    assert {"rule", "path", "line", "col", "symbol", "message"} <= set(
+        out["findings"][0])
+
+
+def test_apply_baseline_duplicate_key_entries_pool_counts(tmp_path):
+    """Two baseline entries sharing one (rule, path, symbol) pool
+    their counts: with both absorbed, NEITHER is stale — reporting the
+    sibling stale would tell the maintainer to delete coverage for a
+    live finding."""
+    findings = run_on(tmp_path, GUARDED_BAD)
+    bump = [f for f in findings if f.symbol == "C.bump"]
+    peek = [f for f in findings if f.symbol == "C.peek"]
+    assert len(bump) == 1 and len(peek) == 1
+    path = findings[0].path
+    baseline = [
+        {"rule": "guarded-by", "path": path, "symbol": "C.bump",
+         "count": 1},
+        {"rule": "guarded-by", "path": path, "symbol": "C.peek",
+         "count": 1},
+        # duplicate key for C.bump: pooled, not double-reported
+        {"rule": "guarded-by", "path": path, "symbol": "C.bump",
+         "count": 1},
+    ]
+    new, stale = apply_baseline(findings, baseline)
+    assert new == []
+    # the duplicated C.bump key has budget 2 for 1 finding: partially
+    # stale, reported ONCE
+    assert len(stale) == 1 and stale[0]["symbol"] == "C.bump"
+    assert stale[0].get("stale_count") == 1
+
+
+BRANCH_CLOSURE_BAD = """\
+import jax
+
+@jax.jit
+def outer(x):
+    def body(c, t):
+        if x[0] > 0:  # closed-over traced value
+            return c + t, t
+        return c, t
+
+    return jax.lax.scan(body, 0.0, x)
+"""
+
+BRANCH_CLOSURE_GOOD = """\
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def outer(x, cfg):
+    n = x.shape[0]
+
+    def body(c, t):
+        if cfg:  # closed-over STATIC: resolves at trace time
+            return c + t, t
+        return c, t
+
+    return jax.lax.scan(body, jnp.zeros(n)[0], x)
+"""
+
+
+def test_branch_fires_on_closed_over_traced_value(tmp_path):
+    """A nested scan body branching on its outer jitted function's
+    array is the flagship bug — closure capture must not launder a
+    traced value into a 'module global'."""
+    findings = run_on(tmp_path, BRANCH_CLOSURE_BAD)
+    assert rules_of(findings) == ["trace-python-branch"]
+
+
+def test_branch_quiet_on_closed_over_static(tmp_path):
+    assert run_on(tmp_path, BRANCH_CLOSURE_GOOD) == []
+
+
+def test_suppression_on_opening_line_covers_inner_lines(tmp_path):
+    """The opening-line suppression of a multi-line statement applies
+    even when an inner line carries its own different-rule disable
+    comment (union, not first-match)."""
+    src = """\
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guarded-by: _lock
+
+    def bump(self):
+        x = (  # nta: disable=guarded-by
+            self.count
+            + 1  # nta: disable=lock-blocking-call
+        )
+        return x
+"""
+    assert run_on(tmp_path, src) == []
+
+
+def test_syntax_error_reported_as_parse_error_finding(tmp_path):
+    """A file that does not parse (mid-edit working tree under --diff)
+    must surface as a `parse-error` finding, not a traceback — exit 1
+    with a rendered location, distinguishable from a tool crash."""
+    findings = run_on(tmp_path, "def broken(:\n    pass\n")
+    assert rules_of(findings) == ["parse-error"]
+    assert findings[0].line == 1
+    # valid files analyzed alongside are unaffected
+    good = tmp_path / "ok.py"
+    good.write_text(GUARDED_GOOD)
+    findings = analyze_paths([str(tmp_path)])
+    assert rules_of(findings) == ["parse-error"]

@@ -173,3 +173,72 @@ def test_pool_workers_are_reused():
     for _ in range(20):
         pool.submit(lambda: None).result(5.0)
     assert pool.worker_count() <= 2
+
+
+def test_pool_submit_survives_thread_spawn_failure(monkeypatch):
+    """submit() enqueues BEFORE spawning, so a Thread.start failure
+    (OS thread pressure) must not raise to the caller — the item is
+    already due to run, and raising would hand call sites an item that
+    is both 'failed' and still executing (double accounting in the
+    dispatch pipeline's slot tracking). The item drains via live
+    workers, or via the retried spawn on the next submit."""
+    import threading
+
+    pool = WorkPool(3, name="p-spawnfail")
+    # Warm one live worker so the queued item has a drain path.
+    pool.submit(lambda: None).wait(5.0)
+
+    real_start = threading.Thread.start
+    fails = {"n": 0}
+
+    def flaky_start(self):
+        if self.name.startswith("p-spawnfail") and fails["n"] == 0:
+            fails["n"] += 1
+            raise RuntimeError("can't start new thread")
+        return real_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", flaky_start)
+    # Saturate the live worker, then submit while a spawn would fire.
+    gate = threading.Event()
+    blocked = pool.submit(gate.wait, 10.0)
+    fut = pool.submit(lambda: 42)  # spawn fails here — must NOT raise
+    assert fails["n"] == 1
+    gate.set()
+    assert blocked.wait(5.0)
+    assert fut.result(5.0) == 42  # the enqueued item still ran
+    # A later submit retries the spawn successfully.
+    assert pool.submit(lambda: 7).result(5.0) == 7
+
+
+
+def test_pool_cold_spawn_failure_leaves_item_queued(monkeypatch):
+    """Zero live workers + persistent spawn failure: submit must not
+    raise, must not run the task inline (a never-block submitter like
+    the dispatch pipeline's dispatcher would block), and must not drop
+    it — the item stays honestly queued and the NEXT submit's spawn
+    retry drains it."""
+    import threading
+
+    pool = WorkPool(2, name="p-coldfail")
+    real_start = threading.Thread.start
+    fails = {"n": 0}
+
+    def flaky_start(self):
+        # Both attempts (initial + immediate retry) of the first
+        # submit fail; later spawns succeed.
+        if self.name.startswith("p-coldfail") and fails["n"] < 2:
+            fails["n"] += 1
+            raise RuntimeError("can't start new thread")
+        return real_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", flaky_start)
+    order = []
+    first = pool.submit(order.append, "first")
+    assert fails["n"] == 2
+    assert not first.done()  # queued, NOT run inline on this thread
+    assert pool.queued() == 1
+    # The next submit re-fires the spawn trigger; one worker drains
+    # both items in FIFO order.
+    second = pool.submit(order.append, "second")
+    assert first.wait(5.0) and second.wait(5.0)
+    assert order == ["first", "second"]

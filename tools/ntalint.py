@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""ntalint CLI — run the nomad_tpu static-analysis suite.
+
+Usage:
+    python tools/ntalint.py nomad_tpu/              # full tree
+    python tools/ntalint.py --diff                  # changed files only
+    python tools/ntalint.py --json nomad_tpu/ops    # machine-readable
+    python tools/ntalint.py --write-baseline nomad_tpu/
+
+Exit codes: 0 = no non-baselined findings (stale baseline entries are
+reported but do not fail the CLI; the tier-1 test DOES fail on them so
+fixed findings leave the baseline), 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+from nomad_tpu.analysis import (  # noqa: E402
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _git_changed_files() -> list:
+    """Tracked-changed + untracked .py files under nomad_tpu/."""
+    out = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(cmd, cwd=_ROOT, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode != 0:
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py") and line.startswith("nomad_tpu/"):
+                path = os.path.join(_ROOT, line)
+                if os.path.exists(path):
+                    out.append(path)
+    return sorted(set(out))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ntalint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: nomad_tpu/)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--diff", action="store_true",
+                        help="analyze only files changed vs git HEAD "
+                             "(plus untracked)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "nomad_tpu/analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current "
+                             "findings and exit 0")
+    parser.add_argument("--rule", action="append", default=None,
+                        help="restrict to specific rule(s)")
+    args = parser.parse_args(argv)
+
+    if args.diff:
+        paths = _git_changed_files()
+        if not paths:
+            if args.json:
+                # Same schema as the analyzed path (consumers read
+                # total_raw unconditionally), plus the files count.
+                print(json.dumps({"findings": [], "stale_baseline": [],
+                                  "total_raw": 0, "files": 0}))
+            else:
+                print("ntalint: no changed python files under "
+                      "nomad_tpu/")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(_ROOT, "nomad_tpu")]
+
+    rules = set(args.rule) if args.rule else None
+    findings = analyze_paths(paths, rules=rules)
+
+    if args.write_baseline:
+        path = write_baseline(findings, args.baseline)
+        print(f"ntalint: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        new, stale = apply_baseline(findings,
+                                    load_baseline(args.baseline))
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "stale_baseline": stale,
+            "total_raw": len(findings),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for ent in stale:
+            print(f"ntalint: STALE baseline entry (finding fixed — "
+                  f"delete it): {ent}")
+        if new:
+            print(f"ntalint: {len(new)} finding(s)")
+        else:
+            print("ntalint: clean")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
