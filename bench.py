@@ -261,8 +261,21 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     penalty = 5.0 if job.type == "batch" else 10.0
     config = PlacementConfig(anti_affinity_penalty=penalty,
                              pre_resolve=pre_resolve)
+    from nomad_tpu.chaos import chaos
+
     batcher = PlacementBatcher()
     sched_stub = SimpleNamespace(eval=SimpleNamespace(id="bench"), job=job)
+    # Degraded-mode harness (--chaos): an injected device fault fails a
+    # whole batch; each member retries its place() — the live dense
+    # scheduler falls back to the host path instead, but the bench's
+    # evals must stay on the device path to keep measuring it, so here
+    # a retry IS the recovery and gets counted (under a lock: a failed
+    # batch fails all its pool threads at once and += is not atomic
+    # across a GIL switch).
+    import threading
+
+    device_retries = [0]
+    retry_lock = threading.Lock()
     if workers is None:
         # The live drain-to-batch path processes a drained group fully
         # concurrently (server/worker.py submits the whole group to the
@@ -276,8 +289,16 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         rng_local = random.Random(seed)
         matrix = ClusterMatrix(snap, job)
         asks = make_asks(*matrix.build_asks(tg_cycle))
-        choices, scores = batcher.place(
-            matrix, asks, host_prng_key(seed), config)
+        for attempt in range(3):
+            try:
+                choices, scores = batcher.place(
+                    matrix, asks, host_prng_key(seed), config)
+                break
+            except Exception:
+                if not chaos.enabled or attempt == 2:
+                    raise
+                with retry_lock:
+                    device_retries[0] += 1
         choices = np.asarray(choices)
         scores = np.asarray(scores)
         plan = Plan(job=job)
@@ -388,6 +409,7 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         dstats["batched_requests"] / dstats["dispatches"]
         if dstats.get("dispatches") else 0.0)
     dstats["conflicts_per_eval"] = conflicted_evals / n_evals
+    dstats["device_retries"] = device_retries[0]
     return (n_evals / elapsed, float(np.percentile(latencies, 99)),
             dstats)
 
@@ -493,6 +515,7 @@ def config_4():
         "occupancy": ds["occupancy"],
         "retries_per_eval": ds["conflicts_per_eval"],
         "retries_per_eval_nopre": ds_off["conflicts_per_eval"],
+        "device_retries": ds["device_retries"] + ds_off["device_retries"],
     }
 
 
@@ -922,6 +945,70 @@ def run_config(n, reps=DEFAULT_REPS):
     return out
 
 
+def run_chaos(seed, reps=1):
+    """Degraded-mode A/B of config 4 (the north-star cluster shape):
+    one clean pass, then the same pass under a mild seeded fault
+    schedule — device-dispatch latency jitter plus a forced device
+    fault burst — reporting occupancy and retries/eval side by side so
+    BENCH_r07.json records what the dense path delivers while faults
+    fire. Refuses to emit numbers if any scheduled fault never fired
+    (a schedule that missed its path measured nothing: typo guard)."""
+    from nomad_tpu.chaos import FaultSpec, chaos
+
+    clean = [CONFIGS[HEADLINE_CONFIG]() for _ in range(reps)]
+    schedule = [
+        # Mild: a congested tunnel adds ~20ms to a quarter of device
+        # dispatches...
+        FaultSpec("batcher.dispatch", "delay", delay=0.02, prob=0.25,
+                  count=64),
+        # ...and two dispatches fail outright (whole-batch retry).
+        FaultSpec("binpack.device", "error", count=2, start=6),
+    ]
+    chaos.arm(seed, schedule)
+    try:
+        degraded = [CONFIGS[HEADLINE_CONFIG]() for _ in range(reps)]
+        unfired = chaos.unfired()
+        fired = len(chaos.firing_log())
+    finally:
+        chaos.disarm()
+    if unfired:
+        for spec in unfired:
+            print(f"bench: scheduled fault never fired: {spec.to_dict()}",
+                  file=sys.stderr)
+        print("bench: REFUSING to emit chaos numbers — the schedule did "
+              "not exercise its sites (typo or unreachable path)",
+              file=sys.stderr)
+        sys.exit(2)
+
+    def med(runs, key):
+        return float(np.median([r[key] for r in runs if key in r]))
+
+    return {
+        "metric": (
+            f"[config {HEADLINE_CONFIG} +chaos seed={seed}] degraded-mode"
+            f" A/B: clean e2e={med(clean, 'e2e'):.1f} evals/s occ="
+            f"{med(clean, 'occupancy'):.1f}; chaos e2e="
+            f"{med(degraded, 'e2e'):.1f} occ="
+            f"{med(degraded, 'occupancy'):.1f}, "
+            f"{fired} faults fired"
+        ),
+        "chaos_seed": seed,
+        "faults_fired": fired,
+        "clean": {
+            "e2e": round(med(clean, "e2e"), 1),
+            "occupancy": round(med(clean, "occupancy"), 2),
+            "retries_per_eval": round(med(clean, "retries_per_eval"), 4),
+            "device_retries": int(med(clean, "device_retries")),
+        },
+        "chaos": {
+            "e2e": round(med(degraded, "e2e"), 1),
+            "occupancy": round(med(degraded, "occupancy"), 2),
+            "retries_per_eval": round(med(degraded, "retries_per_eval"), 4),
+            "device_retries": int(med(degraded, "device_retries")),
+        },
+    }
+
+
 def ntalint_purity_gate():
     """Trace-purity findings in the kernel path (ops/, scheduler/)
     invalidate dense-path numbers BY CONSTRUCTION: an impure call or a
@@ -965,6 +1052,11 @@ def main():
                         help="run the ntalint trace-purity gate over "
                              "ops/ and scheduler/ first; refuse to "
                              "report dense-path numbers on findings")
+    parser.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                        help="run config 4 clean AND under a mild seeded "
+                             "fault schedule (nomad_tpu/chaos); reports "
+                             "degraded-mode occupancy + retries/eval "
+                             "alongside the clean numbers")
     args = parser.parse_args()
 
     if args.check:
@@ -978,6 +1070,10 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         print("bench: ntalint trace-purity gate clean", file=sys.stderr)
+
+    if args.chaos is not None:
+        print(json.dumps(run_chaos(args.chaos)))
+        return
 
     if args.all:
         for n in sorted(CONFIGS):

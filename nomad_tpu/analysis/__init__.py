@@ -10,6 +10,9 @@ Three checker families, run over `nomad_tpu/` as a tier-1 test
   mutation, Python branching on traced values, unhashable static args.
 - ``snapshot`` — scheduler/dispatch modules read cluster state only
   through StateStore.snapshot() handles, never the live store.
+- ``robustness`` — no unbounded waits in server//dispatch/ and no
+  silently-swallowed broad exceptions in server//dispatch//client/
+  (the failure classes nomad_tpu/chaos fault injection hunts).
 """
 
 from .core import (  # noqa: F401
@@ -31,4 +34,6 @@ ALL_RULES = (
     "trace-python-branch",
     "jit-unhashable-static",
     "live-state-read",
+    "unbounded-wait",
+    "swallowed-exception",
 )

@@ -1,0 +1,109 @@
+"""Robustness checkers: waits that can hang forever and exception
+handlers that hide faults.
+
+Two rules, both scoped to the control-plane dirs where fault injection
+(nomad_tpu/chaos) hunts — an unbounded wait turns an injected fault
+into a hung thread instead of a recovered one, and a swallowed
+exception is exactly how injection findings hide:
+
+- ``unbounded-wait`` (``server/`` and ``dispatch/``): a no-argument
+  ``.wait()`` / ``.get()`` / ``.join()`` call blocks forever with no
+  shutdown re-check; every such wait must be bounded (pass a timeout
+  and re-check stop/shutdown in a loop). ``dict.get`` is untouched —
+  it always takes at least one argument.
+
+- ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``):
+  an ``except Exception:`` / ``except BaseException:`` / bare
+  ``except:`` whose entire body is ``pass`` (or ``...``). Either
+  narrow the exception type, log it, or suppress explicitly with
+  ``# nta: disable=swallowed-exception`` and a justification. Handlers
+  for SPECIFIC exception types (``except ValueError: pass``) are a
+  deliberate protocol and stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Module
+
+RULE_UNBOUNDED_WAIT = "unbounded-wait"
+RULE_SWALLOWED = "swallowed-exception"
+
+WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/")
+SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/")
+
+# Attribute calls that block forever when called with no timeout.
+UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}
+BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _in_scope(rel_path: str, markers) -> bool:
+    p = "/" + rel_path
+    return any(m in p for m in markers)
+
+
+def _check_unbounded_waits(mod: Module, findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr not in UNBOUNDED_WAIT_ATTRS:
+            continue
+        if node.args or node.keywords:
+            continue  # a timeout (or any bound) was passed
+        findings.append(Finding(
+            RULE_UNBOUNDED_WAIT, mod.rel, node.lineno, node.col_offset,
+            f"unbounded '.{func.attr}()' — pass a timeout and re-check "
+            f"shutdown in a loop (a wedged peer pins this thread forever)",
+            mod.symbol_of(node)))
+
+
+def _is_silent_body(body: List[ast.stmt]) -> bool:
+    """True when the handler body does nothing: a single `pass`, or a
+    single bare `...` expression."""
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    typ = handler.type
+    if typ is None:
+        return True  # bare except:
+    if isinstance(typ, ast.Name):
+        return typ.id in BROAD_EXC_NAMES
+    if isinstance(typ, ast.Tuple):
+        return any(isinstance(el, ast.Name) and el.id in BROAD_EXC_NAMES
+                   for el in typ.elts)
+    return False
+
+
+def _check_swallowed(mod: Module, findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or not _is_silent_body(node.body):
+            continue
+        findings.append(Finding(
+            RULE_SWALLOWED, mod.rel, node.lineno, node.col_offset,
+            "broad exception silently swallowed — narrow the type, log "
+            "it, or '# nta: disable=swallowed-exception' with a reason",
+            mod.symbol_of(node)))
+
+
+def check(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    if _in_scope(mod.rel, WAIT_SCOPE_MARKERS):
+        _check_unbounded_waits(mod, findings)
+    if _in_scope(mod.rel, SWALLOW_SCOPE_MARKERS):
+        _check_swallowed(mod, findings)
+    return findings

@@ -1,0 +1,16 @@
+"""Deterministic fault injection for the dispatch/broker/raft path.
+
+See registry.py for the site table and semantics; tests/test_chaos_soak.py
+for the soak harness; README.md "Failure model" for the operator view.
+"""
+
+from .registry import (  # noqa: F401
+    DELAY,
+    DROP,
+    ERROR,
+    KNOWN_SITES,
+    ChaosInjectedError,
+    ChaosRegistry,
+    FaultSpec,
+    chaos,
+)

@@ -236,10 +236,18 @@ class ClientAgent:
             self.servers.set_servers(merged)
 
     def _heartbeat_loop(self) -> None:
+        from ..chaos import chaos
+
         while not self._stop.is_set():
             interval = max(self.heartbeat_ttl / 2.0, 0.05)
             if self._stop.wait(interval):
                 return
+            if chaos.enabled and chaos.fire(
+                    "client.heartbeat", node=self.node.id) == "drop":
+                # Injected heartbeat loss: the renewal never reaches the
+                # leader; enough consecutive drops expire the TTL and
+                # the node goes down through the normal path.
+                continue
             try:
                 self.heartbeat_ttl = self.api.nodes.heartbeat(
                     self.node.id, self.node.secret_id
@@ -258,9 +266,13 @@ class ClientAgent:
                         self.node.id, consts.NODE_STATUS_READY
                     )
                 except APIError:
-                    pass
-            except Exception:
-                pass  # unexpected; retry next tick
+                    self.logger.warning(
+                        "re-registration failed; retrying next tick",
+                        exc_info=True)
+            except Exception:  # noqa: BLE001 - loop must survive
+                self.logger.warning(
+                    "heartbeat failed unexpectedly; retrying next tick",
+                    exc_info=True)
 
     def _fingerprint_loop(self) -> None:
         """Periodic re-run of dynamic fingerprints (client.go:739):
@@ -279,7 +291,9 @@ class ClientAgent:
                     self.api.nodes.update_status(
                         self.node.id, consts.NODE_STATUS_READY)
                 except Exception:  # noqa: BLE001 - next heartbeat retries
-                    pass
+                    self.logger.debug(
+                        "fingerprint re-registration failed; the next "
+                        "heartbeat re-registers", exc_info=True)
 
     def _watch_allocations(self) -> None:
         """Blocking-query loop on this node's allocations; apply the
@@ -550,7 +564,9 @@ class ClientAgent:
                 if val is not None:
                     return val
             except Exception:  # noqa: BLE001 - consul down is soft
-                pass
+                self.logger.debug(
+                    "consul KV read for %r failed; using client options",
+                    path, exc_info=True)
         return (self.config.options or {}).get(f"template.kv.{path}")
 
     def _mark_dirty(self, alloc: Allocation) -> None:

@@ -14,7 +14,6 @@ import socket
 import subprocess
 import sys
 import threading
-import time
 from typing import Optional
 
 from ...structs import Task
@@ -294,13 +293,20 @@ def launch_executor(ctx: TaskContext, task: Task, *, rlimit_as: Optional[int] = 
         start_new_session=True,
         close_fds=True,
     )
-    # The executor daemonizes itself (setsid); wait for its socket.
-    # Generous deadline: a burst of concurrent task starts forks many
-    # executors from a large parent (the agent may hold a TPU runtime),
-    # and under that load 15s was observed to miss on real hardware.
-    deadline = time.monotonic() + 60.0
+    # The executor daemonizes itself (setsid); wait for its socket
+    # under jittered backoff (utils/backoff.py): fast first probes for
+    # the common sub-100ms startup, widening toward 250ms so a burst of
+    # concurrent launches doesn't poll-storm the filesystem. Generous
+    # deadline: a burst of concurrent task starts forks many executors
+    # from a large parent (the agent may hold a TPU runtime), and under
+    # that load 15s was observed to miss on real hardware.
+    from ...utils.backoff import Backoff
+
+    bo = Backoff(base=0.01, factor=1.5, max_delay=0.25, deadline=60.0)
+    first = True
     last_err: Optional[Exception] = None
-    while time.monotonic() < deadline:
+    while first or bo.sleep():
+        first = False
         if os.path.exists(sock_path):
             client = ExecutorClient(sock_path)
             try:
@@ -326,7 +332,6 @@ def launch_executor(ctx: TaskContext, task: Task, *, rlimit_as: Optional[int] = 
                 )
             except (OSError, ValueError):
                 raise RuntimeError("executor exited before serving") from last_err
-        time.sleep(0.05)
     # Reap the slow starter: without this a retry would race a second
     # copy of the task against the one this executor eventually starts.
     # The executor and its child each run setsid, so kill both groups.

@@ -11,11 +11,13 @@ exercises eval -> plan -> commit -> client status end-to-end
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, Optional
 
 from .. import mock
+from ..chaos import chaos
 from ..state import watch
 from ..structs import Node, TaskState, consts
 
@@ -24,6 +26,7 @@ class MockClient:
     def __init__(self, server, node: Optional[Node] = None,
                  complete_after: Optional[float] = None):
         self.server = server
+        self.logger = logging.getLogger("nomad_tpu.mock_client")
         self.node = node or mock.node()
         # How long a "task" runs before completing (batch semantics);
         # None means run forever (service semantics).
@@ -59,12 +62,16 @@ class MockClient:
             interval = max(self.heartbeat_ttl / 2.0, 0.05)
             if self._stop.wait(interval):
                 return
+            if chaos.enabled and chaos.fire(
+                    "client.heartbeat", node=self.node.id) == "drop":
+                continue  # injected heartbeat loss (see client/agent.py)
             try:
                 self.heartbeat_ttl = self.server.node_heartbeat(
                     self.node.id, self.node.secret_id
                 )
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 - loop must survive
+                self.logger.debug(
+                    "heartbeat failed; retrying next tick", exc_info=True)
 
     def _watch_allocs(self) -> None:
         """Long-poll on this node's alloc scope; sync changed allocs'
@@ -130,5 +137,7 @@ class MockClient:
         if updates:
             try:
                 self.server.node_update_allocs(updates)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 - next watch tick retries
+                self.logger.debug(
+                    "alloc status sync failed; retried next tick",
+                    exc_info=True)

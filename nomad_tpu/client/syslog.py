@@ -95,5 +95,5 @@ class SyslogCollector:
         try:
             self.stdout.close()
             self.stderr.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except OSError:
+            pass  # already closed / rotator fd gone: shutdown-only path

@@ -41,10 +41,12 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..chaos import chaos
 from ..scheduler import new_scheduler
 from ..server.worker import EvalSession
 from ..structs import Evaluation, Plan, PlanResult, consts
 from ..utils import metrics
+from ..utils.backoff import poll_until
 
 DEQUEUE_TOPUP_SLICE = 0.002  # cond-wait granularity while accumulating
 SLOT_WAIT_SLICE = 0.02  # cond-wait granularity while all slots busy
@@ -112,6 +114,11 @@ class PipelineSession(EvalSession):
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         start = time.monotonic()
         plan.eval_token = self.token
+        if chaos.enabled:
+            # 'error' = the submit RPC fails (leader flap mid-batch);
+            # the eval nacks and redelivers. 'delay' = a slow plan
+            # queue.
+            chaos.fire("dispatch.submit", eval_id=self.eval.id)
         try:
             self.server.eval_pause_nack(self.eval.id, self.token)
         except ValueError:
@@ -175,6 +182,8 @@ class DispatchPipeline:
         self._inflight = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.drained = 0  # guarded-by: _lock (evals requeued by drain())
+        self.finish_dropped = 0  # guarded-by: _lock (chaos dispatch.finish)
 
         # ---- stats ----
         self.evals_in = 0  # guarded-by: _lock (handed off / requeued)
@@ -209,6 +218,39 @@ class DispatchPipeline:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        # Accumulated evals must not die with the pipeline: hand their
+        # leases back so another server's workers redeliver them.
+        self.drain()
+
+    def drain(self) -> int:
+        """Leadership loss (or shutdown): requeue every accumulated
+        eval into the broker by nacking its outstanding token, so
+        nothing a batch had in hand is lost and nothing double-places.
+
+        Extends the PR 1 requeue path one failure class further: a
+        conflict requeue re-enters the ACCUMULATING batch with its
+        token still outstanding; a drain gives the token BACK — on the
+        (old) leader the nack re-readies the eval immediately, and when
+        the broker is already disabled/flushed (a real flap) the nack
+        fails cleanly and the new leader re-seeds the eval from raft
+        state (_restore_evals), since an undelivered eval is still
+        status=pending there. In-flight-but-unacked batch members need
+        no sweep: their stage threads' acks fail against the flushed
+        broker and the same restore covers them, while the plan-queue
+        token guard (plan_submit checks the OUTSTANDING token) keeps a
+        stale batch from committing a double placement."""
+        with self._cond:
+            pending, self._pending = self._pending, []
+            self._cond.notify_all()
+        for entry in pending:
+            self._finish(entry, acked=False)
+        if pending:
+            with self._lock:
+                self.drained += len(pending)
+            self.logger.info(
+                "drained %d accumulated evals back to the broker",
+                len(pending))
+        return len(pending)
 
     # ------------------------------------------------------ admission
 
@@ -357,6 +399,11 @@ class DispatchPipeline:
         """(snapshot, route_host) for a launchable batch, None when the
         FSM never caught up to the batch's snapshot index. Returned,
         not stored: concurrent launches each carry their own."""
+        if chaos.enabled:
+            # 'error' = the launch prologue dies (snapshot/catch-up
+            # failure): _launch aborts the batch, every eval nacks and
+            # redelivers. 'delay' = a follower lagging the leader.
+            chaos.fire("dispatch.launch", batch=len(batch))
         cfg = self.server.config
         # Latency-aware routing, centralized: a batch too small to
         # amortize the device dispatch runs on the host factories with
@@ -459,6 +506,14 @@ class DispatchPipeline:
             get_batcher().cohort_cancel(1)
 
     def _finish(self, entry: _Pending, acked: bool) -> None:
+        if chaos.enabled and chaos.fire(
+                "dispatch.finish", eval_id=entry.eval.id) == "drop":
+            # Injected worker crash holding an unacked eval: neither
+            # ack nor nack goes out — the broker's nack timer is the
+            # recovery path and MUST reclaim it (asserted by the soak).
+            with self._lock:
+                self.finish_dropped += 1
+            return
         try:
             if acked:
                 self.server.eval_ack(entry.eval.id, entry.token)
@@ -491,14 +546,11 @@ class DispatchPipeline:
     # ------------------------------------------------------- plumbing
 
     def _wait_for_index(self, index: int, timeout: float) -> bool:
-        deadline = time.monotonic() + timeout
-        backoff = 0.001
-        while self.server.fsm.state.latest_index() < index:
-            if self._stop.is_set() or time.monotonic() > deadline:
-                return False
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 0.1)
-        return True
+        # Runs on stage threads only (never the dispatcher); the shared
+        # jittered-backoff poll replaces the ad-hoc doubling loop.
+        return poll_until(
+            lambda: self.server.fsm.state.latest_index() >= index,
+            timeout, stop=self._stop, base=0.001, max_delay=0.1)
 
     def _note_submit(self, start: float) -> None:
         dt = time.monotonic() - start
@@ -545,6 +597,8 @@ class DispatchPipeline:
                 "requeues": self.requeues,
                 "requeues_batched": self.requeues_batched,
                 "inline_retries": self.inline_retries,
+                "drained": self.drained,
+                "finish_dropped": self.finish_dropped,
                 "retries_per_eval": round(retries / done, 4) if done else 0.0,
                 # Cumulative stage latencies (divide by the matching
                 # counters for per-unit): microseconds, like the

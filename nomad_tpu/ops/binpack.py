@@ -148,6 +148,21 @@ def make_asks(
     )
 
 
+def check_device_chaos() -> None:
+    """Host-side fault gate for device execution, called by the
+    placement batcher immediately before it issues device programs.
+    Armed with a ``binpack.device`` 'error' spec it raises
+    ChaosInjectedError exactly as a real device/runtime fault would
+    surface from the jitted call — the dense schedulers' recovery
+    contract (fall back to the host iterator path, identical placement
+    semantics) is exercised without needing a chip that actually
+    fails. A no-op two-attribute check in production."""
+    from ..chaos import chaos
+
+    if chaos.enabled:
+        chaos.fire("binpack.device")
+
+
 def host_prng_key(seed: int) -> "_np.ndarray":
     """A threefry key as a HOST uint32[2] (what jax.random.PRNGKey
     yields, without the eager device transfer); jax.random accepts the

@@ -462,13 +462,25 @@ class PlacementBatcher:
 
         import jax
 
+        from ..chaos import chaos
         from ..ops.binpack import (
             NodeState,
             batched_placement_program,
             batched_placement_program_compact,
             batched_placement_program_overlay,
+            check_device_chaos,
             placement_program_jit,
         )
+
+        if chaos.enabled:
+            # 'delay' = a slow device / congested tunnel for this
+            # dispatch; the adaptive window sees the inflated RTT.
+            chaos.fire("batcher.dispatch", batch=len(batch))
+        # Device-fault gate (binpack.device): an injected error
+        # propagates to every request in the batch via req.error —
+        # exactly the blast shape of a real device failure — and the
+        # dense schedulers fall back to the host path per eval.
+        check_device_chaos()
 
         if len(batch) == 1 and batch[0].token is None:
             # Unshared lone request: nothing cacheable, dispatch as-is.

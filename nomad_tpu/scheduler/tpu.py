@@ -170,7 +170,24 @@ class BatchedTPUScheduler(GenericScheduler):
         # workers' same-shaped placement programs coalesce into one
         # vmapped device dispatch instead of N serial calls, and evals
         # sharing a cluster base ride one cached device upload.
-        choices, scores = get_batcher().place(matrix, asks, key, config)
+        try:
+            choices, scores = get_batcher().place(matrix, asks, key, config)
+        except Exception:
+            # Device dispatch failed (runtime fault, OOM on device,
+            # chaos binpack.device): the host iterators have IDENTICAL
+            # placement semantics (parity-tested), so falling back
+            # costs milliseconds of CPU instead of failing the eval
+            # into a nack/redelivery round — the eval still completes
+            # this delivery. The whole bulk set takes the host path;
+            # the plan applier re-verifies either way.
+            self.logger.warning(
+                "device placement dispatch failed; falling back to the "
+                "host path for %d placements", len(bulk), exc_info=True)
+            from ..utils import metrics
+
+            metrics.incr_counter(("scheduler", "host_fallback"), len(bulk))
+            super()._compute_placements(bulk)
+            return
         choices = np.asarray(choices)
         scores = np.asarray(scores)
 

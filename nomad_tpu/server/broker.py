@@ -15,7 +15,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..structs import Evaluation
+from ..chaos import chaos
+from ..structs import Evaluation, consts
+from ..utils import metrics
 from ..utils.ids import generate_uuid
 from ..utils.timer import default_wheel
 
@@ -78,6 +80,10 @@ class EvalBroker:
         # Evals the scheduler re-submitted (reblock) while outstanding;
         # processed on Ack (eval_broker.go:171-182 requeue).
         self._requeue: Dict[str, Evaluation] = {}
+        # Evals routed to the failed queue on delivery-limit exhaustion
+        # (dead-lettered); monotonic across flushes so server.stats()
+        # reports lifetime poison-eval pressure.
+        self.dead_lettered = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------
 
@@ -170,13 +176,32 @@ class EvalBroker:
                     return None, ""
                 ev = self._scan_for_schedulers(schedulers)
                 if ev is not None:
-                    return self._dequeue_locked(ev)
+                    out = self._dequeue_locked(ev)
+                    break
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None, ""
                 self._cond.wait(remaining if remaining is not None else 1.0)
+        return self._chaos_deliver(out)
+
+    def _chaos_deliver(
+        self, out: Tuple[Evaluation, str]
+    ) -> Tuple[Optional[Evaluation], str]:
+        """Fault-injection point on the delivery edge: a dropped
+        delivery models a dequeuer that crashed before doing any work —
+        the lease is burned (counts toward the delivery limit) and the
+        eval redelivers immediately via nack. Runs OUTSIDE the broker
+        lock (a 'delay' fault sleeps in fire())."""
+        if chaos.enabled and chaos.fire(
+                "broker.deliver", eval_id=out[0].id) == "drop":
+            try:
+                self.nack(out[0].id, out[1])
+            except ValueError:
+                pass  # timer already reclaimed it
+            return None, ""
+        return out
 
     def dequeue_many(
         self, schedulers: List[str], max_n: int
@@ -196,6 +221,9 @@ class EvalBroker:
                 if ev is None:
                     break
                 out.append(self._dequeue_locked(ev))
+        if chaos.enabled:
+            out = [item for item in map(self._chaos_deliver, out)
+                   if item[0] is not None]
         return out
 
     def _scan_for_schedulers(self, schedulers: List[str]) -> Optional[Evaluation]:
@@ -223,6 +251,19 @@ class EvalBroker:
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
         """Nack timer fired: the worker died or stalled; redeliver."""
+        if chaos.enabled:
+            # 'drop' = the timeout itself is lost once: re-arm so the
+            # eval redelivers a full nack_timeout late instead of never
+            # (a dropped redelivery must degrade latency, not lose the
+            # at-least-once guarantee). 'delay' sleeps in fire().
+            if chaos.fire("broker.nack_timer", eval_id=eval_id) == "drop":
+                with self._lock:
+                    unack = self._unack.get(eval_id)
+                    if unack is not None and unack.token == token:
+                        unack.timer = self._wheel.schedule(
+                            self.nack_timeout, self._nack_timeout,
+                            eval_id, token)
+                return
         try:
             self.nack(eval_id, token)
         except ValueError:
@@ -270,10 +311,29 @@ class EvalBroker:
             del self._unack[eval_id]
             self._requeue.pop(token, None)
             ev = unack.eval
-            # The job claim stays with this eval; redeliver it, or park
-            # it on the failed queue past the delivery limit.
-            if self._evals.get(ev.id, 0) >= self.delivery_limit:
-                self._enqueue_locked(ev, FAILED_QUEUE)
+            # The job claim stays with this eval; redeliver it, or
+            # dead-letter it past the delivery limit: the failed-queue
+            # copy carries a structured trigger + reason (instead of
+            # silently capping), the leader reaper persists them when it
+            # marks the eval failed, and the counter surfaces poison
+            # evals in server.stats().
+            deliveries = self._evals.get(ev.id, 0)
+            if deliveries >= self.delivery_limit:
+                dead = ev.copy()
+                # Idempotent: a reaper whose eval_update failed (leader
+                # flap) lets the nack timer re-park the ALREADY
+                # dead-lettered copy — re-stamping would clobber the
+                # original trigger and double-count the eval.
+                if dead.triggered_by != consts.EVAL_TRIGGER_DEAD_LETTER:
+                    dead.triggered_by = consts.EVAL_TRIGGER_DEAD_LETTER
+                    dead.status_description = (
+                        f"dead-lettered: delivery limit "
+                        f"({self.delivery_limit}) exhausted after "
+                        f"{deliveries} deliveries "
+                        f"(originally triggered by {ev.triggered_by!r})")
+                    self.dead_lettered += 1
+                    metrics.incr_counter(("broker", "dead_lettered"))
+                self._enqueue_locked(dead, FAILED_QUEUE)
             else:
                 self._enqueue_locked(ev, ev.type)
 
@@ -321,9 +381,12 @@ class EvalBroker:
             return heap.evals() if heap else []
 
     def stats(self) -> Dict[str, int]:
+        with self._lock:
+            dead = self.dead_lettered
         return {
             "total_ready": self.ready_count(),
             "total_unacked": self.unacked_count(),
             "total_blocked": self.blocked_count(),
             "total_waiting": self.waiting_count(),
+            "dead_lettered": dead,
         }

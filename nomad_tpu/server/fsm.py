@@ -204,6 +204,12 @@ class FSM:
                 if alloc.client_status in (
                     consts.ALLOC_CLIENT_COMPLETE,
                     consts.ALLOC_CLIENT_FAILED,
+                    # Lost frees capacity too: a client re-syncing after
+                    # its node was downed (heartbeat TTL) reports its
+                    # allocs lost, and evals blocked on that class must
+                    # re-trigger — the node-down -> alloc-lost ->
+                    # blocked-eval chain ends here.
+                    consts.ALLOC_CLIENT_LOST,
                 ):
                     # Client sync updates are SPARSE (id + status +
                     # task_states, client/agent.py _flush_dirty): the

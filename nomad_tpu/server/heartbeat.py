@@ -13,6 +13,7 @@ import random
 import threading
 from typing import Dict
 
+from ..chaos import chaos
 from ..structs import consts
 from ..utils.pool import WorkPool
 from ..utils.timer import default_wheel
@@ -89,6 +90,14 @@ class HeartbeatTimers:
         self._invalidate_pool.submit(self._apply_down, node_id)
 
     def _apply_down(self, node_id: str) -> None:
+        if chaos.enabled:
+            # 'drop' = the invalidation is lost once; re-arm the timer
+            # so the node downs a full TTL late instead of never.
+            # 'delay' sleeps here on the private pool thread (a raft
+            # apply stuck behind a flapping leader).
+            if chaos.fire("heartbeat.expire", node=node_id) == "drop":
+                self.reset_timer(node_id)
+                return
         # The apply may have sat queued behind raft-blocked workers for
         # a while: if the node heartbeated meanwhile (timer re-armed) or
         # leadership was lost, downing it now would be spurious.

@@ -13,6 +13,7 @@ import json
 from typing import List, Optional, Tuple
 
 from ..structs import Evaluation, Plan, PlanResult
+from ..utils.backoff import Backoff
 from ..utils.codec import from_dict, to_dict
 from ..utils.httppool import HTTPPool, PoolError
 
@@ -43,22 +44,36 @@ class RemoteLeader:
         self._pool = HTTPPool(self.addr, timeout=120.0,
                               ssl_context=ssl_context)
 
-    def _call(self, path: str, body: dict, timeout: Optional[float] = None):
-        try:
-            status, _headers, payload = self._pool.request(
-                "PUT", path, body=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-                timeout=timeout or self.timeout,
-            )
-        except PoolError as e:
-            raise LeaderUnavailableError(str(e)) from None
-        if status >= 400:
+    def _call(self, path: str, body: dict, timeout: Optional[float] = None,
+              retryable: bool = True):
+        """One leader RPC. `retryable` ops ride a short jittered
+        backoff through transport-level failures (a leader restart's
+        refused-connection window): every /v1/internal mutation is
+        token-guarded — a duplicate ack/nack after a lost response is
+        REJECTED by the broker, never double-applied — so at-least-once
+        retry only converts 'leader briefly gone' from an error into
+        latency. Non-retryable: the long-poll dequeue (its wait budget
+        is the caller's) and plan submit (at-most-once by contract; the
+        conflict machinery owns its retries)."""
+        bo = Backoff(base=0.05, max_delay=0.4, attempts=2)
+        while True:
             try:
-                message = json.loads(payload).get("error", "")
-            except Exception:  # noqa: BLE001
-                message = payload.decode(errors="replace")
-            raise LeaderUnavailableError(message or f"HTTP {status}")
-        return json.loads(payload or b"null")
+                status, _headers, payload = self._pool.request(
+                    "PUT", path, body=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                    timeout=timeout or self.timeout,
+                )
+            except PoolError as e:
+                if retryable and bo.sleep():
+                    continue
+                raise LeaderUnavailableError(str(e)) from None
+            if status >= 400:
+                try:
+                    message = json.loads(payload).get("error", "")
+                except Exception:  # noqa: BLE001
+                    message = payload.decode(errors="replace")
+                raise LeaderUnavailableError(message or f"HTTP {status}")
+            return json.loads(payload or b"null")
 
     # ------------------------------------------------------------ evals
 
@@ -68,6 +83,7 @@ class RemoteLeader:
             "/v1/internal/eval/dequeue",
             {"schedulers": schedulers, "timeout": timeout},
             timeout=timeout + 10.0,
+            retryable=False,  # long-poll: the wait budget is the caller's
         )
         ev = from_dict(Evaluation, out.get("eval")) if out.get("eval") else None
         return ev, out.get("token", "")
@@ -109,7 +125,8 @@ class RemoteLeader:
 
     def plan_submit(self, plan: Plan) -> PlanResult:
         out = self._call("/v1/internal/plan/submit",
-                         {"plan": to_dict(plan)}, timeout=40.0)
+                         {"plan": to_dict(plan)}, timeout=40.0,
+                         retryable=False)  # at-most-once by contract
         return from_dict(PlanResult, out["result"])
 
     # ------------------------------------------------------- heartbeats

@@ -36,6 +36,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..chaos import chaos
+from ..utils.backoff import poll_until
+
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
@@ -108,28 +111,53 @@ class InmemTransport(Transport):
     def _reachable(self, a: str, b: str) -> bool:
         return a not in self.disconnected and b not in self.disconnected
 
+    @staticmethod
+    def _exchange(peer: str, handler, args):
+        """One RPC with the same transport.send/recv fault sites the
+        TCP transport wires, so in-process cluster tests chaos-inject
+        RPC loss without sockets. A send-drop loses the request (the
+        handler never runs); a recv-drop runs the handler and loses the
+        RESPONSE — the peer acted, the caller sees silence (the
+        dangerous half of at-least-once delivery)."""
+        if chaos.enabled and chaos.fire("transport.send", peer=peer) == "drop":
+            return None
+        resp = handler(args)
+        if chaos.enabled and chaos.fire("transport.recv", peer=peer) == "drop":
+            return None
+        return resp
+
     def request_vote(self, peer: str, args: dict) -> Optional[dict]:
         node = self.nodes.get(peer)
         if node is None or not self._reachable(args["candidate_id"], peer):
             return None
-        return node.handle_request_vote(args)
+        return self._exchange(peer, node.handle_request_vote, args)
 
     def append_entries(self, peer: str, args: dict) -> Optional[dict]:
         node = self.nodes.get(peer)
         if node is None or not self._reachable(args["leader_id"], peer):
             return None
-        return node.handle_append_entries(args)
+        return self._exchange(peer, node.handle_append_entries, args)
 
     def install_snapshot(self, peer: str, args: dict) -> Optional[dict]:
         node = self.nodes.get(peer)
         if node is None or not self._reachable(args["leader_id"], peer):
             return None
-        return node.handle_install_snapshot(args)
+        return self._exchange(peer, node.handle_install_snapshot, args)
 
     def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
         node = self.nodes.get(peer)
         if node is None or peer in self.disconnected:
             raise ConnectionError(f"peer {peer} unreachable")
+        # Mirror the TCP transport's forward hardening: a send-drop is
+        # provably-unsent (the handler never ran), so riding it out
+        # with backoff cannot double-apply.
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base=0.05, max_delay=0.4, attempts=3)
+        while chaos.enabled and chaos.fire(
+                "transport.send", peer=peer) == "drop":
+            if not bo.sleep():
+                raise ConnectionError(f"peer {peer} unreachable")
         return node.apply(msg_type, payload)
 
 
@@ -639,6 +667,12 @@ class RaftNode:
             time.sleep(HEARTBEAT_INTERVAL)
 
     def _broadcast_heartbeat(self) -> None:
+        if chaos.enabled and chaos.fire(
+                "raft.heartbeat", node=self.node_id) == "drop":
+            # Injected: the leader misses a whole broadcast round —
+            # enough consecutive drops age followers past their
+            # election timeout and flap leadership organically.
+            return
         for peer in self.peers:
             self._replicate_to(peer)
         self._advance_commit()
@@ -715,6 +749,9 @@ class RaftNode:
                 self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
 
     def _advance_commit(self) -> None:
+        if chaos.enabled and chaos.fire(
+                "raft.commit", node=self.node_id) == "drop":
+            return  # injected commit latency: skip this advance round
         with self._lock:
             if self.state != LEADER:
                 return
@@ -735,6 +772,10 @@ class RaftNode:
         """Append an entry; blocks until it is committed and applied
         locally. Followers forward to the leader. Raises if the write
         was superseded (lost leadership before commit)."""
+        if chaos.enabled:
+            # 'delay' = injected apply latency (a slow disk / loaded
+            # leader); 'error' raises like a mid-apply leader loss.
+            chaos.fire("raft.apply", node=self.node_id, msg_type=msg_type)
         with self._lock:
             if self.state != LEADER:
                 leader = self.leader_id
@@ -972,13 +1013,11 @@ class RaftLog:
 
     def apply(self, msg_type: str, payload: Any) -> int:
         index = self.node.apply(msg_type, payload)
-        deadline = time.monotonic() + APPLY_TIMEOUT
-        while self.node.last_index() < index:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"local fsm did not reach index {index} in time"
-                )
-            time.sleep(0.002)
+        if not poll_until(lambda: self.node.last_index() >= index,
+                          APPLY_TIMEOUT, base=0.002, max_delay=0.05):
+            raise TimeoutError(
+                f"local fsm did not reach index {index} in time"
+            )
         return index
 
     def last_index(self) -> int:

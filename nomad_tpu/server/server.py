@@ -537,6 +537,12 @@ class Server:
 
     def revoke_leadership(self) -> None:
         self._leader = False
+        # Drain FIRST, while the broker still accepts nacks: the
+        # pipeline's accumulated evals go back to the ready queue (or,
+        # on a real flap where the broker flushes anyway, fail cleanly
+        # and re-seed from raft state via the new leader's
+        # _restore_evals) — either way no eval is lost with the batch.
+        self.dispatch.drain()
         self._stop_eval_hygiene()
         for timer in self._gc_threads:
             timer.cancel()
@@ -607,9 +613,13 @@ class Server:
                 continue
             updated = ev.copy()
             updated.status = consts.EVAL_STATUS_FAILED
-            updated.status_description = (
-                "evaluation reached delivery limit "
-                f"({self.config.eval_delivery_limit})")
+            if not updated.status_description:
+                # Dead-lettered evals arrive pre-stamped by the broker
+                # (delivery count + original trigger); keep that richer
+                # reason and only synthesize one for legacy parks.
+                updated.status_description = (
+                    "evaluation reached delivery limit "
+                    f"({self.config.eval_delivery_limit})")
             try:
                 self.eval_update([updated])
                 self.broker.ack(ev.id, token)
@@ -705,11 +715,11 @@ class Server:
     def _wait_applied(self, index: int, timeout: float = 5.0) -> None:
         """Wait until the local FSM has applied `index` (a follower's
         FSM lags the leader commit it just forwarded)."""
-        deadline = time.monotonic() + timeout
-        while self.fsm.last_applied_index < index:
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"timed out waiting for index {index}")
-            time.sleep(0.005)
+        from ..utils.backoff import poll_until
+
+        if not poll_until(lambda: self.fsm.last_applied_index >= index,
+                          timeout, base=0.005, max_delay=0.1):
+            raise TimeoutError(f"timed out waiting for index {index}")
 
     def job_deregister(self, job_id: str, create_eval: bool = True) -> Optional[str]:
         job = self.fsm.state.job_by_id(job_id)
@@ -1009,8 +1019,15 @@ class Server:
             try:
                 return remote.eval_dequeue(schedulers, timeout)
             except Exception:  # noqa: BLE001 - leader flap: retry later
-                pass
-        time.sleep(min(timeout, 0.2))
+                self.logger.debug(
+                    "remote eval dequeue failed; retrying next loop",
+                    exc_info=True)
+        # Jittered: on a leader flap EVERY follower worker lands here —
+        # a fixed interval would hammer the recovering leader in
+        # lockstep (utils/backoff.py sleep_jittered).
+        from ..utils.backoff import sleep_jittered
+
+        sleep_jittered(min(timeout, 0.2))
         return None, ""
 
     def eval_dequeue_many(
@@ -1031,7 +1048,9 @@ class Server:
             try:
                 return remote.eval_dequeue_many(schedulers, max_n)
             except Exception:  # noqa: BLE001 - leader flap: batch later
-                pass
+                self.logger.debug(
+                    "remote eval drain failed; batching later",
+                    exc_info=True)
         return []
 
     def eval_ack(self, eval_id: str, token: str) -> None:

@@ -22,6 +22,8 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..chaos import chaos
+from ..utils.backoff import Backoff
 from ..utils.codec import from_dict, to_dict
 from .raft import LogEntry, Transport
 
@@ -244,10 +246,23 @@ class TCPTransport(Transport):
         except OSError:
             pass
 
-    def _call(self, peer: str, msg: dict, timeout: float = RPC_TIMEOUT) -> Optional[dict]:
-        for attempt in (0, 1):
-            sock, pooled = self._checkout(peer, use_pool=attempt == 0)
+    def _call(self, peer: str, msg: dict, timeout: float = RPC_TIMEOUT,
+              connect_backoff: Optional[Backoff] = None) -> Optional[dict]:
+        """One RPC round-trip. `connect_backoff` is a retry policy for
+        DIAL failures only — a failed dial provably sent nothing, so
+        retrying it can never double-deliver; exchange failures keep
+        the single fresh-dial keep-alive retry and then fail to the
+        caller (the frame may have been acted on)."""
+        if chaos.enabled and chaos.fire("transport.send", peer=peer) == "drop":
+            return None  # injected: request lost before the wire
+        use_pool = True
+        while True:
+            sock, pooled = self._checkout(peer, use_pool=use_pool)
             if sock is None:
+                # Dial failure: nothing was sent. Ride out a peer
+                # restart / flap window when the caller asked for it.
+                if connect_backoff is not None and connect_backoff.sleep():
+                    continue
                 return None
             try:
                 sock.settimeout(timeout)
@@ -270,12 +285,22 @@ class TCPTransport(Transport):
                 # follower. The keep-alive race shows up as instant
                 # EOF/RST, never as a timeout.
                 is_timeout = isinstance(e, (socket.timeout, TimeoutError))
-                if pooled and attempt == 0 and not is_timeout:
+                if pooled and not is_timeout:
+                    use_pool = False
                     continue
+                return None
+            if chaos.enabled and chaos.fire(
+                    "transport.recv", peer=peer) == "drop":
+                # Injected: response lost in flight. The request WAS
+                # served; close the socket (its framing state is now
+                # a lie for the pool) and report unreachable.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return None
             self._checkin(peer, sock)
             return resp
-        return None
 
     def request_vote(self, peer: str, args: dict) -> Optional[dict]:
         return self._call(peer, {"kind": "request_vote", "args": args})
@@ -300,6 +325,11 @@ class TCPTransport(Transport):
         return self._call(peer, {"kind": "append_entries", "args": wire_args})
 
     def forward_apply(self, peer: str, msg_type: str, payload: Any) -> int:
+        # Dial-failure retries ride a jittered backoff: a follower
+        # forwarding a write during a leader restart sees connection
+        # refusals for the flap window — retrying those is free of
+        # double-apply risk (nothing was sent), unlike exchange
+        # failures, which _call never retries past the keep-alive race.
         resp = self._call(
             peer,
             {
@@ -307,6 +337,7 @@ class TCPTransport(Transport):
                 "msg_type": msg_type,
                 "payload": _encode_payload(payload),
             },
+            connect_backoff=Backoff(base=0.05, max_delay=0.4, attempts=3),
         )
         if resp is None or "error" in resp:
             raise ConnectionError(

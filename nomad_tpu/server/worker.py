@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
 from ..utils import metrics
+from ..utils.backoff import poll_until
 from ..structs import Evaluation, Plan, PlanResult, consts
 
 DEQUEUE_TIMEOUT = 0.5
@@ -210,7 +211,13 @@ class Worker:
                 ]
                 self._process_eval(ev, token, factory, snapshot)
                 for f in futures:
-                    f.wait()
+                    # Bounded with a shutdown re-check: an unbounded
+                    # wait here pinned the worker thread to a wedged
+                    # batch member forever (ntalint unbounded-wait).
+                    while not f.wait(1.0) and not self._stop.is_set():
+                        pass
+                    if self._stop.is_set():
+                        break
 
     def _process_eval(self, ev: Evaluation, token: str,
                       factory: Optional[str] = None,
@@ -242,16 +249,12 @@ class Worker:
             pass
 
     def _wait_for_index(self, index: int, timeout: float) -> bool:
-        """Local FSM catch-up with exponential backoff
-        (worker.go:214,503)."""
-        deadline = time.monotonic() + timeout
-        backoff = BACKOFF_BASE
-        while self.server.fsm.state.latest_index() < index:
-            if self._stop.is_set() or time.monotonic() > deadline:
-                return False
-            time.sleep(backoff)
-            backoff = min(backoff * 2, BACKOFF_LIMIT)
-        return True
+        """Local FSM catch-up with jittered exponential backoff
+        (worker.go:214,503; policy in utils/backoff.py)."""
+        return poll_until(
+            lambda: self.server.fsm.state.latest_index() >= index,
+            timeout, stop=self._stop,
+            base=BACKOFF_BASE, max_delay=BACKOFF_LIMIT)
 
     def _invoke_scheduler(self, ev: Evaluation, token: str,
                           factory: Optional[str] = None,

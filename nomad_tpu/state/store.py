@@ -504,9 +504,16 @@ class StateStore:
                 alloc = alloc.copy()
                 if existing is not None:
                     alloc.create_index = existing.create_index
-                    alloc.client_status = existing.client_status
-                    alloc.client_description = existing.client_description
                     alloc.task_states = existing.task_states
+                    # The client owns client_status — EXCEPT lost: the
+                    # scheduler marks an alloc lost exactly because its
+                    # node went down and the client can never report
+                    # again (state_store.go:922 carves out the same
+                    # case). Without this the node-down -> alloc-lost
+                    # chain silently reverted to the stale 'running'.
+                    if alloc.client_status != consts.ALLOC_CLIENT_LOST:
+                        alloc.client_status = existing.client_status
+                        alloc.client_description = existing.client_description
                 else:
                     alloc.create_index = index
                     if not alloc.client_status:

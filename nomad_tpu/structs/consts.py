@@ -53,6 +53,9 @@ EVAL_TRIGGER_NODE_UPDATE = "node-update"
 EVAL_TRIGGER_SCHEDULED = "scheduled"
 EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
 EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
+# Broker delivery-limit exhaustion: the eval was dead-lettered to the
+# failed queue with a structured reason (server/broker.py nack()).
+EVAL_TRIGGER_DEAD_LETTER = "delivery-limit-exhausted"
 
 # --- Task states (structs.go:2317) ---
 TASK_STATE_PENDING = "pending"

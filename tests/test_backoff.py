@@ -1,0 +1,74 @@
+"""utils/backoff.py: the one retry/pacing primitive for recovery paths
+(transport redials, leader forwarding, FSM catch-up polls, executor
+launch waits) — deadline, attempt-budget, stop-event, and jitter
+behavior."""
+
+import random
+import threading
+import time
+
+from nomad_tpu.utils.backoff import Backoff, poll_until
+
+
+def test_delays_grow_and_cap():
+    bo = Backoff(base=0.1, factor=2.0, max_delay=0.35, jitter=0.0)
+    assert [round(bo.next_delay(), 3) for _ in range(4)] == [
+        0.1, 0.2, 0.35, 0.35]
+
+
+def test_jitter_spreads_within_band():
+    rng = random.Random(7)
+    bo = Backoff(base=1.0, factor=1.0, max_delay=1.0, jitter=0.25, rng=rng)
+    delays = [bo.next_delay() for _ in range(50)]
+    assert all(0.75 <= d <= 1.25 for d in delays)
+    assert max(delays) - min(delays) > 0.1  # actually spread, not fixed
+
+
+def test_attempt_budget_is_retry_count():
+    bo = Backoff(base=0.001, jitter=0.0, attempts=2)
+    assert bo.sleep() and bo.sleep()
+    assert not bo.sleep()
+
+
+def test_deadline_grants_final_post_sleep_retry():
+    """The deadline landing DURING a sleep still grants the caller one
+    post-sleep retry (state may have changed while sleeping); the NEXT
+    sleep reports expiry."""
+    bo = Backoff(base=0.05, jitter=0.0, deadline=0.02)
+    assert bo.sleep()  # clamped to the deadline, then one last grant
+    assert not bo.sleep()
+
+
+def test_stop_event_interrupts_sleep():
+    stop = threading.Event()
+    bo = Backoff(base=5.0, jitter=0.0, stop=stop)
+    threading.Timer(0.05, stop.set).start()
+    t0 = time.monotonic()
+    assert not bo.sleep()
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_reset_returns_to_base():
+    bo = Backoff(base=0.1, factor=2.0, jitter=0.0)
+    bo.next_delay()
+    bo.next_delay()
+    bo.reset()
+    assert round(bo.next_delay(), 3) == 0.1
+
+
+def test_poll_until_true_and_timeout():
+    assert poll_until(lambda: True, 1.0)
+    t0 = time.monotonic()
+    assert not poll_until(lambda: False, 0.05)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_poll_until_sees_late_flip():
+    flip_at = time.monotonic() + 0.05
+    assert poll_until(lambda: time.monotonic() >= flip_at, 2.0)
+
+
+def test_poll_until_stop_wins():
+    stop = threading.Event()
+    stop.set()
+    assert not poll_until(lambda: False, 5.0, stop=stop)

@@ -167,3 +167,97 @@ def test_dedup_enqueue():
     b.enqueue(ev)
     b.enqueue(ev)
     assert b.ready_count() == 1
+
+
+# ---------------------------------------------------------------------
+# dead-lettering: delivery-limit exhaustion is structured, not silent
+
+
+def test_dead_letter_stamps_reason_and_counts():
+    b = EvalBroker(delivery_limit=2)
+    b.set_enabled(True)
+    ev = make_eval()
+    ev.triggered_by = "job-register"
+    b.enqueue(ev)
+    assert b.stats()["dead_lettered"] == 0
+    for _ in range(2):
+        out, token = b.dequeue(["service"], timeout=0.1)
+        b.nack(out.id, token)
+    dead = b.failed_evals()
+    assert [e.id for e in dead] == [ev.id]
+    # The parked copy carries a structured trigger + reason; the
+    # original trigger survives inside the reason string.
+    from nomad_tpu.structs import consts
+
+    assert dead[0].triggered_by == consts.EVAL_TRIGGER_DEAD_LETTER
+    assert "delivery limit (2)" in dead[0].status_description
+    assert "job-register" in dead[0].status_description
+    assert b.stats()["dead_lettered"] == 1
+
+
+def test_ack_after_dead_letter_rejected_cleanly():
+    """A worker that was holding the eval when it dead-lettered (its
+    nack timer fired) must get a clean ValueError from its late ack —
+    not a silent success that would pull the eval off the failed
+    queue."""
+    b = EvalBroker(nack_timeout=0.1, delivery_limit=1)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    out, token = b.dequeue(["service"], timeout=0.1)
+    assert out is not None
+    # Let the nack timer fire: first delivery already exhausts the
+    # limit of 1, so the timeout dead-letters it.
+    deadline = time.monotonic() + 2.0
+    while not b.failed_evals() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert [e.id for e in b.failed_evals()] == [ev.id]
+    with pytest.raises(ValueError):
+        b.ack(ev.id, token)
+    # Still parked for the reaper, reason intact.
+    assert [e.id for e in b.failed_evals()] == [ev.id]
+    assert b.stats()["dead_lettered"] == 1
+
+
+def test_chaos_delivery_drop_burns_lease_and_redelivers():
+    """An armed broker.deliver 'drop' models a dequeuer crash: the
+    delivery counts toward the limit and the eval redelivers."""
+    from nomad_tpu.chaos import FaultSpec, chaos
+
+    b = EvalBroker(delivery_limit=5)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    with chaos.armed(3, [FaultSpec("broker.deliver", "drop", count=1)]):
+        out, token = b.dequeue(["service"], timeout=0.2)
+        assert out is None and token == ""  # delivery lost
+        out, token = b.dequeue(["service"], timeout=0.5)
+        assert out is not None and out.id == ev.id  # redelivered
+        assert len(chaos.firing_log()) == 1
+    b.ack(ev.id, token)
+
+
+def test_chaos_nack_timer_drop_rearms_instead_of_losing():
+    """A dropped nack-timeout must re-arm the timer (redelivery a full
+    nack_timeout late), never cancel redelivery outright — the
+    at-least-once guarantee degrades to latency, not loss."""
+    from nomad_tpu.chaos import FaultSpec, chaos
+
+    b = EvalBroker(nack_timeout=0.15, delivery_limit=5)
+    b.set_enabled(True)
+    ev = make_eval()
+    b.enqueue(ev)
+    with chaos.armed(9, [FaultSpec("broker.nack_timer", "drop", count=1)]):
+        out, _token = b.dequeue(["service"], timeout=0.2)
+        assert out is not None
+        # First timeout fires ~0.15s in and is DROPPED (re-armed); the
+        # re-armed timer redelivers ~0.3s in.
+        deadline = time.monotonic() + 3.0
+        redelivered = None
+        while time.monotonic() < deadline:
+            redelivered, tok2 = b.dequeue(["service"], timeout=0.1)
+            if redelivered is not None:
+                break
+        assert redelivered is not None and redelivered.id == ev.id
+        assert len(chaos.firing_log()) == 1
+    b.ack(ev.id, tok2)

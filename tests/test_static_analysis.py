@@ -646,3 +646,152 @@ def test_syntax_error_reported_as_parse_error_finding(tmp_path):
     good.write_text(GUARDED_GOOD)
     findings = analyze_paths([str(tmp_path)])
     assert rules_of(findings) == ["parse-error"]
+
+
+# ---------------------------------------------------------------------
+# robustness: unbounded waits (server/ + dispatch/ scope)
+
+
+UNBOUNDED_BAD = """\
+import queue
+import threading
+
+class C:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._done = threading.Event()
+        self._t = threading.Thread(target=lambda: None)
+
+    def run(self):
+        item = self._q.get()
+        self._done.wait()
+        self._t.join()
+        return item
+"""
+
+UNBOUNDED_GOOD = """\
+import queue
+import threading
+
+class C:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._done = threading.Event()
+        self._t = threading.Thread(target=lambda: None)
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            while not self._done.wait(1.0):
+                if self._stop.is_set():
+                    break
+            self._t.join(timeout=2.0)
+            return item
+
+    def lookup(self, d):
+        return d.get("key")  # dict.get always has args: untouched
+"""
+
+
+def test_unbounded_wait_fires_in_server_dir(tmp_path):
+    findings = run_on(tmp_path, UNBOUNDED_BAD, subdir="server")
+    assert rules_of(findings) == ["unbounded-wait"] * 3
+    assert lines_of(findings, "unbounded-wait") == [11, 12, 13]
+
+
+def test_unbounded_wait_quiet_on_bounded_waits(tmp_path):
+    assert run_on(tmp_path, UNBOUNDED_GOOD, subdir="dispatch") == []
+
+
+def test_unbounded_wait_out_of_scope_dirs_ignored(tmp_path):
+    # utils/-style helpers may block forever by design (daemon pools).
+    assert run_on(tmp_path, UNBOUNDED_BAD, subdir="utils") == []
+
+
+def test_unbounded_wait_inline_suppression(tmp_path):
+    src = UNBOUNDED_BAD.replace(
+        "        item = self._q.get()",
+        "        item = self._q.get()  # nta: disable=unbounded-wait")
+    findings = run_on(tmp_path, src, subdir="server")
+    assert lines_of(findings, "unbounded-wait") == [12, 13]
+
+
+# ---------------------------------------------------------------------
+# robustness: swallowed broad exceptions (server/dispatch/client scope)
+
+
+SWALLOWED_BAD = """\
+def risky():
+    pass
+
+def a():
+    try:
+        risky()
+    except Exception:
+        pass
+
+def b():
+    try:
+        risky()
+    except:
+        pass
+
+def c():
+    try:
+        risky()
+    except (ValueError, BaseException):
+        ...
+"""
+
+SWALLOWED_GOOD = """\
+import logging
+
+log = logging.getLogger(__name__)
+
+def risky():
+    pass
+
+def narrow():
+    try:
+        risky()
+    except ValueError:
+        pass  # specific protocol: a late ack is rejected by design
+
+def logged():
+    try:
+        risky()
+    except Exception:
+        log.debug("risky failed", exc_info=True)
+
+def rethrown():
+    try:
+        risky()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+"""
+
+
+def test_swallowed_exception_fires_on_broad_silent_handlers(tmp_path):
+    findings = run_on(tmp_path, SWALLOWED_BAD, subdir="client")
+    assert rules_of(findings) == ["swallowed-exception"] * 3
+    assert lines_of(findings, "swallowed-exception") == [7, 13, 19]
+
+
+def test_swallowed_exception_quiet_on_narrow_logged_rethrown(tmp_path):
+    assert run_on(tmp_path, SWALLOWED_GOOD, subdir="server") == []
+
+
+def test_swallowed_exception_out_of_scope_dirs_ignored(tmp_path):
+    assert run_on(tmp_path, SWALLOWED_BAD, subdir="scheduler") == []
+
+
+def test_swallowed_exception_inline_suppression(tmp_path):
+    src = SWALLOWED_BAD.replace(
+        "    except Exception:",
+        "    except Exception:  # nta: disable=swallowed-exception", 1)
+    findings = run_on(tmp_path, src, subdir="client")
+    assert lines_of(findings, "swallowed-exception") == [13, 19]
