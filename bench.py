@@ -262,6 +262,13 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     config = PlacementConfig(anti_affinity_penalty=penalty,
                              pre_resolve=pre_resolve)
     from nomad_tpu.chaos import chaos
+    from nomad_tpu.trace import (
+        STAGE_DEVICE_DISPATCH,
+        STAGE_MATRIX_BUILD,
+        get_recorder,
+    )
+
+    recorder = get_recorder()
 
     batcher = PlacementBatcher()
     sched_stub = SimpleNamespace(eval=SimpleNamespace(id="bench"), job=job)
@@ -285,10 +292,17 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         workers = batch
 
     def one_eval(seed):
+        # Trace spans mirror the live dense scheduler's stage
+        # attribution (scheduler/tpu.py) so the bench's per-stage p99
+        # table reads off the same flight recorder as production.
+        eid = f"bench-{seed}"
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         rng_local = random.Random(seed)
         matrix = ClusterMatrix(snap, job)
         asks = make_asks(*matrix.build_asks(tg_cycle))
+        recorder.record_span(eid, STAGE_MATRIX_BUILD, tm0)
+        tm1 = time.monotonic()
         for attempt in range(3):
             try:
                 choices, scores = batcher.place(
@@ -299,6 +313,8 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
                     raise
                 with retry_lock:
                     device_retries[0] += 1
+        recorder.record_span(eid, STAGE_DEVICE_DISPATCH, tm1)
+        tm2 = time.monotonic()
         choices = np.asarray(choices)
         scores = np.asarray(scores)
         plan = Plan(job=job)
@@ -324,6 +340,8 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
             plan.append_alloc(_build_allocation(
                 sched_stub, missing, node, task_resources, metrics))
             placed += 1
+        recorder.record_span(eid, "host.finalize", tm2)
+        recorder.complete(eid)
         return placed, time.perf_counter() - t0, choices
 
     pool = ThreadPoolExecutor(max_workers=workers)
@@ -380,9 +398,15 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     # wreck a p99 on its own.
     from nomad_tpu.scheduler.batcher import BATCH_BUCKETS
 
+    # Warmup rounds stay OUT of the stage-attribution table (they
+    # measure compile caches, not steady state); restore whatever arm
+    # (--no-trace) the CLI selected afterwards.
+    _trace_was = recorder.enabled
+    recorder.set_enabled(False)
     for i, warm_n in enumerate((batch, batch) + tuple(BATCH_BUCKETS) + (1,)):
         if warm_n <= batch:
             run_round(10_000 + i * 1000, n=warm_n)
+    recorder.set_enabled(_trace_was)
     stats0 = batcher.stats()
     latencies = []
     placed_total = 0
@@ -896,7 +920,55 @@ def _median_iqr(vals):
 
 
 def run_config(n, reps=DEFAULT_REPS):
+    from nomad_tpu.trace import get_recorder
+
+    get_recorder().reset()  # per-config stage attribution, not cross-config
     runs = [CONFIGS[n]() for _ in range(reps)]
+    return _summarize(n, runs, reps)
+
+
+def run_config_trace_ab(n, reps=DEFAULT_REPS):
+    """run_config with an INTERLEAVED traced/untraced arm per rep: each
+    rep runs the config with the flight recorder on, then immediately
+    again with it off, and the overhead is the MEDIAN of per-rep
+    e2e ratios — pairing cancels host-load drift exactly like the
+    cpu/tpu columns' interleaving (two sequential 3-rep arms measured
+    ±12% 'overhead' in BOTH directions on an idle box). Returns
+    (summary-of-traced-runs, median ratio)."""
+    from nomad_tpu.trace import get_recorder
+
+    rec = get_recorder()
+    rec.reset()
+    runs = []
+    ratios = []
+    untraced_rates = []
+    try:
+        for _ in range(reps):
+            rec.set_enabled(True)
+            r = CONFIGS[n]()
+            runs.append(r)
+            rec.set_enabled(False)
+            u = CONFIGS[n]()
+            ratios.append(r["e2e"] / u["e2e"])
+            untraced_rates.append(u["e2e"])
+    finally:
+        rec.set_enabled(True)
+    out = _summarize(n, runs, reps)
+    ratio, _ = _median_iqr(ratios)
+    out["trace_overhead"] = {
+        "traced_e2e": out["columns"]["e2e"]["median"],
+        "untraced_e2e": round(float(np.median(untraced_rates)), 3),
+        "ratio": round(float(ratio), 4),
+        "per_rep_ratios": [round(float(x), 4) for x in ratios],
+    }
+    out["metric"] += (
+        f"; trace overhead: paired-ratio median x{ratio:.3f} "
+        f"(traced {out['trace_overhead']['traced_e2e']:.1f} vs untraced "
+        f"{out['trace_overhead']['untraced_e2e']:.1f} evals/s)")
+    return out, float(ratio)
+
+
+def _summarize(n, runs, reps):
     name = runs[0]["name"]
     cols = {}
     for key in runs[0]:
@@ -942,6 +1014,22 @@ def run_config(n, reps=DEFAULT_REPS):
             "median"]
         out["metric"] += (
             f" (pre-resolve OFF: {out['retries_per_eval_nopre']:.3f})")
+    # Per-stage latency attribution from the flight recorder
+    # (nomad_tpu/trace): where each eval's time went across the reps —
+    # the in-system answer to "what is the p99 made of". Empty when
+    # --no-trace disabled the recorder.
+    from nomad_tpu.trace import get_recorder
+
+    stages = get_recorder().stage_stats()
+    if stages:
+        out["stage_p99_ms"] = {
+            k: v["p99_ms"] for k, v in sorted(stages.items())}
+        out["stage_table"] = stages
+        top = sorted(
+            ((k, v["p99_ms"]) for k, v in stages.items() if k != "e2e"),
+            key=lambda kv: -kv[1])[:3]
+        out["metric"] += "; stage p99 " + ", ".join(
+            f"{k}={v:.1f}ms" for k, v in top)
     return out
 
 
@@ -1057,7 +1145,17 @@ def main():
                              "fault schedule (nomad_tpu/chaos); reports "
                              "degraded-mode occupancy + retries/eval "
                              "alongside the clean numbers")
+    parser.add_argument("--no-trace", action="store_true",
+                        help="disable the eval-lifecycle flight recorder "
+                             "(nomad_tpu/trace) for this run — the A/B "
+                             "arm the --check overhead gate compares "
+                             "against")
     args = parser.parse_args()
+
+    from nomad_tpu.trace import get_recorder
+
+    if args.no_trace:
+        get_recorder().set_enabled(False)
 
     if args.check:
         bad = ntalint_purity_gate()
@@ -1071,6 +1169,18 @@ def main():
             sys.exit(2)
         print("bench: ntalint trace-purity gate clean", file=sys.stderr)
 
+    if args.check and not args.no_trace and (args.all
+                                             or args.chaos is not None):
+        # The trace-overhead A/B gate needs paired traced/untraced runs
+        # of ONE config; doubling the whole matrix (--all) or the chaos
+        # A/B would conflate arms. Say so loudly — a silent skip would
+        # read as "gate passed".
+        print("bench: NOTE --check's trace-overhead gate only applies "
+              "to single-config runs; run `bench.py --check --config "
+              f"{HEADLINE_CONFIG}` for the gated traced-vs-untraced "
+              "comparison (the purity gate above DID run)",
+              file=sys.stderr)
+
     if args.chaos is not None:
         print(json.dumps(run_chaos(args.chaos)))
         return
@@ -1078,8 +1188,25 @@ def main():
     if args.all:
         for n in sorted(CONFIGS):
             print(json.dumps(run_config(n, reps=args.reps)))
+        return
+
+    if args.check and not args.no_trace:
+        # Trace-overhead gate: the always-on recorder must be close to
+        # free. Each rep runs traced then untraced back to back and
+        # the gate reads the MEDIAN of per-rep ratios — refusing to
+        # report if tracing cost more than 5% of median e2e.
+        out, ratio = run_config_trace_ab(args.config, reps=args.reps)
+        if ratio < 0.95:
+            print(json.dumps(out), file=sys.stderr)
+            print(f"bench: REFUSING to report — tracing cost "
+                  f"{(1 - ratio) * 100:.1f}% of median e2e (> 5% "
+                  f"budget; per-rep ratios "
+                  f"{out['trace_overhead']['per_rep_ratios']})",
+                  file=sys.stderr)
+            sys.exit(2)
     else:
-        print(json.dumps(run_config(args.config, reps=args.reps)))
+        out = run_config(args.config, reps=args.reps)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

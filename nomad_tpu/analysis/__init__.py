@@ -10,9 +10,12 @@ Three checker families, run over `nomad_tpu/` as a tier-1 test
   mutation, Python branching on traced values, unhashable static args.
 - ``snapshot`` — scheduler/dispatch modules read cluster state only
   through StateStore.snapshot() handles, never the live store.
-- ``robustness`` — no unbounded waits in server//dispatch/ and no
-  silently-swallowed broad exceptions in server//dispatch//client/
-  (the failure classes nomad_tpu/chaos fault injection hunts).
+- ``robustness`` — no unbounded waits in server//dispatch//trace/, no
+  silently-swallowed broad exceptions in server//dispatch//client//
+  trace/ (the failure classes nomad_tpu/chaos fault injection hunts),
+  and no blocking call or unbounded container growth on the flight
+  recorder's record path (`NTA_RECORD_PATH` manifest — the functions
+  the broker lock and the dispatcher thread run).
 """
 
 from .core import (  # noqa: F401
@@ -36,4 +39,5 @@ ALL_RULES = (
     "live-state-read",
     "unbounded-wait",
     "swallowed-exception",
+    "record-path-blocking",
 )

@@ -113,6 +113,66 @@ class Module:
         return ".".join(reversed(parts)) if parts else "<module>"
 
 
+# ----------------------------------------------- intra-module call graph
+#
+# Shared by every manifest-reachability rule (locks.py
+# NTA_DISPATCHER_ENTRYPOINTS, robustness.py NTA_RECORD_PATH): ONE
+# definition of "reachable from" so the rules' notions of the call
+# graph cannot drift. Direct calls only — `self.m()` within a class,
+# bare `f()` at module level; references handed to pools/threads are
+# not followed (they run on other threads, which is exactly the
+# sanctioned fix for a dispatcher finding).
+
+
+def module_functions(tree: ast.Module) -> Dict[str, "ast.FunctionDef"]:
+    """qualname -> FunctionDef for every def: methods as Class.method,
+    module-level functions bare."""
+    functions: Dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    functions[f"{node.name}.{sub.name}"] = sub
+    return functions
+
+
+def direct_calls(qual: str, fn: "ast.FunctionDef",
+                 functions: Dict[str, "ast.FunctionDef"]) -> set:
+    """The qualnames `fn` calls directly."""
+    cls = qual.split(".")[0] if "." in qual else None
+    out = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self" and cls is not None):
+            cand = f"{cls}.{func.attr}"
+            if cand in functions:
+                out.add(cand)
+        elif isinstance(func, ast.Name) and func.id in functions:
+            out.add(func.id)
+    return out
+
+
+def reachable_from(entries, functions: Dict[str, "ast.FunctionDef"],
+                   calls: Dict[str, set]) -> set:
+    """Transitive closure of `entries` over the direct-call graph."""
+    seen = set()
+    todo = [e for e in entries if e in functions]
+    while todo:
+        cur = todo.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        todo.extend(calls.get(cur, ()))
+    return seen
+
+
 def _iter_py_files(paths: List[str]) -> List[str]:
     out: List[str] = []
     for p in paths:

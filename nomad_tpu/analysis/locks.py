@@ -36,7 +36,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Module
+from .core import Finding, Module, direct_calls, reachable_from
 
 RULE_GUARDED = "guarded-by"
 RULE_LOCK_BLOCKING = "lock-blocking-call"
@@ -208,20 +208,10 @@ class _ModuleIndex:
                 info.guarded[attr] = info.locks[name]
 
     def _direct_calls(self, qual: str, fn: ast.FunctionDef) -> Set[str]:
-        cls = qual.split(".")[0] if "." in qual else None
-        out: Set[str] = set()
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            attr = _self_attr(func)
-            if attr is not None and cls is not None:
-                cand = f"{cls}.{attr}"
-                if cand in self.functions:
-                    out.add(cand)
-            elif isinstance(func, ast.Name) and func.id in self.functions:
-                out.add(func.id)
-        return out
+        # Shared walker (core.direct_calls): the dispatcher rule and
+        # robustness' record-path rule must agree on what "reachable"
+        # means.
+        return direct_calls(qual, fn, self.functions)
 
     # ------------------------------------------------------ resolution
 
@@ -250,15 +240,7 @@ class _ModuleIndex:
 
 
 def _dispatcher_reachable(index: _ModuleIndex) -> Set[str]:
-    seen: Set[str] = set()
-    todo = [e for e in index.entrypoints if e in index.functions]
-    while todo:
-        cur = todo.pop()
-        if cur in seen:
-            continue
-        seen.add(cur)
-        todo.extend(index.calls.get(cur, ()))
-    return seen
+    return reachable_from(index.entrypoints, index.functions, index.calls)
 
 
 class _FunctionWalker:

@@ -1,42 +1,82 @@
-"""Robustness checkers: waits that can hang forever and exception
-handlers that hide faults.
+"""Robustness checkers: waits that can hang forever, exception
+handlers that hide faults, and blocking/unbounded work on the flight
+recorder's record path.
 
-Two rules, both scoped to the control-plane dirs where fault injection
-(nomad_tpu/chaos) hunts — an unbounded wait turns an injected fault
-into a hung thread instead of a recovered one, and a swallowed
-exception is exactly how injection findings hide:
+Three rules. The first two are scoped to the control-plane dirs where
+fault injection (nomad_tpu/chaos) hunts — an unbounded wait turns an
+injected fault into a hung thread instead of a recovered one, and a
+swallowed exception is exactly how injection findings hide:
 
-- ``unbounded-wait`` (``server/`` and ``dispatch/``): a no-argument
-  ``.wait()`` / ``.get()`` / ``.join()`` call blocks forever with no
-  shutdown re-check; every such wait must be bounded (pass a timeout
-  and re-check stop/shutdown in a loop). ``dict.get`` is untouched —
-  it always takes at least one argument.
+- ``unbounded-wait`` (``server/``, ``dispatch/``, ``trace/``): a
+  no-argument ``.wait()`` / ``.get()`` / ``.join()`` call blocks
+  forever with no shutdown re-check; every such wait must be bounded
+  (pass a timeout and re-check stop/shutdown in a loop). ``dict.get``
+  is untouched — it always takes at least one argument.
 
-- ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``):
-  an ``except Exception:`` / ``except BaseException:`` / bare
-  ``except:`` whose entire body is ``pass`` (or ``...``). Either
+- ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``,
+  ``trace/``): an ``except Exception:`` / ``except BaseException:`` /
+  bare ``except:`` whose entire body is ``pass`` (or ``...``). Either
   narrow the exception type, log it, or suppress explicitly with
   ``# nta: disable=swallowed-exception`` and a justification. Handlers
   for SPECIFIC exception types (``except ValueError: pass``) are a
   deliberate protocol and stay quiet.
+
+- ``record-path-blocking`` — a module that declares a flight-recorder
+  record-path manifest::
+
+      NTA_RECORD_PATH = ("FlightRecorder.record_span", ...)
+
+  gets every function reachable from those entrypoints (direct
+  intra-module calls, the same reachability the dispatcher rule uses —
+  these are the functions the broker lock and the dispatcher thread's
+  ``NTA_DISPATCHER_ENTRYPOINTS`` chain run) checked for:
+
+  * blocking calls — ``sleep``/``wait``/``join``/``acquire``/
+    ``result``/``urlopen``/socket sends — with or WITHOUT a timeout:
+    the record path may not park at all, bounded or not (a ``with
+    lock:`` around constant work is the only sanctioned
+    synchronization);
+  * unbounded container growth — ``.append``/``.extend``/``.insert``/
+    ``.setdefault``/``.add`` on an attribute-rooted container
+    (``self.ring.append``, ``entry.spans.append``). Fixed-memory
+    storage writes into PREALLOCATED slots by index; growth calls on
+    locals (bounded scratch) stay quiet.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import Dict, List, Set
 
-from .core import Finding, Module
+from .core import (
+    Finding,
+    Module,
+    direct_calls,
+    module_functions,
+    reachable_from,
+)
 
 RULE_UNBOUNDED_WAIT = "unbounded-wait"
 RULE_SWALLOWED = "swallowed-exception"
+RULE_RECORD_PATH = "record-path-blocking"
 
-WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/")
-SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/")
+WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/")
+SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/")
 
 # Attribute calls that block forever when called with no timeout.
 UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}
 BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+RECORD_MANIFEST = "NTA_RECORD_PATH"
+# Blocking regardless of arguments: the record path may not park.
+RECORD_BLOCKING_ATTRS = {"sleep", "wait", "join", "acquire", "result",
+                         "urlopen", "recv", "send", "sendall", "sendto",
+                         "block_until_ready", "submit_plan"}
+RECORD_BLOCKING_NAMES = {"sleep", "urlopen"}
+# Container growth calls; fine on locals, flagged on attribute-rooted
+# receivers (an attribute outlives the call — that is where unbounded
+# memory hides).
+RECORD_GROWTH_ATTRS = {"append", "extend", "insert", "setdefault", "add"}
 
 
 def _in_scope(rel_path: str, markers) -> bool:
@@ -100,10 +140,94 @@ def _check_swallowed(mod: Module, findings: List[Finding]) -> None:
             mod.symbol_of(node)))
 
 
+# ------------------------------------------------- record-path rule
+
+
+def _functions_and_calls(mod: Module):
+    """(qualname -> FunctionDef, qualname -> direct callee qualnames):
+    THE intra-module call graph (core.module_functions/direct_calls) —
+    shared with the dispatcher rule so the two manifests' notions of
+    "reachable" cannot drift. References handed to pools/threads are
+    not followed (they run on other threads; for the RECORD path there
+    is no such escape hatch — handing work off would itself be an
+    allocation per record)."""
+    functions = module_functions(mod.tree)
+    calls: Dict[str, Set[str]] = {
+        qual: direct_calls(qual, fn, functions)
+        for qual, fn in functions.items()
+    }
+    return functions, calls
+
+
+def _record_manifest(mod: Module) -> List[str]:
+    out: List[str] = []
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == RECORD_MANIFEST:
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            out.append(el.value)
+    return out
+
+
+def _attribute_rooted(expr: ast.AST) -> bool:
+    """True when the receiver chain goes through an attribute access —
+    i.e. the container outlives the call (self.x, entry.spans,
+    self.a[i].b); plain locals/params are bounded scratch."""
+    return any(isinstance(n, ast.Attribute) for n in ast.walk(expr))
+
+
+def _check_record_path(mod: Module, findings: List[Finding]) -> None:
+    entries = _record_manifest(mod)
+    if not entries:
+        return
+    functions, calls = _functions_and_calls(mod)
+    reachable = reachable_from(entries, functions, calls)
+    for qual in sorted(reachable):
+        for node in ast.walk(functions[qual]):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                if func.id in RECORD_BLOCKING_NAMES:
+                    findings.append(Finding(
+                        RULE_RECORD_PATH, mod.rel, node.lineno,
+                        node.col_offset,
+                        f"blocking call '{func.id}' on the flight-"
+                        f"recorder record path (manifest "
+                        f"{RECORD_MANIFEST}); the record path must "
+                        f"never park", qual))
+                continue
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr in RECORD_BLOCKING_ATTRS:
+                findings.append(Finding(
+                    RULE_RECORD_PATH, mod.rel, node.lineno,
+                    node.col_offset,
+                    f"blocking call '.{func.attr}()' on the flight-"
+                    f"recorder record path (manifest "
+                    f"{RECORD_MANIFEST}); the record path must never "
+                    f"park, bounded or not", qual))
+            elif (func.attr in RECORD_GROWTH_ATTRS
+                    and _attribute_rooted(func.value)):
+                findings.append(Finding(
+                    RULE_RECORD_PATH, mod.rel, node.lineno,
+                    node.col_offset,
+                    f"unbounded growth '.{func.attr}()' on an "
+                    f"attribute-rooted container on the record path — "
+                    f"write into preallocated slots by index "
+                    f"(drop-oldest ring), never grow", qual))
+
+
 def check(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
     if _in_scope(mod.rel, WAIT_SCOPE_MARKERS):
         _check_unbounded_waits(mod, findings)
     if _in_scope(mod.rel, SWALLOW_SCOPE_MARKERS):
         _check_swallowed(mod, findings)
+    _check_record_path(mod, findings)
     return findings

@@ -138,6 +138,12 @@ class HTTPServer:
 
             def _dispatch(self):
                 _start = time.monotonic()
+                # Set by api.handle when a route matches; a single
+                # undifferentiated ("http", "request") sample mixed
+                # every route into one meaningless distribution — the
+                # histogram percentiles only mean something per
+                # (method, route).
+                self.nomad_route = "unmatched"
                 try:
                     body = api.handle(self)
                 except HTTPError as e:
@@ -151,7 +157,9 @@ class HTTPServer:
                     index = (api.server.fsm.state.latest_index()
                              if api.server is not None else 0)
                     self._reply(200, body, index)
-                metrics.measure_since(("http", "request"), _start)
+                metrics.measure_since(
+                    ("http", "request", self.command, self.nomad_route),
+                    _start)
 
             def _reply(self, status, body, index=None):
                 stream = None
@@ -287,6 +295,8 @@ class HTTPServer:
             (r"^/v1/status/leader$", self._status_leader),
             (r"^/v1/status/peers$", self._status_peers),
             (r"^/v1/agent/self$", self._agent_self),
+            (r"^/v1/agent/trace$", self._agent_trace),
+            (r"^/v1/metrics$", self._metrics),
             (r"^/v1/system/gc$", self._system_gc),
             (r"^/v1/client/fs/ls/(?P<alloc_id>[^/]+)$", self._fs_ls),
             (r"^/v1/client/fs/stat/(?P<alloc_id>[^/]+)$", self._fs_stat),
@@ -319,11 +329,17 @@ class HTTPServer:
             self._fs_logs, self._client_stats, self._client_alloc_stats,
             self._client_alloc_snapshot,
             self._agent_self, self._agent_servers,
+            self._agent_trace, self._metrics,
             self._debug_stacks, self._debug_profile, self._debug_vars,
         }
         for pattern, handler in route_handlers:
             m = re.match(pattern, path)
             if m:
+                # Route tag for the per-route request histogram: the
+                # handler's name is a stable, low-cardinality stand-in
+                # for the route pattern (path params never leak into
+                # metric names).
+                req.nomad_route = handler.__name__.lstrip("_")
                 if self.server is None and handler not in client_only_ok:
                     raise HTTPError(
                         501, "server not enabled on this agent")
@@ -683,6 +699,39 @@ class HTTPServer:
         if dispatch is not None:
             out["dispatch_pipeline"] = dispatch.stats()
         return out
+
+    def _agent_trace(self, method, query, body):
+        """Eval-lifecycle traces from the local flight recorder
+        (nomad_tpu/trace): recent completed span trees, the tail-kept
+        slow traces (past the rolling e2e p99), the per-stage latency
+        table, and recorder health counters. ?limit=N bounds the recent
+        list; ?eval=<id> fetches one eval's trace."""
+        from ..trace import get_recorder
+
+        rec = get_recorder()
+        eval_id = query.get("eval", [""])[0]
+        if eval_id:
+            found = rec.trace_for(eval_id)
+            if found is None:
+                raise HTTPError(404, f"no trace for eval {eval_id!r}")
+            return {"trace": found}
+        limit = int(query.get("limit", ["50"])[0])
+        return {
+            "recent": rec.traces(limit),
+            "tail": rec.tail_traces(),
+            "stages": rec.stage_stats(),
+            "recorder": rec.stats(),
+        }
+
+    def _metrics(self, method, query, body):
+        """Prometheus text exposition of the shared telemetry registry
+        (counters/gauges + log-bucket histograms for every timing
+        sample). format=json returns the raw inmem snapshot instead."""
+        if query.get("format", [""])[0] == "json":
+            return metrics.get_metrics().snapshot()
+        return RawResponse(
+            metrics.format_prometheus().encode(),
+            "text/plain; version=0.0.4; charset=utf-8")
 
     def _system_gc(self, method, query, body):
         self.server.force_gc()

@@ -217,6 +217,15 @@ class ChaosRegistry:
             action = spec.kind
             delay = spec.delay
             self._log.append((site, n, action, delay))
+        # Trace correlation: when the firing call carries an eval
+        # context, stamp (site, ordinal, kind) onto that eval's trace —
+        # at completion it lands on the span covering the firing time,
+        # so a seeded replay pinpoints which stage a fault inflated.
+        eval_id = ctx.get("eval_id")
+        if eval_id:
+            from ..trace import annotate_fault
+
+            annotate_fault(eval_id, site, n, action)
         # Side effects OUTSIDE the lock: a delay must never hold up
         # unrelated sites' decisions, and the raise must not poison the
         # registry state.

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from .. import trace
 from ..chaos import chaos
 from ..scheduler import new_scheduler
 from ..server.worker import EvalSession
@@ -131,6 +132,8 @@ class PipelineSession(EvalSession):
             except ValueError:
                 pass
         self.pipeline._note_submit(start)
+        trace.record_span(self.eval.id, trace.STAGE_PLAN_SUBMIT, start,
+                          trace_id=self.eval.trace_id)
         if result.refresh_index:
             self.pipeline._note_conflict()
             if (self.created_evals == 0
@@ -353,6 +356,16 @@ class DispatchPipeline:
         return batch
 
     def _launch(self, batch: List[_Pending]) -> None:
+        # Trace: the accumulate stage closes when the batch is cut.
+        # Recorded HERE (stage thread) rather than in _accumulate so
+        # the dispatcher thread carries zero extra work per batch.
+        t_launch = time.monotonic()
+        for entry in batch:
+            trace.record_span(
+                entry.eval.id, trace.STAGE_DISPATCH_ACCUMULATE,
+                entry.enqueued_at, t_launch,
+                ann={"batch": len(batch), "requeues": entry.requeues},
+                trace_id=entry.eval.trace_id)
         # The whole prologue is guarded: it runs on a pool thread now,
         # where an escaped exception dies into an unread PoolFuture —
         # and the slot _accumulate took would leak, wedging the
@@ -363,6 +376,11 @@ class DispatchPipeline:
             self.logger.exception(
                 "batch launch failed; nacking %d evals", len(batch))
             prologue = None
+        for entry in batch:
+            trace.record_span(
+                entry.eval.id, trace.STAGE_DISPATCH_LAUNCH, t_launch,
+                ann=({"failed": True} if prologue is None else None),
+                trace_id=entry.eval.trace_id)
         # Single abort call site: an abort raising INSIDE the try must
         # never be re-entered by the except path (double slot release).
         if prologue is None:
@@ -469,6 +487,9 @@ class DispatchPipeline:
             with self._lock:
                 self.requeues += 1
                 self.t_process += time.monotonic() - start
+            trace.record_span(ev.id, trace.STAGE_SCHED_PROCESS, start,
+                              ann={"path": "pipeline", "requeued": True},
+                              trace_id=ev.trace_id)
             metrics.incr_counter(("dispatch", "requeue"))
             self._repay_unconsumed(session)
             # Back into the ACCUMULATING batch; the broker token stays
@@ -481,12 +502,19 @@ class DispatchPipeline:
             self.logger.exception("pipeline eval %s failed", ev.id)
             with self._lock:
                 self.t_process += time.monotonic() - start
+            trace.record_span(ev.id, trace.STAGE_SCHED_PROCESS, start,
+                              ann={"path": "pipeline", "failed": True},
+                              trace_id=ev.trace_id)
             self._repay_unconsumed(session)
             self._finish(entry, acked=False)
             self._release_slot(remaining)
             return
         with self._lock:
             self.t_process += time.monotonic() - start
+        trace.record_span(
+            ev.id, trace.STAGE_SCHED_PROCESS, start,
+            ann={"path": "pipeline", "route_host": route_host},
+            trace_id=ev.trace_id)
         self._repay_unconsumed(session)
         self._finish(entry, acked=True)
         self._release_slot(remaining)

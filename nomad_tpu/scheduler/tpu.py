@@ -21,10 +21,12 @@ from __future__ import annotations
 
 import logging
 import random
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import trace
 from ..structs import (
     Allocation,
     AllocMetric,
@@ -136,11 +138,15 @@ class BatchedTPUScheduler(GenericScheduler):
             super()._compute_placements(bulk)
             return
 
+        _t0 = time.monotonic()
         matrix = ClusterMatrix(self.state, self.job, self.plan)
         tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
         placements = [tg_indices[m.task_group.name] for m in bulk]
 
         asks = make_asks(*matrix.build_asks(placements))
+        trace.record_span(self.eval.id, trace.STAGE_MATRIX_BUILD, _t0,
+                          ann={"placements": len(bulk)},
+                          trace_id=self.eval.trace_id)
         penalty = (
             BATCH_JOB_ANTI_AFFINITY_PENALTY
             if self.batch
@@ -170,6 +176,7 @@ class BatchedTPUScheduler(GenericScheduler):
         # workers' same-shaped placement programs coalesce into one
         # vmapped device dispatch instead of N serial calls, and evals
         # sharing a cluster base ride one cached device upload.
+        _t0 = time.monotonic()
         try:
             choices, scores = get_batcher().place(matrix, asks, key, config)
         except Exception:
@@ -186,10 +193,15 @@ class BatchedTPUScheduler(GenericScheduler):
             from ..utils import metrics
 
             metrics.incr_counter(("scheduler", "host_fallback"), len(bulk))
+            trace.record_span(
+                self.eval.id, trace.STAGE_DEVICE_DISPATCH, _t0,
+                ann={"host_fallback": True}, trace_id=self.eval.trace_id)
             super()._compute_placements(bulk)
             return
         choices = np.asarray(choices)
         scores = np.asarray(scores)
+        trace.record_span(self.eval.id, trace.STAGE_DEVICE_DISPATCH, _t0,
+                          trace_id=self.eval.trace_id)
 
         # Host-side exact port assignment per chosen node, incremental.
         net_indexes: Dict[str, NetworkIndex] = {}
@@ -404,6 +416,7 @@ class DenseSystemScheduler(SystemScheduler):
                 pinned_ids.append(nid)
         by_id = {n.id: n for n in self.nodes}
         pinned_nodes = [by_id[nid] for nid in pinned_ids if nid in by_id]
+        _t0 = time.monotonic()
         matrix = ClusterMatrix(self.state, self.job, self.plan,
                                nodes=pinned_nodes)
         matrix.nodes_by_dc = self.nodes_by_dc
@@ -413,6 +426,9 @@ class DenseSystemScheduler(SystemScheduler):
         placements = [tg_by_name[m.task_group.name] for m in place]
         resources, bw, ports, _tg_index, _active, _jdh, _tdh = \
             matrix.build_asks(placements)
+        trace.record_span(self.eval.id, trace.STAGE_MATRIX_BUILD, _t0,
+                          ann={"placements": len(place), "pinned": True},
+                          trace_id=self.eval.trace_id)
 
         util = matrix.util.copy()
         bw_used = matrix.bw_used.copy()

@@ -20,6 +20,7 @@ from ..structs import Evaluation, consts
 from ..utils import metrics
 from ..utils.ids import generate_uuid
 from ..utils.timer import default_wheel
+from .. import trace
 
 FAILED_QUEUE = "_failed"
 
@@ -151,6 +152,15 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
         if not self._enabled:
             return
+        # Trace: stamp the enqueue instant (redeliveries re-stamp, so a
+        # nacked eval's next broker.wait span measures ITS wait). The
+        # recorder is a leaf lock and never blocks (ntalint
+        # record-path-blocking) — safe under the broker lock. The
+        # failed queue is excluded: its trace was already completed as
+        # 'dead-letter', and marking the dead copy would open a second
+        # bogus trace that the reaper's dequeue+ack then publishes.
+        if queue != FAILED_QUEUE:
+            trace.mark(ev.id, ev.trace_id)
         # Per-job serialization: the job is claimed by the first eval;
         # later ones wait in the per-job blocked heap until Ack.
         claimed = self._job_evals.get(ev.job_id, "")
@@ -243,10 +253,14 @@ class EvalBroker:
 
     def _dequeue_locked(self, ev: Evaluation) -> Tuple[Evaluation, str]:
         token = generate_uuid()
-        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        deliveries = self._evals.get(ev.id, 0) + 1
+        self._evals[ev.id] = deliveries
         timer = self._wheel.schedule(
             self.nack_timeout, self._nack_timeout, ev.id, token)
         self._unack[ev.id] = _Unack(ev, token, timer)
+        trace.record_since_mark(
+            ev.id, trace.STAGE_BROKER_WAIT,
+            {"deliveries": deliveries, "type": ev.type})
         return ev, token
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
@@ -299,6 +313,14 @@ class EvalBroker:
                     del self._blocked[job_id]
                 if nxt is not None:
                     self._enqueue_locked(nxt, nxt.type)
+            # Ack is the lifecycle's last breath: the plan (if any)
+            # already committed before the worker acked, so the span
+            # tree is whole. Completed BEFORE the reblock re-enqueue:
+            # _process_enqueue marks the requeued run's enqueue instant
+            # on what must be a FRESH trace — completing afterwards
+            # would pop that mark and split the requeued lifecycle.
+            # (Leaf locks only; same pattern as the dead-letter path.)
+            trace.complete(eval_id, "acked")
             # Process a reblock submitted while this eval was outstanding.
             requeued = self._requeue.pop(token, None)
             if requeued is not None:
@@ -319,6 +341,10 @@ class EvalBroker:
             # evals in server.stats().
             deliveries = self._evals.get(ev.id, 0)
             if deliveries >= self.delivery_limit:
+                # A dead-lettered eval never acks: close its trace here
+                # (the nacked-but-redelivering case below keeps the
+                # trace open — its next delivery keeps appending spans).
+                trace.complete(ev.id, "dead-letter")
                 dead = ev.copy()
                 # Idempotent: a reaper whose eval_update failed (leader
                 # flap) lets the nack timer re-park the ALREADY

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 from ..state import PeriodicLaunch, StateStore
 from ..utils import metrics
 from ..structs import Allocation, Evaluation, Job, Node, consts
+from .. import trace
 from .timetable import TimeTable
 
 # Log message types (structs.go:40-53)
@@ -191,7 +192,17 @@ class FSM:
         for alloc in allocs:
             if alloc.job is None and job is not None:
                 alloc.job = job
+        t0 = time.monotonic()
         self.state.upsert_allocs(index, allocs)
+        # Trace: the state-store write is the lifecycle's last
+        # side-effecting stage; one span per eval whose allocs landed
+        # in this apply (a plan's allocs share one eval). create=False:
+        # this handler ALSO runs on followers and on raft-log replay,
+        # where no broker opened the trace — only an active (leader,
+        # live) lifecycle records here.
+        for eval_id in {a.eval_id for a in allocs if a.eval_id}:
+            trace.record_span(eval_id, trace.STAGE_ALLOC_UPSERT, t0,
+                              ann={"index": index}, create=False)
         return None
 
     def _apply_alloc_client_update(self, index: int, payload: dict):

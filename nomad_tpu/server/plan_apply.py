@@ -18,6 +18,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from .. import trace
 from ..structs import Allocation, Plan, PlanResult, allocs_fit, consts, remove_allocs
 from ..utils import metrics
 from .fsm import ALLOC_UPDATE
@@ -271,6 +272,7 @@ class PlanApplier:
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
         """Per-node verification with partial commit
         (plan_apply.go:194 evaluatePlan)."""
+        _t0 = time.monotonic()
         result = PlanResult(
             node_update=dict(plan.node_update),
             node_allocation=dict(plan.node_allocation),
@@ -296,6 +298,10 @@ class PlanApplier:
                 result.refresh_index = snapshot.latest_index()
                 self.plans_rejected += 1
                 self.nodes_rejected += rejected
+                trace.record_span(
+                    plan.eval_id, trace.STAGE_PLAN_EVALUATE, _t0,
+                    ann={"nodes_rejected": rejected, "gang": True},
+                    create=False)
                 return result
             result.node_update.pop(node_id, None)
             result.node_allocation.pop(node_id, None)
@@ -303,6 +309,13 @@ class PlanApplier:
         if rejected:
             self.plans_rejected += 1
             self.nodes_rejected += rejected
+        # create=False: the applier serves remote (follower-worker)
+        # plans too — their lifecycle trace lives in the follower's
+        # process, not this one.
+        trace.record_span(
+            plan.eval_id, trace.STAGE_PLAN_EVALUATE, _t0,
+            ann=({"nodes_rejected": rejected} if rejected else None),
+            create=False)
         return result
 
     def stats(self) -> dict:
@@ -325,6 +338,8 @@ class PlanApplier:
         index = self.log.apply(
             ALLOC_UPDATE, {"allocs": allocs, "job": plan.job}
         )
+        trace.record_span(plan.eval_id, trace.STAGE_PLAN_COMMIT, start,
+                          ann={"allocs": len(allocs)}, create=False)
         # Stamp indexes onto the result's alloc objects the way the Go
         # store mutates shared pointers — workers count fresh placements
         # by create_index == alloc_index (scheduler/util.py).

@@ -28,6 +28,7 @@ from ..structs import (
 )
 from ..utils.ids import generate_uuid
 from ..utils.pool import WorkPool
+from .. import trace
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import FAILED_QUEUE, EvalBroker
@@ -1201,6 +1202,10 @@ class Server:
             "num_workers": len(self.workers),
             "dispatch_pipeline": self.dispatch.stats(),
             "plan_applier": self.plan_applier.stats(),
+            # Per-stage eval-lifecycle latency table (nomad_tpu/trace):
+            # count/mean/max + log-bucket p50/p95/p99 per stage, plus
+            # the e2e row — the north-star p99, attributed.
+            "trace": trace.get_recorder().stage_stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

@@ -28,6 +28,7 @@ from ..scheduler import new_scheduler
 from ..utils import metrics
 from ..utils.backoff import poll_until
 from ..structs import Evaluation, Plan, PlanResult, consts
+from .. import trace
 
 DEQUEUE_TIMEOUT = 0.5
 BACKOFF_BASE = 0.02
@@ -77,6 +78,8 @@ class EvalSession:
             except ValueError:
                 pass
         metrics.measure_since(("worker", "submit_plan"), start)
+        trace.record_span(self.eval.id, trace.STAGE_PLAN_SUBMIT, start,
+                          trace_id=self.eval.trace_id)
         if result.refresh_index:
             # Stale snapshot: catch up and hand back fresh state.
             self.worker._wait_for_index(result.refresh_index, timeout=5.0)
@@ -237,6 +240,9 @@ class Worker:
             return
         finally:
             metrics.measure_since(("worker", "invoke_scheduler", ev.type), start)
+            trace.record_span(ev.id, trace.STAGE_SCHED_PROCESS, start,
+                              ann={"path": "worker"},
+                              trace_id=ev.trace_id)
         try:
             self.server.eval_ack(ev.id, token)
         except ValueError:

@@ -42,6 +42,11 @@ class Evaluation:
     snapshot_index: int = 0
     create_index: int = 0
     modify_index: int = 0
+    # Eval-lifecycle trace id (nomad_tpu/trace): stamped at creation,
+    # carried through broker/dispatch/scheduler/plan so every layer's
+    # spans land in one tree. Empty on evals minted by older callers —
+    # the recorder falls back to the eval id.
+    trace_id: str = ""
 
     def copy(self) -> "Evaluation":
         return copy.deepcopy(self)
@@ -83,6 +88,7 @@ class Evaluation:
             status=consts.EVAL_STATUS_PENDING,
             wait=wait,
             previous_eval=self.id,
+            trace_id=generate_uuid(),
         )
 
     def create_blocked_eval(
@@ -102,6 +108,7 @@ class Evaluation:
             previous_eval=self.id,
             class_eligibility=dict(class_eligibility),
             escaped_computed_class=escaped,
+            trace_id=generate_uuid(),
         )
 
 
@@ -116,4 +123,5 @@ def new_eval(job: Job, triggered_by: str) -> Evaluation:
         # bump the latter without changing the job spec.
         job_modify_index=job.job_modify_index,
         status=consts.EVAL_STATUS_PENDING,
+        trace_id=generate_uuid(),
     )

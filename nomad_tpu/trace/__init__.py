@@ -1,0 +1,61 @@
+"""Eval-lifecycle tracing: flight-recorder spans with p99 stage
+attribution (see trace/README.md).
+
+Every evaluation yields a span tree — broker wait, dispatch-pipeline
+accumulate/launch, scheduler invoke, matrix build, device dispatch,
+plan submit/evaluate/commit, FSM alloc upsert — recorded into a
+bounded lock-striped ring buffer (recorder.py). Exposed via
+``/v1/agent/trace`` (recent + tail-kept traces), ``/v1/metrics``
+(Prometheus exposition of the shared telemetry registry), and the
+per-stage latency table in ``server.stats()["trace"]``.
+
+Call sites use the module-level helpers below against the process-wide
+recorder; all of them are no-ops when the recorder is disabled and
+never raise into the instrumented path.
+"""
+
+from .recorder import FlightRecorder  # noqa: F401
+from .span import (  # noqa: F401
+    ALL_STAGES,
+    LIFECYCLE_CORE_STAGES,
+    STAGE_ALLOC_UPSERT,
+    STAGE_BROKER_WAIT,
+    STAGE_DEVICE_DISPATCH,
+    STAGE_DISPATCH_ACCUMULATE,
+    STAGE_DISPATCH_LAUNCH,
+    STAGE_MATRIX_BUILD,
+    STAGE_PLAN_COMMIT,
+    STAGE_PLAN_EVALUATE,
+    STAGE_PLAN_SUBMIT,
+    STAGE_SCHED_PROCESS,
+)
+
+# The process-wide recorder every instrumentation site uses. Module
+# level so the disabled check is two attribute loads + a branch (the
+# same shape as chaos.enabled).
+_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def mark(eval_id: str, trace_id: str = "") -> None:
+    _recorder.mark(eval_id, trace_id)
+
+
+def record_since_mark(eval_id: str, stage: str, ann=None) -> None:
+    _recorder.record_since_mark(eval_id, stage, ann)
+
+
+def record_span(eval_id: str, stage: str, t0: float, t1=None, ann=None,
+                trace_id: str = "", create: bool = True) -> None:
+    _recorder.record_span(eval_id, stage, t0, t1, ann, trace_id, create)
+
+
+def annotate_fault(eval_id: str, site: str, seq: int, kind: str) -> None:
+    _recorder.annotate_fault(eval_id, site, seq, kind)
+
+
+def complete(eval_id: str, status: str = "complete") -> None:
+    _recorder.complete(eval_id, status)

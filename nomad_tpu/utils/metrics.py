@@ -9,11 +9,75 @@ per-handler, and rpc.go:168-172.
 
 from __future__ import annotations
 
+import math
+import re
 import socket
 import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+# ------------------------------------------------------ histogram math
+#
+# Log-bucketed latency histograms (milliseconds). Shared by the inmem
+# sink's samples and the trace flight recorder (nomad_tpu/trace) so
+# both report percentiles off the same bucket ladder. Layout:
+#
+#   bucket 0              v <= 0            (upper bound 0)
+#   bucket 1              0 < v <= 1e-3 ms  (sub-microsecond floor)
+#   bucket i >= 2         upper = 1e-3 * RATIO^(i-1)
+#
+# RATIO = 2^(1/4) (~19% bucket width): a percentile read off a bucket's
+# upper bound overstates the true value by at most one ratio step. 200
+# buckets span 1e-3 ms .. ~8e8 ms (~9 days) — everything past the top
+# clamps into the last bucket.
+
+HIST_MIN_MS = 1e-3
+HIST_RATIO = 2.0 ** 0.25
+_HIST_LOG_RATIO = math.log(HIST_RATIO)
+HIST_BUCKETS = 200
+
+
+def hist_bucket(v: float) -> int:
+    """Bucket index for a millisecond value (extremes well-defined:
+    zero/negative -> 0, sub-floor -> 1, huge -> last bucket)."""
+    if v <= 0.0:
+        return 0
+    if v <= HIST_MIN_MS:
+        return 1
+    b = 2 + int(math.log(v / HIST_MIN_MS) / _HIST_LOG_RATIO)
+    return b if b < HIST_BUCKETS else HIST_BUCKETS - 1
+
+
+def hist_bucket_upper(i: int) -> float:
+    """Inclusive upper bound (ms) of bucket `i`."""
+    if i <= 0:
+        return 0.0
+    if i == 1:
+        return HIST_MIN_MS
+    return HIST_MIN_MS * HIST_RATIO ** (i - 1)
+
+
+def hist_percentile(buckets, count: int, q: float) -> float:
+    """The q-quantile read off bucket counts: the upper bound of the
+    bucket where the cumulative count crosses rank ceil(q * count).
+    `buckets` is either a dense count list (flight recorder) or a
+    sparse {bucket_index: count} dict (inmem samples) — one rank-walk
+    serves both so the two surfaces cannot drift. Returns 0.0 on an
+    empty histogram."""
+    if count <= 0:
+        return 0.0
+    rank = max(1, math.ceil(q * count))
+    items = (sorted(buckets.items()) if isinstance(buckets, dict)
+             else enumerate(buckets))
+    cum = 0
+    last = 0
+    for i, c in items:
+        cum += c
+        last = i
+        if cum >= rank:
+            return hist_bucket_upper(i)
+    return hist_bucket_upper(last)
 
 
 class _Interval:
@@ -23,8 +87,11 @@ class _Interval:
         self.start = start
         self.counters: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0])  # count, sum
         self.gauges: Dict[str, float] = {}
-        self.samples: Dict[str, List[float]] = defaultdict(
-            lambda: [0, 0.0, float("inf"), float("-inf")]  # count, sum, min, max
+        # count, sum, min, max, {bucket: count} (log-bucketed, so
+        # p50/p95/p99 are recoverable from any interval snapshot —
+        # count/sum/min/max alone cannot reconstruct a percentile).
+        self.samples: Dict[str, list] = defaultdict(
+            lambda: [0, 0.0, float("inf"), float("-inf"), {}]
         )
 
 
@@ -36,6 +103,11 @@ class InmemSink:
         self.retain = retain
         self._lock = threading.Lock()
         self._intervals: List[_Interval] = [_Interval(time.time())]
+        # Lifetime aggregates, never rotated: the Prometheus surface
+        # reads THESE — counters and histogram buckets exposed from the
+        # rolling intervals would DECREASE as old intervals rotate out,
+        # and every decrease reads as a counter reset to rate().
+        self._life = _Interval(time.time())
 
     def _current(self) -> _Interval:
         now = time.time()
@@ -49,21 +121,40 @@ class InmemSink:
 
     def incr_counter(self, name: str, n: float) -> None:
         with self._lock:
-            c = self._current().counters[name]
-            c[0] += 1
-            c[1] += n
+            for c in (self._current().counters[name],
+                      self._life.counters[name]):
+                c[0] += 1
+                c[1] += n
 
     def set_gauge(self, name: str, v: float) -> None:
         with self._lock:
             self._current().gauges[name] = v
+            self._life.gauges[name] = v
 
     def add_sample(self, name: str, v: float) -> None:
+        b = hist_bucket(v)
         with self._lock:
-            s = self._current().samples[name]
-            s[0] += 1
-            s[1] += v
-            s[2] = min(s[2], v)
-            s[3] = max(s[3], v)
+            for s in (self._current().samples[name],
+                      self._life.samples[name]):
+                s[0] += 1
+                s[1] += v
+                s[2] = min(s[2], v)
+                s[3] = max(s[3], v)
+                s[4][b] = s[4].get(b, 0) + 1
+
+    @staticmethod
+    def _sample_dict(v: list) -> dict:
+        count = v[0]
+        return {
+            "count": count,
+            "sum": v[1],
+            "min": v[2] if count else 0.0,
+            "max": v[3] if count else 0.0,
+            "mean": (v[1] / count) if count else 0.0,
+            "p50": hist_percentile(v[4], count, 0.50),
+            "p95": hist_percentile(v[4], count, 0.95),
+            "p99": hist_percentile(v[4], count, 0.99),
+        }
 
     def snapshot(self, intervals: int = 2) -> List[dict]:
         """The most recent aggregation intervals, newest last."""
@@ -77,17 +168,27 @@ class InmemSink:
                     },
                     "gauges": dict(iv.gauges),
                     "samples": {
-                        k: {
-                            "count": v[0],
-                            "sum": v[1],
-                            "min": v[2] if v[0] else 0.0,
-                            "max": v[3] if v[0] else 0.0,
-                            "mean": (v[1] / v[0]) if v[0] else 0.0,
-                        }
+                        k: self._sample_dict(v)
                         for k, v in iv.samples.items()
                     },
                 })
             return out
+
+    def merged(self) -> dict:
+        """The LIFETIME aggregates (never rotated) — the Prometheus
+        exposition source. Exposing the rolling intervals instead would
+        make _total/_count/_bucket values decrease as intervals rotate
+        out, which rate()/increase() read as counter resets."""
+        with self._lock:
+            return {
+                "counters": {k: list(v)
+                             for k, v in self._life.counters.items()},
+                "gauges": dict(self._life.gauges),
+                "samples": {
+                    k: [v[0], v[1], v[2], v[3], dict(v[4])]
+                    for k, v in self._life.samples.items()
+                },
+            }
 
 
 class StatsdSink:
@@ -354,6 +455,61 @@ def install_signal_dump(signum: Optional[int] = None) -> None:
         print(format_snapshot(_global.snapshot()), file=sys.stderr)
 
     signal.signal(signum, dump)
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _PROM_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_num(v: float) -> str:
+    # Integral values print as integers (the common case for counts);
+    # everything else as repr floats — both are valid exposition.
+    if float(v) == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def format_prometheus(metrics: Optional[Metrics] = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of the inmem sink,
+    aggregated across every retained interval: counters as counters,
+    gauges as gauges, timing samples as histograms over the shared
+    log-bucket ladder (values are MILLISECONDS — measure_since's unit —
+    stated in each HELP line). Served at /v1/agent's sibling route
+    /v1/metrics (api/http.py)."""
+    m = metrics or _global
+    merged = m.inmem.merged()
+    lines: List[str] = []
+    for name in sorted(merged["counters"]):
+        v = merged["counters"][name]
+        p = _prom_name(name)
+        lines.append(f"# HELP {p}_total aggregated counter {name}")
+        lines.append(f"# TYPE {p}_total counter")
+        lines.append(f"{p}_total {_prom_num(v[1])}")
+    for name in sorted(merged["gauges"]):
+        p = _prom_name(name)
+        lines.append(f"# HELP {p} gauge {name}")
+        lines.append(f"# TYPE {p} gauge")
+        lines.append(f"{p} {_prom_num(merged['gauges'][name])}")
+    for name in sorted(merged["samples"]):
+        v = merged["samples"][name]
+        p = _prom_name(name)
+        lines.append(f"# HELP {p} timing sample {name} (milliseconds)")
+        lines.append(f"# TYPE {p} histogram")
+        cum = 0
+        for b in sorted(v[4]):
+            cum += v[4][b]
+            le = hist_bucket_upper(b)
+            lines.append(f'{p}_bucket{{le="{le:g}"}} {cum}')
+        lines.append(f'{p}_bucket{{le="+Inf"}} {v[0]}')
+        lines.append(f"{p}_sum {_prom_num(v[1])}")
+        lines.append(f"{p}_count {v[0]}")
+    return "\n".join(lines) + "\n"
 
 
 def incr_counter(parts, n: float = 1) -> None:

@@ -26,6 +26,112 @@ def test_inmem_counter_gauge_sample_aggregation():
     assert abs(s["mean"] - 3.0) < 1e-9
 
 
+def test_hist_bucket_math_at_extremes():
+    """Log-bucket ladder edges: zero/negative land in the dedicated
+    zero bucket, sub-ms values in the floor bucket, multi-second values
+    in a finite bucket whose bound brackets them within one ratio step,
+    and absurd values clamp to the last bucket instead of overflowing."""
+    from nomad_tpu.utils.metrics import (
+        HIST_BUCKETS,
+        HIST_MIN_MS,
+        HIST_RATIO,
+        hist_bucket,
+        hist_bucket_upper,
+        hist_percentile,
+    )
+
+    assert hist_bucket(0.0) == 0 and hist_bucket(-3.0) == 0
+    assert hist_bucket_upper(0) == 0.0
+    assert hist_bucket(1e-7) == 1 and hist_bucket(HIST_MIN_MS) == 1
+    for v in (0.004, 0.7, 12.5, 5_000.0, 3_600_000.0):  # sub-ms .. 1h
+        b = hist_bucket(v)
+        assert 1 < b < HIST_BUCKETS - 1
+        assert v <= hist_bucket_upper(b) <= v * HIST_RATIO * (1 + 1e-9)
+    assert hist_bucket(1e15) == HIST_BUCKETS - 1  # clamp, no IndexError
+    # percentiles: empty -> 0; all-zero samples -> 0 (the zero bucket)
+    assert hist_percentile([0] * HIST_BUCKETS, 0, 0.99) == 0.0
+    zeros = [0] * HIST_BUCKETS
+    zeros[0] = 10
+    assert hist_percentile(zeros, 10, 0.99) == 0.0
+
+
+def test_inmem_sample_percentiles():
+    """p50/p95/p99 recoverable from any interval snapshot (the old
+    count/sum/min/max could not reconstruct a percentile) — within one
+    bucket-ratio step of the true order statistic."""
+    import numpy as np
+
+    from nomad_tpu.utils.metrics import HIST_RATIO
+
+    sink = InmemSink(interval=60.0)
+    vals = [0.0, 0.0004] + [float(i) for i in range(1, 999)] + [7200.0]
+    for v in vals:
+        sink.add_sample("mixed", v)
+    s = sink.snapshot()[-1]["samples"]["mixed"]
+    for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        true = float(np.percentile(vals, q * 100))
+        assert true <= s[key] <= max(true, 1e-3) * HIST_RATIO * 1.02, (
+            q, true, s[key])
+    assert s["count"] == len(vals) and s["min"] == 0.0
+
+
+def test_statsd_wire_format_unchanged_by_histograms():
+    """The statsd/statsite sinks' line protocol must not grow bucket
+    baggage — only the inmem sink aggregates histograms."""
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+    m = Metrics(prefix="nomad_tpu")
+    m.add_sink(StatsdSink(f"127.0.0.1:{port}"))
+    m.add_sample(("plan", "evaluate"), 12.5)
+    assert recv.recv(1024).decode() == "nomad_tpu.plan.evaluate:12.5|ms"
+    recv.close()
+
+
+def test_prometheus_counters_survive_interval_rotation():
+    """The exposition reads LIFETIME aggregates: counters must not
+    shrink when old intervals rotate out of the inmem ring (a shrinking
+    _total reads as a counter reset to rate())."""
+    from nomad_tpu.utils.metrics import Metrics, format_prometheus
+
+    m = Metrics(prefix="nt")
+    m.inmem.interval = 0.01
+    m.inmem.retain = 2
+    for _ in range(5):
+        m.incr_counter(("c",), 1)
+        m.add_sample(("s",), 1.0)
+        time.sleep(0.015)
+    # rolling window kept only 2 intervals...
+    assert len(m.inmem._intervals) <= 2
+    text = format_prometheus(m)
+    # ...but the exposed totals cover all 5 increments
+    assert "nt_c_total 5" in text
+    assert "nt_s_count 5" in text
+
+
+def test_prometheus_exposition_shape():
+    from nomad_tpu.utils.metrics import Metrics, format_prometheus
+
+    m = Metrics(prefix="nt")
+    m.incr_counter(("rpc", "query"), 3)
+    m.set_gauge(("broker", "depth"), 5)
+    for v in (1.0, 2.0, 400.0):
+        m.add_sample(("plan", "evaluate"), v)
+    text = format_prometheus(m)
+    assert "# TYPE nt_rpc_query_total counter" in text
+    assert "nt_rpc_query_total 3" in text
+    assert "# TYPE nt_broker_depth gauge" in text
+    assert "# TYPE nt_plan_evaluate histogram" in text
+    assert 'nt_plan_evaluate_bucket{le="+Inf"} 3' in text
+    assert "nt_plan_evaluate_count 3" in text
+    assert "nt_plan_evaluate_sum 403" in text
+    # cumulative: bucket counts never decrease
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("nt_plan_evaluate_bucket")]
+    assert cums == sorted(cums)
+
+
 def test_inmem_interval_rotation():
     sink = InmemSink(interval=0.01, retain=3)
     for i in range(6):

@@ -490,7 +490,8 @@ def test_apply_baseline_count_is_a_ceiling(tmp_path):
 
 
 CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
-             "nomad_tpu/ops/", "nomad_tpu/parallel/")
+             "nomad_tpu/ops/", "nomad_tpu/parallel/",
+             "nomad_tpu/trace/")
 
 
 def _tree_findings():
@@ -795,3 +796,87 @@ def test_swallowed_exception_inline_suppression(tmp_path):
         "    except Exception:  # nta: disable=swallowed-exception", 1)
     findings = run_on(tmp_path, src, subdir="client")
     assert lines_of(findings, "swallowed-exception") == [13, 19]
+
+
+# ---------------------------------------------------------------------
+# robustness: flight-recorder record path (NTA_RECORD_PATH manifest)
+
+
+RECORD_BAD = """\
+import time
+
+NTA_RECORD_PATH = ("Rec.record",)
+
+class Rec:
+    def __init__(self):
+        self.items = []
+        self.ring = [None] * 8
+        self.idx = 0
+
+    def record(self, x):
+        self._hist(x)
+        self.items.append(x)
+
+    def _hist(self, x):
+        time.sleep(0.001)
+"""
+
+RECORD_GOOD = """\
+import threading
+
+NTA_RECORD_PATH = ("Rec.record",)
+
+class Rec:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ring = [None] * 8
+        self.idx = 0
+        self.seen = []
+
+    def record(self, x):
+        with self._lock:
+            self.ring[self.idx % 8] = x
+            self.idx += 1
+            scratch = [x]
+            scratch.append(x)  # local scratch: bounded, quiet
+
+    def flush(self):
+        # NOT reachable from the manifest: growth is allowed here.
+        self.seen.append(self.ring[0])
+        return self.seen
+"""
+
+
+def test_record_path_fires_on_blocking_and_growth(tmp_path):
+    """sleep reached through the call chain AND attribute-rooted
+    .append both fire; the manifest drives reachability exactly like
+    NTA_DISPATCHER_ENTRYPOINTS."""
+    findings = run_on(tmp_path, RECORD_BAD)
+    assert rules_of(findings) == ["record-path-blocking"] * 2
+    # the append in record (line 13) and the sleep in _hist (line 16)
+    assert lines_of(findings, "record-path-blocking") == [13, 16]
+    assert {f.symbol for f in findings} == {"Rec.record", "Rec._hist"}
+
+
+def test_record_path_quiet_on_slot_writes_and_off_path_growth(tmp_path):
+    assert run_on(tmp_path, RECORD_GOOD) == []
+
+
+def test_record_path_ignored_without_manifest(tmp_path):
+    """No NTA_RECORD_PATH manifest -> the rule does not apply (the
+    same sleep/append patterns are ordinary code elsewhere)."""
+    src = RECORD_BAD.replace('NTA_RECORD_PATH = ("Rec.record",)\n', "")
+    assert lines_of(run_on(tmp_path, src), "record-path-blocking") == []
+
+
+def test_real_recorder_record_path_is_clean():
+    """The actual flight recorder must satisfy its own manifest: no
+    blocking call, no unbounded growth, reachable from any of the
+    NTA_RECORD_PATH entrypoints the broker/dispatcher threads call."""
+    from nomad_tpu.trace import recorder as rec_mod
+
+    findings = analyze_paths(
+        [os.path.join(REPO, "nomad_tpu", "trace", "recorder.py")])
+    assert rec_mod.NTA_RECORD_PATH  # the manifest exists and is non-empty
+    assert [f for f in findings
+            if f.rule == "record-path-blocking"] == []
