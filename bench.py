@@ -787,6 +787,11 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
             # stats ARE this run's deltas.
             dstats["pipeline"] = server.dispatch.stats()
             dstats["applier"] = server.plan_applier.stats()
+            # Overload counters (nomad_tpu/admission): a non-overload
+            # config that shed or expired evals measured a server
+            # protecting itself, not the dense path — --check gates
+            # dense-path numbers on this column staying zero.
+            dstats["broker"] = server.broker.stats()
             return (n_jobs / storm_elapsed, success,
                     float(np.percentile(lat, 99)), dstats)
         finally:
@@ -900,6 +905,8 @@ def _live_result(name, cpu_rate, cpu_success, cpu_lone_p99,
         "applier_plans_rejected": applier.get("plans_rejected", 0),
         "applier_plans_evaluated": applier.get("plans_evaluated", 0),
         "retries_per_eval": pipe.get("retries_per_eval", 0.0),
+        "shed": (dstats.get("broker", {}).get("shed", 0)
+                 + dstats.get("broker", {}).get("expired", 0)),
     }
 
 
@@ -1097,6 +1104,256 @@ def run_chaos(seed, reps=1):
     }
 
 
+def _overload_server(protection, cap):
+    """Live server for one overload arm. Protection ON bounds the
+    service ready queue, stamps deadlines, and arms the admission
+    gate; OFF is the unbounded pre-PR-5 behaviour kept reachable for
+    the A/B."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    # eval_batch_size 8 (not the default 64): the pipeline's intake
+    # backpressure engages at 2 full batches, so this keeps the
+    # saturation bound (16) + ready cap at the storm's scale — the
+    # protection being measured, not a queue too deep to ever fill.
+    if protection:
+        cfg = ServerConfig(
+            num_schedulers=4,
+            scheduler_factories={"service": "service-tpu"},
+            eval_batch_size=8,
+            eval_ready_caps={"service": cap},
+            eval_deadline_ttl=15.0,
+            eval_nack_timeout=60.0)
+    else:
+        cfg = ServerConfig(
+            num_schedulers=4,
+            scheduler_factories={"service": "service-tpu"},
+            eval_batch_size=8,
+            eval_ready_cap=0,
+            admission_enabled=False,
+            breaker_enabled=False,
+            eval_nack_timeout=60.0)
+    server = Server(cfg)
+    server.start()
+    return server
+
+
+def _overload_job(jid, priority=None):
+    from nomad_tpu import mock
+
+    job = mock.job()
+    job.id = jid
+    job.type = "service"
+    if priority is not None:
+        job.priority = priority
+    job.task_groups[0].count = 4
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = 20
+    tg.tasks[0].resources.memory_mb = 16
+    return job
+
+
+def _overload_wait(server, eval_ids, deadline_s=300.0):
+    from nomad_tpu.structs import consts
+
+    deadline = time.perf_counter() + deadline_s
+    state = server.fsm.state
+    while time.perf_counter() < deadline:
+        evs = [state.eval_by_id(e) for e in eval_ids]
+        if all(e is not None and e.status in
+               (consts.EVAL_STATUS_COMPLETE,
+                consts.EVAL_STATUS_FAILED) for e in evs):
+            return
+        time.sleep(0.02)
+    raise TimeoutError("overload arm did not settle")
+
+
+def _overload_storm(server, rate, n_submit, rng):
+    """Submit `n_submit` jobs paced at 3x the measured capacity
+    `rate`, polling completions as they land; returns goodput
+    (accepted evals/s), shed_rate, accepted-eval p99 (ms), and the
+    broker-depth samples taken at each submission."""
+    from nomad_tpu.structs import consts
+
+    interval = 1.0 / (3.0 * rate)
+    pending = {}  # eval_id -> submit time
+    latencies = {}  # eval_id -> (seconds, triggered_by)
+    depths = []
+    state = server.fsm.state
+    broker0 = server.broker.stats()
+
+    def poll():
+        done = []
+        for eid, t0 in pending.items():
+            ev = state.eval_by_id(eid)
+            if ev is not None and ev.status in (
+                    consts.EVAL_STATUS_COMPLETE, consts.EVAL_STATUS_FAILED):
+                latencies[eid] = (time.perf_counter() - t0, ev.triggered_by)
+                done.append(eid)
+        for eid in done:
+            del pending[eid]
+
+    start = time.perf_counter()
+    last_poll = 0.0
+    for i in range(n_submit):
+        target = start + i * interval
+        while True:
+            now = time.perf_counter()
+            if now >= target:
+                break
+            if now - last_poll >= 0.02:  # completion scans are O(pending)
+                poll()
+                last_poll = now
+            time.sleep(0.002)
+        job = _overload_job(f"ovl-{i}", priority=rng.choice([20, 50, 80]))
+        ev_id, _ = server.job_register(job)
+        pending[ev_id] = time.perf_counter()
+        depths.append(server.broker.ready_count())
+    submit_elapsed = time.perf_counter() - start
+    deadline = time.perf_counter() + 300.0
+    while pending and time.perf_counter() < deadline:
+        poll()
+        time.sleep(0.02)
+    if pending:
+        raise TimeoutError(f"{len(pending)} overload evals never settled")
+    end = time.perf_counter()
+
+    shed_trigs = (consts.EVAL_TRIGGER_SHED, consts.EVAL_TRIGGER_EXPIRED)
+    accepted = [lat for lat, trig in latencies.values()
+                if trig not in shed_trigs]
+    n_shed = n_submit - len(accepted)
+    # Depth trend over the submission window, quarter-mean smoothed:
+    # batch drains dip the raw samples a few evals between polls, but
+    # an unbounded queue's quarter means climb monotonically while a
+    # capped one's plateau at the cap.
+    q = max(1, len(depths) // 4)
+    quarter_means = [round(sum(depths[i * q:(i + 1) * q]) / q, 1)
+                     for i in range(4)]
+    return {
+        "submitted": n_submit,
+        "offered_rate": round(3.0 * rate, 1),
+        "achieved_rate": round(n_submit / submit_elapsed, 1),
+        "shed_rate": round(n_shed / n_submit, 4),
+        "goodput": round(len(accepted) / (end - start), 1),
+        "accepted_p99_ms": round(
+            float(np.percentile(accepted, 99)) * 1000, 1),
+        "depth_max": max(depths),
+        "depth_final": depths[-1],
+        "depth_quarter_means": quarter_means,
+        "depth_monotonic_growth": bool(
+            all(b > a for a, b in zip(quarter_means, quarter_means[1:]))),
+        # Storm-window deltas, not server lifetime.
+        "broker_shed": server.broker.stats()["shed"] - broker0["shed"],
+        "broker_expired": (server.broker.stats()["expired"]
+                           - broker0["expired"]),
+    }
+
+
+def run_overload(seed, n_nodes=400, probe_jobs=24, window_s=6.0, cap=16):
+    """Overload A/B for the live pipeline (the soak's quantitative
+    twin, tests/test_overload_soak.py): measure capacity with a
+    capacity-sized storm, then submit at 3x that rate — once with
+    protection ON (bounded service queue at `cap`, deadlines,
+    admission), once with everything OFF. Protection ON should hold
+    goodput near capacity with a bounded accepted-eval p99 and a
+    capped queue; OFF shows the queue growing monotonically with the
+    p99 inflating alongside it."""
+    import random as _random
+
+    from nomad_tpu import mock
+
+    def seed_cluster(server):
+        for _ in range(n_nodes):
+            node = mock.node()
+            node.compute_class()
+            server.log.apply("node_register", {"node": node})
+
+    def warm(server):
+        # Two waves so both the full-upload and base-delta program
+        # variants compile outside the measured windows (the
+        # _live_pipeline warm-up discipline). Deregs mint NO evals —
+        # a burst of dereg evals against the ON arm's capped queue
+        # would shed, polluting the storm's counters.
+        for wave in ("wA", "wB"):
+            jobs = [_overload_job(f"{wave}-{j}") for j in range(probe_jobs)]
+            evs = [server.job_register(job)[0] for job in jobs]
+            _overload_wait(server, evs)
+            for job in jobs:
+                server.job_deregister(job.id, create_eval=False)
+
+    def capacity(server):
+        # Sustained-rate probe sized like the storm, not one batch: a
+        # handful of jobs drains in a single device dispatch and reads
+        # 3-5x the steady-state rate, which would turn "3x capacity"
+        # into a meaningless instant burst.
+        n = max(probe_jobs, 60)
+        jobs = [_overload_job(f"capy-{j}") for j in range(n)]
+        t0 = time.perf_counter()
+        evs = [server.job_register(job)[0] for job in jobs]
+        _overload_wait(server, evs)
+        return n / (time.perf_counter() - t0)
+
+    # Capacity is measured on the UNbounded arm (a capped queue would
+    # shed the probe itself) and reused for the ON arm — both arms see
+    # the identical offered load.
+    off_server = _overload_server(protection=False, cap=0)
+    try:
+        seed_cluster(off_server)
+        warm(off_server)
+        rate = capacity(off_server)
+        # A SUSTAINED overload window, not an instant burst: 3x the
+        # measured rate held for ~window_s seconds (bounded so a fast
+        # box cannot explode the job count).
+        storm_jobs = int(min(900, max(120, 3.0 * rate * window_s)))
+        off = _overload_storm(off_server, rate,
+                              storm_jobs, _random.Random(seed))
+    finally:
+        off_server.shutdown()
+
+    on_server = _overload_server(protection=True, cap=cap)
+    try:
+        seed_cluster(on_server)
+        warm(on_server)
+        on = _overload_storm(on_server, rate,
+                             storm_jobs, _random.Random(seed))
+        on["breaker_state"] = on_server.stats()["admission"][
+            "breaker"]["state"]
+    finally:
+        on_server.shutdown()
+
+    return {
+        "metric": (
+            f"[overload seed={seed}] {n_nodes} nodes, capacity "
+            f"{rate:.1f} evals/s, storm at 3x: protection-ON "
+            f"goodput={on['goodput']:.1f} shed_rate={on['shed_rate']:.2f} "
+            f"accepted-p99={on['accepted_p99_ms']:.0f}ms "
+            f"depth<= {on['depth_max']}; OFF "
+            f"goodput={off['goodput']:.1f} shed_rate={off['shed_rate']:.2f} "
+            f"p99={off['accepted_p99_ms']:.0f}ms depth-> "
+            f"{off['depth_max']} "
+            f"(monotonic={off['depth_monotonic_growth']})"
+        ),
+        "overload_seed": seed,
+        "capacity_evals_per_s": round(rate, 1),
+        "service_queue_cap": cap,
+        "protection_on": on,
+        "protection_off": off,
+    }
+
+
+def _shed_gate(out, n):
+    """--check: a NON-overload config that shed or expired evals was
+    measured while the server protected itself — its dense-path
+    numbers describe a degraded run, not the pipeline. Refuse."""
+    shed = out.get("columns", {}).get("shed", {}).get("median", 0)
+    if shed > 0:
+        print(f"bench: REFUSING to report config {n}: shed_rate > 0 "
+              f"(median {shed} evals shed/expired) in a non-overload "
+              f"config — raise eval_ready_cap / deadline TTL or fix "
+              f"the regression that slowed the drain", file=sys.stderr)
+        sys.exit(2)
+
+
 def ntalint_purity_gate():
     """Trace-purity findings in the kernel path (ops/, scheduler/)
     invalidate dense-path numbers BY CONSTRUCTION: an impure call or a
@@ -1145,6 +1402,13 @@ def main():
                              "fault schedule (nomad_tpu/chaos); reports "
                              "degraded-mode occupancy + retries/eval "
                              "alongside the clean numbers")
+    parser.add_argument("--overload", type=int, default=None,
+                        metavar="SEED",
+                        help="overload A/B on the live pipeline "
+                             "(nomad_tpu/admission): measure capacity, "
+                             "storm at 3x, report shed_rate / goodput / "
+                             "accepted-eval p99 with protection on vs "
+                             "off")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -1185,9 +1449,16 @@ def main():
         print(json.dumps(run_chaos(args.chaos)))
         return
 
+    if args.overload is not None:
+        print(json.dumps(run_overload(args.overload)))
+        return
+
     if args.all:
         for n in sorted(CONFIGS):
-            print(json.dumps(run_config(n, reps=args.reps)))
+            out = run_config(n, reps=args.reps)
+            if args.check:
+                _shed_gate(out, n)
+            print(json.dumps(out))
         return
 
     if args.check and not args.no_trace:
@@ -1206,6 +1477,8 @@ def main():
             sys.exit(2)
     else:
         out = run_config(args.config, reps=args.reps)
+    if args.check:
+        _shed_gate(out, args.config)
     print(json.dumps(out))
 
 

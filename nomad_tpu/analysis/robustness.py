@@ -7,14 +7,16 @@ fault injection (nomad_tpu/chaos) hunts — an unbounded wait turns an
 injected fault into a hung thread instead of a recovered one, and a
 swallowed exception is exactly how injection findings hide:
 
-- ``unbounded-wait`` (``server/``, ``dispatch/``, ``trace/``): a
+- ``unbounded-wait`` (``server/``, ``dispatch/``, ``trace/``,
+  ``admission/``): a
   no-argument ``.wait()`` / ``.get()`` / ``.join()`` call blocks
   forever with no shutdown re-check; every such wait must be bounded
   (pass a timeout and re-check stop/shutdown in a loop). ``dict.get``
   is untouched — it always takes at least one argument.
 
 - ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``,
-  ``trace/``): an ``except Exception:`` / ``except BaseException:`` /
+  ``trace/``, ``admission/``): an ``except Exception:`` /
+  ``except BaseException:`` /
   bare ``except:`` whose entire body is ``pass`` (or ``...``). Either
   narrow the exception type, log it, or suppress explicitly with
   ``# nta: disable=swallowed-exception`` and a justification. Handlers
@@ -60,8 +62,9 @@ RULE_UNBOUNDED_WAIT = "unbounded-wait"
 RULE_SWALLOWED = "swallowed-exception"
 RULE_RECORD_PATH = "record-path-blocking"
 
-WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/")
-SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/")
+WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/", "/admission/")
+SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
+                         "/admission/")
 
 # Attribute calls that block forever when called with no timeout.
 UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}

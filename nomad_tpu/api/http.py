@@ -19,6 +19,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, List, Optional, Tuple
 
+from ..admission import AdmissionRejected
 from ..state import watch
 from ..structs import Allocation, Evaluation, Job, Node, Plan
 from ..utils import metrics
@@ -146,6 +147,15 @@ class HTTPServer:
                 self.nomad_route = "unmatched"
                 try:
                     body = api.handle(self)
+                except AdmissionRejected as e:
+                    # Overload shed/limit (nomad_tpu/admission): a
+                    # machine-readable Retry-After so well-behaved
+                    # clients adapt their cadence instead of hammering.
+                    self._reply(
+                        e.status,
+                        {"error": e.message,
+                         "retry_after": round(e.retry_after, 3)},
+                        headers={"Retry-After": f"{e.retry_after:.3f}"})
                 except HTTPError as e:
                     self._reply(e.status, {"error": e.message})
                 except (ValueError, PermissionError) as e:
@@ -161,7 +171,7 @@ class HTTPServer:
                     ("http", "request", self.command, self.nomad_route),
                     _start)
 
-            def _reply(self, status, body, index=None):
+            def _reply(self, status, body, index=None, headers=None):
                 stream = None
                 if isinstance(body, RawResponse):
                     data, ctype, stream = body.data, body.content_type, body.stream
@@ -171,6 +181,8 @@ class HTTPServer:
                     data, ctype = json.dumps(body).encode(), "application/json"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 if stream is None:
                     self.send_header("Content-Length", str(len(data)))
                 else:
@@ -343,6 +355,14 @@ class HTTPServer:
                 if self.server is None and handler not in client_only_ok:
                     raise HTTPError(
                         501, "server not enabled on this agent")
+                # Overload admission gate (nomad_tpu/admission): sheds
+                # or rate-limits write/read traffic past green
+                # pressure; internal leader-forward, client control,
+                # and observability routes are exempt (limiter.py).
+                ctl = (getattr(self.server, "admission", None)
+                       if self.server is not None else None)
+                if ctl is not None:
+                    ctl.check_http(method, path, req.nomad_route)
                 return handler(method, query, body, **m.groupdict())
         raise HTTPError(404, f"no handler for {path!r}")
 
@@ -594,11 +614,17 @@ class HTTPServer:
 
     def _internal_eval_dequeue(self, method, query, body):
         self._require_leader()
+        # The clamp is no longer silent: the EFFECTIVE timeout goes
+        # back in the response body, so a client that asked for more
+        # than MAX_BLOCKING_WAIT can see its actual long-poll budget
+        # and adapt its retry cadence instead of assuming the server
+        # honored the request.
         timeout = min(float(body.get("timeout", 1.0)), MAX_BLOCKING_WAIT)
         ev, token = self.server.broker.dequeue(
             body.get("schedulers") or [], timeout)
         return {"eval": to_dict(ev) if ev is not None else None,
-                "token": token}
+                "token": token,
+                "timeout": timeout}
 
     def _internal_eval_dequeue_many(self, method, query, body):
         """Non-blocking drain for a FOLLOWER worker's batch: without

@@ -43,6 +43,14 @@ site                   wired into
                        timer re-arms; delay = late node-down)
 ``client.heartbeat``   client heartbeat tick (drop = heartbeat lost -> TTL
                        expiry -> node down)
+``admission.slow_consumer``  pipeline stage consumer about to process an
+                       eval (delay = a wedged scheduler thread: e2e p99
+                       inflates and the pressure monitor must react;
+                       error = the consumer dies, the eval nacks)
+``device.breaker_trip``  device dispatch at the circuit breaker's gate
+                       (error = device fault the breaker counts — K of
+                       them trip the dense path to the host iterators;
+                       delay = a slow batch for the slow-trip rule)
 =====================  =======================================================
 """
 
@@ -70,6 +78,8 @@ KNOWN_SITES = frozenset({
     "binpack.device",
     "heartbeat.expire",
     "client.heartbeat",
+    "admission.slow_consumer",
+    "device.breaker_trip",
 })
 
 DROP = "drop"

@@ -43,6 +43,15 @@ class ServerBlock:
     dispatch_pipeline: Optional[bool] = None
     dispatch_max_inflight: Optional[int] = None
     dense_pre_resolve: Optional[bool] = None
+    # Overload protection (nomad_tpu/admission; server/config.py):
+    # bounded broker ready queues, eval deadlines, the token-bucket
+    # intake gate, and the device-path circuit breaker.
+    eval_ready_cap: Optional[int] = None
+    eval_deadline_ttl: Optional[float] = None
+    admission_enabled: Optional[bool] = None
+    breaker_enabled: Optional[bool] = None
+    breaker_failure_threshold: Optional[int] = None
+    breaker_cooldown: Optional[float] = None
 
 
 @dataclass
@@ -198,6 +207,10 @@ _SCHEMA: Dict[str, Any] = {
     "server.eval_batch_size": int, "server.dense_min_batch": int,
     "server.dispatch_pipeline": bool, "server.dispatch_max_inflight": int,
     "server.dense_pre_resolve": bool,
+    "server.eval_ready_cap": int, "server.eval_deadline_ttl": float,
+    "server.admission_enabled": bool, "server.breaker_enabled": bool,
+    "server.breaker_failure_threshold": int,
+    "server.breaker_cooldown": float,
     "client.enabled": bool, "client.state_dir": str,
     "client.alloc_dir": str, "client.node_class": str,
     "client.servers": _str_list, "client.network_speed": int,

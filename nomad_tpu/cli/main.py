@@ -667,6 +667,21 @@ def cmd_agent(args) -> int:
                 cfg.server.dispatch_max_inflight)
         if cfg.server.dense_pre_resolve is not None:
             server_cfg.dense_pre_resolve = cfg.server.dense_pre_resolve
+        # Overload protection (nomad_tpu/admission): bounded broker
+        # queues, deadlines, intake gate, device-path breaker.
+        if cfg.server.eval_ready_cap is not None:
+            server_cfg.eval_ready_cap = cfg.server.eval_ready_cap
+        if cfg.server.eval_deadline_ttl is not None:
+            server_cfg.eval_deadline_ttl = cfg.server.eval_deadline_ttl
+        if cfg.server.admission_enabled is not None:
+            server_cfg.admission_enabled = cfg.server.admission_enabled
+        if cfg.server.breaker_enabled is not None:
+            server_cfg.breaker_enabled = cfg.server.breaker_enabled
+        if cfg.server.breaker_failure_threshold is not None:
+            server_cfg.breaker_failure_threshold = (
+                cfg.server.breaker_failure_threshold)
+        if cfg.server.breaker_cooldown is not None:
+            server_cfg.breaker_cooldown = cfg.server.breaker_cooldown
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
         if cfg.vault.address:

@@ -187,6 +187,8 @@ class DispatchPipeline:
         self._thread: Optional[threading.Thread] = None
         self.drained = 0  # guarded-by: _lock (evals requeued by drain())
         self.finish_dropped = 0  # guarded-by: _lock (chaos dispatch.finish)
+        self.expired_dropped = 0  # guarded-by: _lock (deadline at launch)
+        self.breaker_routed = 0  # guarded-by: _lock (host via open breaker)
 
         # ---- stats ----
         self.evals_in = 0  # guarded-by: _lock (handed off / requeued)
@@ -272,6 +274,16 @@ class DispatchPipeline:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def saturated(self) -> bool:
+        """Intake-backpressure signal for the worker handoff
+        (server/worker.py): True while the accumulator already holds
+        two full batches' worth of evals. A saturated pipeline must not
+        keep draining the broker — evals held here are invisible to the
+        bounded ready queues (nomad_tpu/admission), so an unbounded
+        drain would reopen exactly the intake the depth caps close."""
+        with self._lock:
+            return len(self._pending) >= 2 * self.max_batch
 
     # ------------------------------------------------------ dispatcher
 
@@ -360,6 +372,17 @@ class DispatchPipeline:
         # Recorded HERE (stage thread) rather than in _accumulate so
         # the dispatcher thread carries zero extra work per batch.
         t_launch = time.monotonic()
+        # Deadline enforcement BEFORE any matrix build or cohort
+        # announcement: an expired eval must not burn a device lane on
+        # a plan its submitter already gave up on (nomad_tpu/admission
+        # deadline semantics; the broker enforces the same bound at
+        # dequeue, this covers time spent accumulating).
+        batch = self._drop_expired(batch, t_launch)
+        if not batch:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
+            return
         for entry in batch:
             trace.record_span(
                 entry.eval.id, trace.STAGE_DISPATCH_ACCUMULATE,
@@ -399,6 +422,57 @@ class DispatchPipeline:
                 self._process_entry, entry, snapshot, route_host,
                 remaining)
 
+    def _drop_expired(self, batch: List[_Pending],
+                      t_launch: float) -> List[_Pending]:
+        """Split out entries whose deadline passed while accumulating,
+        terminalize them (status=failed with a structured reason +
+        ack), and return the live remainder. Runs on a stage thread."""
+        now = time.time()
+        live: List[_Pending] = []
+        expired: List[_Pending] = []
+        for entry in batch:
+            if entry.eval.expired(now):
+                expired.append(entry)
+            else:
+                live.append(entry)
+        if not expired:
+            return batch
+        with self._lock:
+            self.expired_dropped += len(expired)
+        metrics.incr_counter(("dispatch", "expired_dropped"),
+                             len(expired))
+        for entry in expired:
+            trace.record_span(
+                entry.eval.id, trace.STAGE_DISPATCH_ACCUMULATE,
+                entry.enqueued_at, t_launch,
+                ann={"expired": True, "deadline": entry.eval.deadline},
+                trace_id=entry.eval.trace_id)
+            self._finish_expired(entry)
+        return live
+
+    def _finish_expired(self, entry: _Pending) -> None:
+        """Persist the structured terminal outcome for one expired
+        entry, then release its broker lease. On a leader flap either
+        write can fail — the nack timer redelivers and the broker's
+        dequeue-side deadline check parks it structured there instead,
+        so the eval still reaches exactly one terminal outcome."""
+        upd = entry.eval.copy()
+        upd.status = consts.EVAL_STATUS_FAILED
+        upd.status_description = (
+            f"deadline expired before dispatch: deadline "
+            f"{entry.eval.deadline:.3f} passed while accumulating "
+            f"(originally triggered by {entry.eval.triggered_by!r})")
+        try:
+            self.server.eval_update([upd])
+        except Exception:
+            self.logger.warning(
+                "expired-eval terminal write for %s failed; broker "
+                "deadline check will re-park it", entry.eval.id,
+                exc_info=True)
+            self._finish(entry, acked=False)
+            return
+        self._finish(entry, acked=True)
+
     def _abort_batch(self, batch: List[_Pending]) -> None:
         """Nack every entry and release the in-flight slot
         _accumulate took for this batch. The release is in a finally:
@@ -427,6 +501,22 @@ class DispatchPipeline:
         # amortize the device dispatch runs on the host factories with
         # identical placement semantics (parity-tested).
         route_host = len(batch) < cfg.dense_min_batch
+        if not route_host:
+            # Device-path circuit breaker (admission/breaker.py): an
+            # OPEN breaker inside its cool-down routes the whole batch
+            # to the host factories up front — no matrix build against
+            # a sick device path, no cohort announcement to repay.
+            # This is the NON-consuming hint: once the cool-down
+            # elapses it goes quiet and the dense path's acquire() gate
+            # (scheduler/tpu.py) sends exactly one half-open probe.
+            from ..admission import get_breaker
+
+            if get_breaker().should_route_host():
+                route_host = True
+                with self._lock:
+                    self.breaker_routed += len(batch)
+                metrics.incr_counter(
+                    ("dispatch", "breaker_route_host"), len(batch))
         if route_host:
             with self._lock:
                 self.routed_host += len(batch)
@@ -472,6 +562,14 @@ class DispatchPipeline:
             announced=(not route_host
                        and ev.type != consts.JOB_TYPE_SYSTEM))
         try:
+            if chaos.enabled:
+                # 'delay' = a stalled stage consumer (a wedged
+                # scheduler thread): the eval sits in process, the e2e
+                # p99 inflates, and the pressure monitor must see it —
+                # the overload soak forces consumer stalls through this
+                # site. 'error' = the consumer dies; the eval nacks and
+                # redelivers via the except path below.
+                chaos.fire("admission.slow_consumer", eval_id=ev.id)
             factory = self.server.config.factory_for(ev.type)
             if route_host:
                 from ..server.worker import host_factory
@@ -627,6 +725,8 @@ class DispatchPipeline:
                 "inline_retries": self.inline_retries,
                 "drained": self.drained,
                 "finish_dropped": self.finish_dropped,
+                "expired_dropped": self.expired_dropped,
+                "breaker_routed": self.breaker_routed,
                 "retries_per_eval": round(retries / done, 4) if done else 0.0,
                 # Cumulative stage latencies (divide by the matching
                 # counters for per-unit): microseconds, like the

@@ -138,6 +138,33 @@ class BatchedTPUScheduler(GenericScheduler):
             super()._compute_placements(bulk)
             return
 
+        # Device-path circuit breaker (nomad_tpu/admission): the
+        # consuming gate, checked BEFORE the matrix build — an open
+        # breaker means the device path is sick, so paying the
+        # ClusterMatrix + build_asks cost only to discard them would
+        # burn leader CPU per eval for nothing. An open breaker (or a
+        # busy half-open probe slot) routes this eval to the host
+        # iterators WITHOUT paying the failure latency the per-eval
+        # fallback below would — the whole point of the breaker is
+        # that N consecutive failures become one routing decision,
+        # not N timeouts.
+        from ..admission import get_breaker
+        from ..chaos import chaos
+        from ..utils import metrics
+
+        breaker = get_breaker()
+        if not breaker.acquire():
+            self._repay_cohort()
+            metrics.incr_counter(
+                ("scheduler", "breaker_rejected"), len(bulk))
+            trace.record_span(
+                self.eval.id, trace.STAGE_DEVICE_DISPATCH,
+                time.monotonic(),
+                ann={"breaker": breaker.state()},
+                trace_id=self.eval.trace_id)
+            super()._compute_placements(bulk)
+            return
+
         _t0 = time.monotonic()
         matrix = ClusterMatrix(self.state, self.job, self.plan)
         tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
@@ -178,26 +205,35 @@ class BatchedTPUScheduler(GenericScheduler):
         # sharing a cluster base ride one cached device upload.
         _t0 = time.monotonic()
         try:
+            if chaos.enabled:
+                # 'error' = an injected device fault AT the breaker's
+                # gate: lands in the except below, so a seeded schedule
+                # can trip the breaker deterministically (the overload
+                # soak drives trip -> half-open -> reclose through
+                # this site).
+                chaos.fire("device.breaker_trip", eval_id=self.eval.id)
             choices, scores = get_batcher().place(matrix, asks, key, config)
         except Exception:
             # Device dispatch failed (runtime fault, OOM on device,
-            # chaos binpack.device): the host iterators have IDENTICAL
-            # placement semantics (parity-tested), so falling back
-            # costs milliseconds of CPU instead of failing the eval
-            # into a nack/redelivery round — the eval still completes
-            # this delivery. The whole bulk set takes the host path;
-            # the plan applier re-verifies either way.
+            # chaos binpack.device / device.breaker_trip): the host
+            # iterators have IDENTICAL placement semantics
+            # (parity-tested), so falling back costs milliseconds of
+            # CPU instead of failing the eval into a nack/redelivery
+            # round — the eval still completes this delivery. The whole
+            # bulk set takes the host path; the plan applier
+            # re-verifies either way. The breaker counts the failure:
+            # K consecutive ones trip the dense path out of the way.
+            breaker.record_failure()
             self.logger.warning(
                 "device placement dispatch failed; falling back to the "
                 "host path for %d placements", len(bulk), exc_info=True)
-            from ..utils import metrics
-
             metrics.incr_counter(("scheduler", "host_fallback"), len(bulk))
             trace.record_span(
                 self.eval.id, trace.STAGE_DEVICE_DISPATCH, _t0,
                 ann={"host_fallback": True}, trace_id=self.eval.trace_id)
             super()._compute_placements(bulk)
             return
+        breaker.record_success((time.monotonic() - _t0) * 1000.0)
         choices = np.asarray(choices)
         scores = np.asarray(scores)
         trace.record_span(self.eval.id, trace.STAGE_DEVICE_DISPATCH, _t0,

@@ -5,6 +5,14 @@ per-job serialization (a job is claimed at enqueue time; later evals
 wait in a per-job blocked heap until the outstanding one is Acked),
 unack tracking with Nack timers, a delivery limit routing poison evals
 to the `_failed` queue, and wait-time evals.
+
+Overload protection (nomad_tpu/admission) extends the reference: ready
+queues are optionally BOUNDED (per-scheduler-type depth caps) with
+priority-aware shedding — lowest priority, newest first, stamped with a
+structured `EVAL_TRIGGER_SHED` outcome exactly once and parked on the
+failed queue for the reaper, never silently dropped — and evals carry a
+creation-stamped deadline the dequeue path enforces, so stale work is
+parked (`EVAL_TRIGGER_EXPIRED`) instead of burning a scheduler.
 """
 
 from __future__ import annotations
@@ -23,6 +31,16 @@ from ..utils.timer import default_wheel
 from .. import trace
 
 FAILED_QUEUE = "_failed"
+
+# Triggers that mark an eval already parked for terminal processing on
+# the failed queue: a copy carrying one of these is never re-stamped,
+# re-counted, or dead-lettered again (shed/expired evals must reach
+# exactly ONE structured terminal outcome).
+_TERMINAL_PARK_TRIGGERS = (
+    consts.EVAL_TRIGGER_DEAD_LETTER,
+    consts.EVAL_TRIGGER_SHED,
+    consts.EVAL_TRIGGER_EXPIRED,
+)
 
 
 class _Heap:
@@ -45,6 +63,27 @@ class _Heap:
             return None
         return -self._items[0][0]
 
+    def worst_priority(self) -> Optional[int]:
+        """Priority of the shed victim: the LOWEST priority resident
+        (O(n) scan; only runs when a bounded queue is at capacity)."""
+        if not self._items:
+            return None
+        return -max(item[0] for item in self._items)
+
+    def pop_worst(self) -> Optional[Evaluation]:
+        """Remove and return the shed victim: lowest priority, newest
+        first (max insertion counter among the lowest priority)."""
+        if not self._items:
+            return None
+        idx = max(range(len(self._items)),
+                  key=lambda i: (self._items[i][0], self._items[i][1]))
+        victim = self._items[idx][2]
+        last = self._items.pop()
+        if idx < len(self._items):
+            self._items[idx] = last
+            heapq.heapify(self._items)
+        return victim
+
     def __len__(self):
         return len(self._items)
 
@@ -63,9 +102,19 @@ class _Unack:
 
 
 class EvalBroker:
-    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
+                 ready_cap: int = 0,
+                 ready_caps: Optional[Dict[str, int]] = None):
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        # Bounded ready queues (nomad_tpu/admission): per-scheduler-type
+        # depth caps — `ready_caps` overrides per type, `ready_cap` is
+        # the default for every other type; 0 = unbounded. The failed
+        # queue is never capped (it holds the structured terminal parks
+        # the caps produce — capping it would shed the shed records).
+        self.ready_cap = max(0, ready_cap)
+        self._ready_caps = {k: max(0, v)
+                            for k, v in (ready_caps or {}).items()}
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -85,6 +134,11 @@ class EvalBroker:
         # (dead-lettered); monotonic across flushes so server.stats()
         # reports lifetime poison-eval pressure.
         self.dead_lettered = 0  # guarded-by: _lock
+        # Overload-protection counters, monotonic like dead_lettered:
+        # evals shed from full bounded ready queues, and evals whose
+        # deadline expired before a dequeuer reached them.
+        self.shed = 0  # guarded-by: _lock
+        self.expired = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------
 
@@ -162,14 +216,100 @@ class EvalBroker:
         if queue != FAILED_QUEUE:
             trace.mark(ev.id, ev.trace_id)
         # Per-job serialization: the job is claimed by the first eval;
-        # later ones wait in the per-job blocked heap until Ack.
+        # later ones wait in the per-job blocked heap until Ack. The
+        # blocked heaps ride the same bounded-queue discipline as the
+        # ready queue they feed: without a cap, re-registering one job
+        # at storm rate while its eval is outstanding grows the heap
+        # without bound, invisibly to the ready cap AND the pressure
+        # monitor — exactly the unbounded intake the caps close.
         claimed = self._job_evals.get(ev.job_id, "")
         if not claimed:
             self._job_evals[ev.job_id] = ev.id
         elif claimed != ev.id:
-            self._blocked.setdefault(ev.job_id, _Heap()).push(ev)
+            blocked = self._blocked.setdefault(ev.job_id, _Heap())
+            cap = self._ready_caps.get(queue, self.ready_cap)
+            if cap and len(blocked) >= cap:
+                worst = blocked.worst_priority()
+                if worst is None or ev.priority <= worst:
+                    self._shed_locked(ev, queue, cap, where="blocked")
+                    return
+                self._shed_locked(blocked.pop_worst(), queue, cap,
+                                  where="blocked")
+            blocked.push(ev)
             return
-        self._ready.setdefault(queue, _Heap()).push(ev)
+        heap = self._ready.setdefault(queue, _Heap())
+        if queue != FAILED_QUEUE:
+            cap = self._ready_caps.get(queue, self.ready_cap)
+            if cap and len(heap) >= cap:
+                # Priority-aware shed, never a silent drop: the victim
+                # is the LOWEST-priority eval, newest first — and the
+                # incoming eval is by definition the newest at its
+                # priority, so it sheds itself whenever it does not
+                # strictly outrank the worst resident.
+                worst = heap.worst_priority()
+                if worst is None or ev.priority <= worst:
+                    self._shed_locked(ev, queue, cap)
+                    return
+                self._shed_locked(heap.pop_worst(), queue, cap)
+        heap.push(ev)
+        self._cond.notify_all()
+
+    def _shed_locked(self, ev: Evaluation, queue: str, cap: int,
+                     where: str = "ready") -> None:
+        """Shed one eval from a full bounded ready (or per-job blocked)
+        queue: complete its trace, stamp the structured outcome exactly
+        ONCE, count it, and park the stamped copy on the failed queue —
+        the leader reaper persists it as a terminal status exactly like
+        a dead-letter. A ready-shed eval's job claim intentionally
+        stays with the eval id; the reaper's ack releases it and
+        promotes the job's blocked evals (the dead-letter protocol,
+        server.py _reap_failed_evals). A blocked-shed eval never held
+        the claim."""
+        with self._lock:
+            trace.complete(ev.id, "shed")
+            shed = ev.copy()
+            if shed.triggered_by not in _TERMINAL_PARK_TRIGGERS:
+                shed.triggered_by = consts.EVAL_TRIGGER_SHED
+                shed.status_description = (
+                    f"shed: {where} queue {queue!r} at capacity ({cap}); "
+                    f"lowest-priority ({ev.priority}) newest eval "
+                    f"dropped under overload (originally triggered by "
+                    f"{ev.triggered_by!r})")
+                self.shed += 1
+                metrics.incr_counter(("broker", "shed"))
+            self._park_failed_locked(shed)
+
+    def _expire_locked(self, ev: Evaluation, queue: str) -> None:
+        """An eval whose creation-stamped deadline passed while queued:
+        skipped at dequeue, parked on the failed queue with a
+        structured reason (exactly once — see _TERMINAL_PARK_TRIGGERS),
+        so stale work never reaches a scheduler or a device lane."""
+        with self._lock:
+            trace.complete(ev.id, "expired")
+            dead = ev.copy()
+            if dead.triggered_by not in _TERMINAL_PARK_TRIGGERS:
+                dead.triggered_by = consts.EVAL_TRIGGER_EXPIRED
+                dead.status_description = (
+                    f"deadline expired before dispatch: deadline "
+                    f"{ev.deadline:.3f} passed while queued on "
+                    f"{queue!r} (originally triggered by "
+                    f"{ev.triggered_by!r})")
+                self.expired += 1
+                metrics.incr_counter(("broker", "expired"))
+            self._park_failed_locked(dead)
+
+    def _park_failed_locked(self, ev: Evaluation) -> None:
+        """Push a stamped terminal copy straight onto the failed queue.
+        Deliberately NOT routed through ``_enqueue_locked``: its
+        per-job claim check would divert a copy whose job is claimed
+        by a DIFFERENT eval (a blocked-heap shed) into the blocked
+        heap instead of the failed queue — a terminal park must always
+        reach the reaper. The failed queue is never capped and its
+        copies are never trace-marked (their trace was completed at
+        the park site)."""
+        if not self._enabled:
+            return
+        self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
         self._cond.notify_all()
 
     # ------------------------------------------------------------------
@@ -237,19 +377,32 @@ class EvalBroker:
         return out
 
     def _scan_for_schedulers(self, schedulers: List[str]) -> Optional[Evaluation]:
-        best_queue = None
-        best_priority = -1
-        for sched in schedulers:
-            heap = self._ready.get(sched)
-            if heap is None:
+        now = time.time()
+        while True:
+            best_queue = None
+            best_priority = -1
+            for sched in schedulers:
+                heap = self._ready.get(sched)
+                if heap is None:
+                    continue
+                prio = heap.peek_priority()
+                if prio is not None and prio > best_priority:
+                    best_priority = prio
+                    best_queue = sched
+            if best_queue is None:
+                return None
+            ev = self._ready[best_queue].pop()
+            if ev is None:
+                return None
+            # Deadline enforcement at dequeue: an expired eval would
+            # only burn a scheduler (or a device lane) producing a plan
+            # the submitter no longer wants — park it structured and
+            # keep scanning for live work. The failed queue is exempt:
+            # its copies are terminal parks on their way to the reaper.
+            if best_queue != FAILED_QUEUE and ev.expired(now):
+                self._expire_locked(ev, best_queue)
                 continue
-            prio = heap.peek_priority()
-            if prio is not None and prio > best_priority:
-                best_priority = prio
-                best_queue = sched
-        if best_queue is None:
-            return None
-        return self._ready[best_queue].pop()
+            return ev
 
     def _dequeue_locked(self, ev: Evaluation) -> Tuple[Evaluation, str]:
         token = generate_uuid()
@@ -349,8 +502,11 @@ class EvalBroker:
                 # Idempotent: a reaper whose eval_update failed (leader
                 # flap) lets the nack timer re-park the ALREADY
                 # dead-lettered copy — re-stamping would clobber the
-                # original trigger and double-count the eval.
-                if dead.triggered_by != consts.EVAL_TRIGGER_DEAD_LETTER:
+                # original trigger and double-count the eval. Shed and
+                # expired parks are covered by the same guard: a shed
+                # eval must never ALSO dead-letter (one structured
+                # terminal outcome, exactly once).
+                if dead.triggered_by not in _TERMINAL_PARK_TRIGGERS:
                     dead.triggered_by = consts.EVAL_TRIGGER_DEAD_LETTER
                     dead.status_description = (
                         f"dead-lettered: delivery limit "
@@ -359,7 +515,7 @@ class EvalBroker:
                         f"(originally triggered by {ev.triggered_by!r})")
                     self.dead_lettered += 1
                     metrics.incr_counter(("broker", "dead_lettered"))
-                self._enqueue_locked(dead, FAILED_QUEUE)
+                self._park_failed_locked(dead)
             else:
                 self._enqueue_locked(ev, ev.type)
 
@@ -406,13 +562,27 @@ class EvalBroker:
             heap = self._ready.get(FAILED_QUEUE)
             return heap.evals() if heap else []
 
-    def stats(self) -> Dict[str, int]:
+    def ready_by_queue(self) -> Dict[str, int]:
+        """Per-scheduler-type ready depths (failed queue excluded) —
+        the pressure monitor measures each CAPPED queue against its
+        own budget; lumping uncapped queues into one total would read
+        a deliberately-unbounded queue's backlog as cap pressure."""
+        with self._lock:
+            return {q: len(h) for q, h in self._ready.items()
+                    if q != FAILED_QUEUE}
+
+    def stats(self) -> Dict[str, object]:
         with self._lock:
             dead = self.dead_lettered
+            shed = self.shed
+            expired = self.expired
         return {
+            "ready_by_queue": self.ready_by_queue(),
             "total_ready": self.ready_count(),
             "total_unacked": self.unacked_count(),
             "total_blocked": self.blocked_count(),
             "total_waiting": self.waiting_count(),
             "dead_lettered": dead,
+            "shed": shed,
+            "expired": expired,
         }

@@ -79,6 +79,45 @@ class ServerConfig:
     # dispatch round-trip. False = independent (vmapped) evals.
     dense_pre_resolve: bool = True
 
+    # ---- Overload protection (nomad_tpu/admission) ----
+    # Bounded broker ready queues: default per-scheduler-type depth cap
+    # (0 = unbounded) plus per-type overrides. A full queue sheds the
+    # lowest-priority newest eval with a structured outcome.
+    eval_ready_cap: int = 0
+    eval_ready_caps: Dict[str, int] = field(default_factory=dict)
+    # Eval deadline base TTL in seconds (0 = no deadlines). The
+    # effective TTL scales with priority (admission/deadline.py):
+    # default-priority evals get exactly this, priority 100 gets 1.5x.
+    eval_deadline_ttl: float = 0.0
+    # Token-bucket admission control on the HTTP/RPC intake. Buckets
+    # only engage past green pressure, so the defaults are inert on an
+    # unloaded server; leader-forward + raft + client control traffic
+    # and the observability routes are always exempt.
+    admission_enabled: bool = True
+    admission_write_rate: float = 50.0
+    admission_write_burst: float = 100.0
+    admission_read_rate: float = 200.0
+    admission_read_burst: float = 400.0
+    # Retry-After hint (seconds) on red-pressure 503 sheds.
+    admission_red_retry_after: float = 1.0
+    # Absolute broker-depth thresholds (ready+unacked) used when ready
+    # queues are UNcapped; capped queues use fractions of the cap.
+    admission_depth_yellow: int = 256
+    admission_depth_red: int = 1024
+    # Rolling e2e p99 thresholds in ms (0 disables the latency input —
+    # absolute latency bars are deployment-specific).
+    admission_p99_yellow_ms: float = 0.0
+    admission_p99_red_ms: float = 0.0
+    # Device-path circuit breaker (admission/breaker.py): trip to the
+    # host path after this many CONSECUTIVE device failures (or slow
+    # batches when breaker_slow_ms > 0), cool down, then half-open
+    # probe back.
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_slow_ms: float = 0.0
+    breaker_slow_batches: int = 8
+    breaker_cooldown: float = 5.0
+
     # Telemetry gauge emission period (command.go:570 setupTelemetry)
     telemetry_interval: float = 10.0
     statsd_addr: str = ""

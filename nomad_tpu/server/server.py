@@ -57,7 +57,9 @@ class Server:
         self.fsm = FSM()
         self.log = DevLog(self.fsm)
         self.broker = EvalBroker(
-            self.config.eval_nack_timeout, self.config.eval_delivery_limit
+            self.config.eval_nack_timeout, self.config.eval_delivery_limit,
+            ready_cap=self.config.eval_ready_cap,
+            ready_caps=self.config.eval_ready_caps,
         )
         self.blocked_evals = BlockedEvals(self.broker.enqueue_all)
         self.plan_queue = PlanQueue()
@@ -97,6 +99,21 @@ class Server:
         from ..dispatch import DispatchPipeline
 
         self.dispatch = DispatchPipeline(self)
+        # Overload protection (nomad_tpu/admission): pressure monitor +
+        # token-bucket intake control; the HTTP layer and the TCP
+        # transport consult it per request. The device-path breaker is
+        # process-global (it guards the one shared device, like the
+        # batcher); configure() updates thresholds without un-tripping.
+        from ..admission import AdmissionController, get_breaker
+
+        self.admission = AdmissionController(self, self.config)
+        get_breaker().configure(
+            failure_threshold=self.config.breaker_failure_threshold,
+            slow_ms=self.config.breaker_slow_ms,
+            slow_batches=self.config.breaker_slow_batches,
+            cooldown=self.config.breaker_cooldown,
+            enabled=self.config.breaker_enabled,
+        )
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -206,6 +223,10 @@ class Server:
                             ("dispatch", "in_flight"), d["in_flight"])
                         metrics.set_gauge(
                             ("dispatch", "pending"), d["pending"])
+                    # Pressure level is per-server too (followers gate
+                    # their own HTTP intake); snapshot() refreshes the
+                    # cached level and emits the gauge itself.
+                    self.admission.pressure.snapshot()
                     if not self._leader:
                         # Broker/plan-queue/heartbeats are leader-only
                         # (eval_broker.go:650 runs in the leader loop);
@@ -213,6 +234,8 @@ class Server:
                         # leader's gauges in shared sinks.
                         continue
                     broker = self.broker.stats()
+                    metrics.set_gauge(("broker", "shed"), broker["shed"])
+                    metrics.set_gauge(("broker", "expired"), broker["expired"])
                     metrics.set_gauge(("broker", "total_ready"), broker["total_ready"])
                     metrics.set_gauge(("broker", "total_unacked"), broker["total_unacked"])
                     metrics.set_gauge(("broker", "total_blocked"), broker["total_blocked"])
@@ -263,6 +286,10 @@ class Server:
         self.log = RaftLog(self.raft)
         self.plan_applier.log = self.log
         transport.register(self.raft)
+        # RPC intake admission (raft + leader-forward kinds exempt;
+        # transport.py _dispatch). Plain attribute assignment: inmem
+        # test transports simply never consult it.
+        transport.admission = self.admission
         for i in range(self.config.num_schedulers):
             worker = Worker(self, i)
             self.workers.append(worker)
@@ -1005,6 +1032,17 @@ class Server:
     # ----------------------------------------------------------- evals
 
     def eval_update(self, evals: List[Evaluation], token: str = "") -> int:
+        # Deadline stamping at the creation funnel: every fresh pending
+        # eval passes through here before the FSM commit that enqueues
+        # it. stamp() is a no-op on terminal/already-stamped evals, so
+        # status re-commits of existing evals pass through untouched.
+        ttl = self.config.eval_deadline_ttl
+        if ttl > 0:
+            from ..admission import deadline as _deadline
+
+            now = time.time()
+            for ev in evals:
+                _deadline.stamp(ev, ttl, now)
         return self.log.apply(
             fsm_msgs.EVAL_UPDATE, {"evals": evals, "token": token}
         )
@@ -1202,6 +1240,10 @@ class Server:
             "num_workers": len(self.workers),
             "dispatch_pipeline": self.dispatch.stats(),
             "plan_applier": self.plan_applier.stats(),
+            # Overload-protection surface (nomad_tpu/admission):
+            # pressure level + reasons, intake-bucket stats, and the
+            # device-path breaker state.
+            "admission": self.admission.snapshot(),
             # Per-stage eval-lifecycle latency table (nomad_tpu/trace):
             # count/mean/max + log-bucket p50/p95/p99 per stage, plus
             # the e2e row — the north-star p99, attributed.

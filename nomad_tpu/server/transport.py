@@ -22,6 +22,7 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..admission import AdmissionRejected
 from ..chaos import chaos
 from ..utils.backoff import Backoff
 from ..utils.codec import from_dict, to_dict
@@ -100,6 +101,12 @@ class TCPTransport(Transport):
         self._pool_lock = threading.Lock()
         self._closed = False
         self.dials = 0  # sockets ever opened (observability/tests)
+        # RPC-intake admission control (nomad_tpu/admission), wired by
+        # Server.start_with_raft. Raft consensus and leader-forward
+        # kinds are exempt inside check_rpc — shedding append_entries
+        # would turn overload into leader loss — so today this gates
+        # only non-raft frames (future bulk/query kinds).
+        self.admission = None
 
     # ------------------------------------------------------- serving
 
@@ -169,6 +176,15 @@ class TCPTransport(Transport):
         kind = msg.get("kind")
         if self.node is None:
             return {"error": "node not ready"}
+        if self.admission is not None:
+            try:
+                self.admission.check_rpc(kind)
+            except AdmissionRejected as e:
+                # Structured 503/429 analog for the frame protocol: the
+                # caller sees a normal error frame plus the machine-
+                # readable back-off hint, never a dropped connection.
+                return {"error": e.message, "status": e.status,
+                        "retry_after": round(e.retry_after, 3)}
         if kind == "request_vote":
             return self.node.handle_request_vote(msg["args"])
         if kind == "append_entries":

@@ -33,6 +33,10 @@ from .. import trace
 DEQUEUE_TIMEOUT = 0.5
 BACKOFF_BASE = 0.02
 BACKOFF_LIMIT = 2.0
+# Nap between saturation re-checks when the dispatch pipeline's
+# accumulator is full (intake backpressure, nomad_tpu/admission):
+# bounded, and short enough that drain resumes within a batch launch.
+BACKPRESSURE_NAP = 0.01
 
 
 def is_dense_factory(name: str) -> bool:
@@ -110,6 +114,7 @@ class Worker:
         self._paused = False  # guarded-by: _pause_lock
         self._pause_lock = threading.Lock()
         self._pause_cond = threading.Condition(self._pause_lock)
+        self._parked = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.rng = random.Random()
 
@@ -135,16 +140,43 @@ class Worker:
             self._paused = paused
             self._pause_cond.notify_all()
 
+    def parked(self) -> bool:
+        """True while the run loop is waiting inside the paused state —
+        i.e. this worker is provably NOT inside a broker dequeue. A
+        sleep after ``set_pause(True)`` is not equivalent: an in-flight
+        dequeue long-poll can outlive any fixed sleep on a loaded host
+        and steal the next enqueued eval."""
+        return self._parked.is_set()
+
     def _check_paused(self) -> None:
         with self._pause_lock:
-            while self._paused and not self._stop.is_set():
-                self._pause_cond.wait(0.5)
+            if not (self._paused and not self._stop.is_set()):
+                return
+            self._parked.set()
+            try:
+                while self._paused and not self._stop.is_set():
+                    self._pause_cond.wait(0.5)
+            finally:
+                self._parked.clear()
 
     # ------------------------------------------------------------------
 
     def run(self) -> None:
         while not self._stop.is_set():
             self._check_paused()
+            pipeline = getattr(self.server, "dispatch", None)
+            if (pipeline is not None and pipeline.enabled
+                    and pipeline.saturated()):
+                # Intake backpressure (nomad_tpu/admission): the
+                # central accumulator already holds two full batches.
+                # Draining more would only move backlog out of the
+                # BOUNDED broker ready queues into the pipeline's
+                # unbounded pending list, hiding it from priority
+                # shedding and deadline enforcement. Nap (bounded) and
+                # re-check; the stop/pause paths stay responsive.
+                metrics.incr_counter(("worker", "backpressure"))
+                time.sleep(BACKPRESSURE_NAP)
+                continue
             start = time.monotonic()
             ev, token = self.server.eval_dequeue(
                 self.server.config.enabled_schedulers, DEQUEUE_TIMEOUT

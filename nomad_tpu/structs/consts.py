@@ -56,6 +56,13 @@ EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
 # Broker delivery-limit exhaustion: the eval was dead-lettered to the
 # failed queue with a structured reason (server/broker.py nack()).
 EVAL_TRIGGER_DEAD_LETTER = "delivery-limit-exhausted"
+# Overload protection (nomad_tpu/admission): the eval was shed from a
+# full bounded ready queue (priority-aware, lowest-priority newest-first;
+# server/broker.py _shed_locked) ...
+EVAL_TRIGGER_SHED = "shed-overload"
+# ... or its creation-stamped deadline passed before it could be
+# dispatched (broker dequeue skip / dispatch-pipeline launch drop).
+EVAL_TRIGGER_EXPIRED = "deadline-expired"
 
 # --- Task states (structs.go:2317) ---
 TASK_STATE_PENDING = "pending"

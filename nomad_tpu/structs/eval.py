@@ -47,9 +47,23 @@ class Evaluation:
     # spans land in one tree. Empty on evals minted by older callers —
     # the recorder falls back to the eval id.
     trace_id: str = ""
+    # Overload protection (nomad_tpu/admission): absolute wall-clock
+    # instant past which this eval is stale — the broker skips it at
+    # dequeue and the dispatch pipeline drops it before matrix build.
+    # 0.0 = no deadline. Stamped once at creation (priority-scaled,
+    # admission/deadline.py) by the server's eval_update funnel.
+    deadline: float = 0.0
 
     def copy(self) -> "Evaluation":
         return copy.deepcopy(self)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when a deadline is set and has passed (wall clock)."""
+        if not self.deadline:
+            return False
+        import time
+
+        return (now if now is not None else time.time()) >= self.deadline
 
     def terminal_status(self) -> bool:
         return self.status in (

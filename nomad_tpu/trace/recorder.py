@@ -405,6 +405,16 @@ class FlightRecorder:
             return [self._tail[(self._tail_idx - 1 - k) % TAIL_KEEP]
                     for k in range(n)]
 
+    def e2e_p99(self) -> float:
+        """Rolling end-to-end p99 in ms (0.0 before any completions).
+        Cheap single-histogram read for the pressure monitor
+        (nomad_tpu/admission) — stage_stats() walks every stage."""
+        with self._hist_lock:
+            if not self._e2e.count:
+                return 0.0
+            return hist_percentile(
+                self._e2e.buckets, self._e2e.count, 0.99)
+
     def stage_stats(self) -> Dict[str, dict]:
         """Per-stage latency table: count/mean/max and log-bucket
         p50/p95/p99, all in milliseconds."""

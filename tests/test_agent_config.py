@@ -266,3 +266,30 @@ server {
     merged = merge_config(cfg, load_config(str(q)))
     assert merged.server.scheduler_factories == {
         "service": "service-tpu", "batch": "batch-tpu"}
+
+
+def test_overload_protection_knobs(tmp_path):
+    """Operators tune the overload-protection surfaces from HCL
+    (nomad_tpu/admission; server/config.py): bounded broker queues,
+    eval deadlines, the intake gate, and the device-path breaker."""
+    p = tmp_path / "a.hcl"
+    p.write_text('''
+server {
+  enabled = true
+  eval_ready_cap = 512
+  eval_deadline_ttl = 30.0
+  admission_enabled = false
+  breaker_enabled = true
+  breaker_failure_threshold = 3
+  breaker_cooldown = 2.5
+}
+''')
+    cfg = load_config(str(p))
+    assert cfg.server.eval_ready_cap == 512
+    assert cfg.server.eval_deadline_ttl == 30.0
+    assert cfg.server.admission_enabled is False
+    assert cfg.server.breaker_enabled is True
+    assert cfg.server.breaker_failure_threshold == 3
+    assert cfg.server.breaker_cooldown == 2.5
+    # Unset knobs stay None so merge/default semantics hold.
+    assert default_config().server.eval_ready_cap is None

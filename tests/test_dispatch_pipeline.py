@@ -532,3 +532,97 @@ def test_dispatcher_keeps_accumulating_while_launch_blocks():
         and pipeline.stats()["in_flight"] == 0, timeout=10.0), \
         (server.nacked, pipeline.stats())
 
+
+
+def test_saturated_pipeline_backpressures_worker_drain():
+    """Intake backpressure (nomad_tpu/admission): once the accumulator
+    holds two full batches, workers stop draining the broker — backlog
+    must stay in the BOUNDED ready queues where priority shedding and
+    deadline enforcement can see it, not migrate into the pipeline's
+    unbounded pending list."""
+    server = make_server(eval_batch_size=2)  # saturation bound = 4
+    try:
+        seed_nodes(server)
+        quiesce(server)
+        # Freeze the dispatcher so submitted evals stay pending.
+        server.dispatch._stop.set()
+        with server.dispatch._cond:
+            server.dispatch._cond.notify_all()
+        if server.dispatch._thread is not None:
+            server.dispatch._thread.join(timeout=5.0)
+
+        # Saturate: 4 evals >= 2 * max_batch(2).
+        for _ in range(4):
+            ev = mock.eval()
+            server.eval_update([ev])
+        assert wait_until(lambda: server.broker.ready_count() == 4, 5.0)
+        for _ in range(4):
+            got, token = server.broker.dequeue(["service"], timeout=1.0)
+            assert got is not None
+            server.dispatch.submit(got, token)
+        assert server.dispatch.saturated()
+
+        # A fresh storm lands in the broker; released workers must NOT
+        # drain it while the pipeline stays saturated.
+        for _ in range(6):
+            server.eval_update([mock.eval()])
+        assert wait_until(lambda: server.broker.ready_count() == 6, 5.0)
+        for w in server.workers:
+            w.set_pause(False)
+        time.sleep(0.8)  # > DEQUEUE_TIMEOUT: plenty of drain chances
+        assert server.broker.ready_count() == 6
+        assert server.dispatch.pending_count() == 4
+    finally:
+        server.shutdown()
+
+
+def test_pipeline_drops_expired_evals_before_matrix_build():
+    """Deadline enforcement at batch launch (nomad_tpu/admission): an
+    eval whose deadline passed while accumulating is terminalized with
+    a structured reason BEFORE any matrix build — and the live
+    remainder of the batch still dispatches."""
+    server = make_server(num_schedulers=0)  # manual submit control
+    try:
+        seed_nodes(server)
+        entries = []
+        for _ in range(3):
+            ev = mock.eval()
+            server.eval_update([ev])
+        # The live fourth eval belongs to a REAL job so its dispatch
+        # can complete with placements.
+        job = mock.job()
+        job.id = "live-job"
+        job.task_groups[0].tasks[0].resources.networks = []
+        server.job_register(job)
+        assert wait_until(lambda: server.broker.ready_count() == 4, 5.0)
+        for _ in range(4):
+            got, token = server.broker.dequeue(["service"], timeout=1.0)
+            assert got is not None
+            entries.append(got)
+            # Expire three of them AFTER the broker's dequeue-side
+            # check — the window this launch-time drop exists for.
+            if len(entries) < 4:
+                got.deadline = time.time() - 1.0
+            server.dispatch.submit(got, token)
+
+        assert wait_until(
+            lambda: server.dispatch.stats()["expired_dropped"] == 3, 10.0)
+        state = server.fsm.state
+        assert wait_until(
+            lambda: all(
+                state.eval_by_id(e.id) is not None
+                and state.eval_by_id(e.id).terminal_status()
+                for e in entries), 10.0)
+        for e in entries[:3]:
+            stored = state.eval_by_id(e.id)
+            assert stored.status == consts.EVAL_STATUS_FAILED
+            assert "deadline expired" in stored.status_description
+        # The live fourth eval dispatched and placed.
+        live = state.eval_by_id(entries[3].id)
+        assert live.job_id == "live-job"
+        assert live.status == consts.EVAL_STATUS_COMPLETE
+        assert state.allocs_by_job("live-job")
+        # Leases released: nothing left unacked, nothing re-delivers.
+        assert wait_until(lambda: server.broker.unacked_count() == 0, 5.0)
+    finally:
+        server.shutdown()

@@ -189,3 +189,102 @@ def test_blocking_query_stale_index_returns_immediately(api):
                                            wait=5.0)
     assert time.monotonic() - t0 < 1.0
     assert len(out) == 1 and new_idx >= cur
+
+
+# ---------------------------------------------------------------------
+# overload admission (nomad_tpu/admission): 429/503 + Retry-After,
+# effective long-poll timeout echo
+
+
+def _raw_request(addr, path, method="GET", body=None):
+    """Raw urllib call returning (status, headers, json_body) — the SDK
+    client hides headers, and Retry-After is the point here."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    data = _json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(addr + path, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return resp.status, dict(resp.headers), _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), _json.loads(e.read())
+
+
+def test_internal_dequeue_echoes_effective_timeout(api, monkeypatch):
+    client, server = api
+    addr = client.address.rstrip("/")
+    # An over-limit ask is clamped AND the clamp is reported. The cap
+    # is shrunk so the clamped long-poll returns within the test
+    # budget instead of parking for the real 300s.
+    from nomad_tpu.api import http as http_mod
+
+    monkeypatch.setattr(http_mod, "MAX_BLOCKING_WAIT", 0.2)
+    status, _h, out = _raw_request(
+        addr, "/v1/internal/eval/dequeue", method="POST",
+        body={"schedulers": [], "timeout": 99999.0})
+    assert status == 200
+    assert out["timeout"] == 0.2  # the effective (clamped) budget
+    assert out["eval"] is None
+    # An in-budget ask echoes itself.
+    status, _h, out = _raw_request(
+        addr, "/v1/internal/eval/dequeue", method="POST",
+        body={"schedulers": [], "timeout": 0.05})
+    assert status == 200
+    assert out["timeout"] == 0.05
+
+
+def test_admission_red_sheds_writes_with_retry_after(api):
+    client, server = api
+    addr = client.address.rstrip("/")
+    server.admission.force_level("red")
+    try:
+        job = mock.job()
+        from nomad_tpu.utils.codec import to_dict
+
+        status, headers, out = _raw_request(
+            addr, "/v1/jobs", method="PUT", body={"job": to_dict(job)})
+        assert status == 503
+        assert float(headers["Retry-After"]) > 0
+        assert "retry_after" in out
+        # Observability stays reachable while shedding.
+        status, _h, _out = _raw_request(addr, "/v1/metrics?format=json")
+        assert status == 200
+        # Internal leader-forward routes stay reachable.
+        status, _h, out = _raw_request(
+            addr, "/v1/internal/eval/dequeue", method="POST",
+            body={"schedulers": [], "timeout": 0.01})
+        assert status == 200
+    finally:
+        server.admission.force_level(None)
+    # Back to green: writes flow again.
+    eval_id = client.jobs.register(mock.job())
+    assert eval_id
+
+
+def test_admission_yellow_rate_limits_writes_429(api):
+    client, server = api
+    addr = client.address.rstrip("/")
+    # Drain the write bucket to a deterministic empty.
+    server.admission._write.rate = 0.0
+    server.admission._write.burst = 0.0
+    with server.admission._write._lock:
+        server.admission._write._tokens = 0.0
+    server.admission.force_level("yellow")
+    try:
+        from nomad_tpu.utils.codec import to_dict
+
+        status, headers, _out = _raw_request(
+            addr, "/v1/jobs", method="PUT",
+            body={"job": to_dict(mock.job())})
+        assert status == 429
+        assert float(headers["Retry-After"]) > 0
+        # Reads pass under yellow.
+        status, _h, _out = _raw_request(addr, "/v1/jobs")
+        assert status == 200
+    finally:
+        server.admission.force_level(None)
+        server.admission._write.rate = 50.0
+        server.admission._write.burst = 100.0

@@ -1,0 +1,340 @@
+"""Overload soak (nomad_tpu/admission): a mock cluster driven with a
+3x-capacity submission storm, A/B'd with protection ON vs OFF.
+
+Protection ON (bounded service queue + admission gate + deadline
+stamping + device-path breaker):
+
+- goodput (accepted evals/s) stays >= 80% of the no-storm baseline —
+  the protected server keeps doing useful work under the storm;
+- every shed eval reaches a structured terminal outcome EXACTLY once
+  (`EVAL_TRIGGER_SHED`, status=failed, counted once, never also
+  dead-lettered);
+- shedding is priority-aware: every accepted eval outranks (>=) every
+  shed one;
+- the pressure monitor reads red at full queue and the HTTP admission
+  gate sheds writes with a Retry-After while observability stays
+  reachable;
+- the dispatcher thread stays live (liveness roster read from
+  ntalint's NTA_DISPATCHER_ENTRYPOINTS manifest);
+- under a seeded chaos schedule (`device.breaker_trip`,
+  `admission.slow_consumer`) the circuit breaker demonstrably trips ->
+  half-opens -> recloses, read from its transition log.
+
+Protection OFF: the same storm grows the broker monotonically past the
+ON arm's bound with zero sheds — the unbounded behaviour this PR
+removes by default-config choice, kept reachable for the A/B.
+
+`bench.py --overload` reports the same A/B quantitatively
+(BENCH_r09.json: shed_rate, goodput, accepted-eval p99).
+"""
+
+import random
+import time
+from collections import Counter
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.admission import AdmissionRejected, get_breaker
+from nomad_tpu.chaos import FaultSpec, chaos
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.worker import DEQUEUE_TIMEOUT
+from nomad_tpu.structs import consts
+
+N_NODES = 60
+CAP = 8  # bounded service-queue depth for the ON arm
+STORM = 3 * CAP  # the 3x-capacity burst
+SOAK_SEED = 90210
+
+
+@pytest.fixture(autouse=True)
+def _isolate_globals():
+    """Chaos registry and the device-path breaker are process-global:
+    state leaked past one test would fault or trip whatever runs
+    next."""
+    yield
+    chaos.disarm()
+    b = get_breaker()
+    b.reset()
+    b.configure(failure_threshold=5, slow_ms=0.0, slow_batches=8,
+                cooldown=5.0, enabled=True)
+
+
+def wait_until(fn, timeout=90.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_server(**over):
+    defaults = dict(
+        num_schedulers=4,
+        scheduler_factories={"service": "service-tpu"},
+        eval_batch_size=16,
+        eval_nack_timeout=2.0,
+    )
+    defaults.update(over)
+    server = Server(ServerConfig(**defaults))
+    server.start()
+    return server
+
+
+def seed_nodes(server, n=N_NODES):
+    for _ in range(n):
+        node = mock.node()
+        node.compute_class()
+        server.node_register(node)
+
+
+def quiesce(server):
+    """Park every worker and wait for each to ACK from inside the
+    paused wait — only then is no dequeue long-poll in flight that
+    could steal the next storm's evals (a fixed sleep raced this on
+    loaded hosts; an in-flight long-poll can outlive it)."""
+    for w in server.workers:
+        w.set_pause(True)
+    assert wait_until(
+        lambda: all(w.parked() for w in server.workers),
+        timeout=4 * DEQUEUE_TIMEOUT + 30.0), [
+            (w.id, w.parked()) for w in server.workers]
+
+
+def submit_storm(server, n_jobs, prefix, rng=None, count=4):
+    """Register a storm against paused workers and return
+    {eval_id: priority}; the caller releases the workers."""
+    quiesce(server)
+    evals = {}
+    for i in range(n_jobs):
+        job = mock.job()
+        job.id = f"{prefix}-{i}"
+        if rng is not None:
+            job.priority = rng.choice([20, 50, 80])
+        job.task_groups[0].count = count  # dense path engages
+        job.task_groups[0].tasks[0].resources.cpu = 20
+        job.task_groups[0].tasks[0].resources.memory_mb = 16
+        job.task_groups[0].tasks[0].resources.networks = []
+        ev_id, _idx = server.job_register(job)
+        evals[ev_id] = job.priority
+    return evals
+
+
+def release(server):
+    for w in server.workers:
+        w.set_pause(False)
+
+
+def run_to_terminal(server, eval_ids, timeout=90.0):
+    """Release the workers and return the wall-clock seconds until
+    every eval in `eval_ids` is terminal in FSM state."""
+    t0 = time.perf_counter()
+    release(server)
+    state = server.fsm.state
+
+    def done():
+        evs = [state.eval_by_id(e) for e in eval_ids]
+        return all(e is not None and e.terminal_status() for e in evs)
+
+    assert wait_until(done, timeout), {
+        e: getattr(state.eval_by_id(e), "status", None) for e in eval_ids}
+    return time.perf_counter() - t0
+
+
+def settle_quiet(server, timeout=60.0):
+    assert wait_until(
+        lambda: (server.broker.ready_count() == 0
+                 and server.broker.unacked_count() == 0
+                 and server.dispatch.stats()["in_flight"] == 0
+                 and server.dispatch.stats()["pending"] == 0),
+        timeout), (server.broker.stats(), server.dispatch.stats())
+
+
+def assert_dispatcher_live(server):
+    """ntalint's never-block manifest doubles as the liveness roster:
+    every entrypoint's thread must still be running post-storm."""
+    from nomad_tpu.dispatch.pipeline import NTA_DISPATCHER_ENTRYPOINTS
+
+    assert NTA_DISPATCHER_ENTRYPOINTS
+    for entry in NTA_DISPATCHER_ENTRYPOINTS:
+        cls_name, _meth = entry.split(".")
+        assert cls_name == "DispatchPipeline", entry
+        thread = server.dispatch._thread
+        assert thread is not None and thread.is_alive(), (
+            f"dispatcher thread for {entry} stalled/died")
+
+
+def test_overload_soak_protection_on():
+    rng = random.Random(SOAK_SEED)
+    server = make_server(
+        # Bound ONLY the service queue so the pressure monitor's
+        # ready-fraction input reads against exactly this cap.
+        eval_ready_cap=0,
+        eval_ready_caps={"service": CAP},
+        eval_deadline_ttl=60.0,  # stamped on every eval; never expires here
+        # K-consecutive semantics is unit-tested (test_admission); the
+        # soak uses K=1 so the seeded single device fault trips the
+        # breaker deterministically regardless of batch interleaving.
+        breaker_failure_threshold=1,
+        breaker_cooldown=0.6,
+    )
+    try:
+        seed_nodes(server)
+
+        # Warm (unmeasured): compiles every jitted program the storms run.
+        warm = submit_storm(server, CAP, "warm")
+        run_to_terminal(server, warm)
+        settle_quiet(server)
+
+        # Baseline: capacity-sized storms, no overload, no shedding.
+        # Two reps, conservative (slowest) one is the baseline — host
+        # drift must not manufacture a goodput regression.
+        rates = []
+        for rep in ("base0", "base1"):
+            evs = submit_storm(server, CAP, rep)
+            elapsed = run_to_terminal(server, evs)
+            rates.append(len(evs) / elapsed)
+            settle_quiet(server)
+        baseline_rate = min(rates)
+        assert server.broker.stats()["shed"] == 0  # baseline never sheds
+
+        # Overload: a 3x-capacity burst against paused workers. The
+        # bounded queue must hold at CAP, shedding the rest with a
+        # structured outcome, and the pressure/admission loop must
+        # react while the storm is standing.
+        storm = submit_storm(server, STORM, "storm", rng=rng)
+        bstats = server.broker.stats()
+        assert bstats["total_ready"] <= CAP
+        assert bstats["shed"] == STORM - CAP
+        assert bstats["dead_lettered"] == 0 and bstats["expired"] == 0
+
+        snap = server.admission.pressure.snapshot(refresh=True)
+        assert snap["level"] == "red", snap
+        assert any("ready depth" in r for r in snap["reasons"]), snap
+        # Red pressure: the write gate sheds with a back-off hint...
+        with pytest.raises(AdmissionRejected) as exc:
+            server.admission.check_http("PUT", "/v1/jobs", "job_update")
+        assert exc.value.status == 503 and exc.value.retry_after > 0
+        # ...while the observability surface stays reachable.
+        server.admission.check_http("GET", "/v1/agent/self", "agent_self")
+        # Deadlines were stamped at the creation funnel.
+        sample = next(iter(storm))
+        assert server.fsm.state.eval_by_id(sample).deadline > time.time()
+
+        elapsed = run_to_terminal(server, storm)
+        goodput = CAP / elapsed  # CAP accepted evals completed
+        assert goodput >= 0.8 * baseline_rate, (
+            f"goodput {goodput:.2f} evals/s < 80% of baseline "
+            f"{baseline_rate:.2f}")
+        settle_quiet(server)
+
+        # Every shed eval: structured terminal outcome EXACTLY once.
+        state = server.fsm.state
+        evs = [state.eval_by_id(e) for e in storm]
+        assert all(e is not None and e.terminal_status() for e in evs)
+        statuses = Counter(e.id for e in state.evals())
+        assert all(c == 1 for c in statuses.values())  # one record per id
+        shed = [e for e in evs if e.triggered_by == consts.EVAL_TRIGGER_SHED]
+        accepted = [e for e in evs
+                    if e.triggered_by != consts.EVAL_TRIGGER_SHED]
+        assert len(shed) == STORM - CAP and len(accepted) == CAP
+        for e in shed:
+            assert e.status == consts.EVAL_STATUS_FAILED
+            assert "shed" in e.status_description
+        for e in accepted:
+            assert e.status == consts.EVAL_STATUS_COMPLETE, (
+                e.id, e.status, e.status_description)
+        # Priority-aware: every accepted eval outranks every shed one.
+        assert (min(storm[e.id] for e in accepted)
+                >= max(storm[e.id] for e in shed))
+        # The counter agrees with the state-store census: counted once.
+        assert server.broker.stats()["shed"] == len(shed)
+        # Pressure recovered once the storm drained.
+        assert server.admission.pressure.snapshot(refresh=True)[
+            "level"] == "green"
+
+        # Breaker leg, seeded: one injected device fault trips the
+        # breaker (closed -> open); the rest of the storm routes host.
+        breaker = get_breaker()
+        assert breaker.state() == "closed"  # nothing tripped it so far
+        chaos.arm(SOAK_SEED, [
+            FaultSpec("device.breaker_trip", "error", count=1),
+            FaultSpec("admission.slow_consumer", "delay", delay=0.05,
+                      count=2),
+        ])
+        trip_storm = submit_storm(server, CAP, "trip")
+        run_to_terminal(server, trip_storm)
+        settle_quiet(server)
+        assert not chaos.unfired(), [
+            s.to_dict() for s in chaos.unfired()]
+        chaos.disarm()
+        assert breaker.stats()["trips"] >= 1
+
+        # Cool-down passes, faults are gone: the next dense storm
+        # sends exactly one half-open probe, which succeeds and
+        # recloses the breaker.
+        time.sleep(0.7)
+        probe_storm = submit_storm(server, CAP, "probe")
+        run_to_terminal(server, probe_storm)
+        settle_quiet(server)
+        st = breaker.stats()
+        assert st["half_opens"] >= 1 and st["recloses"] >= 1, st
+        assert breaker.state() == "closed"
+        # The transition log shows the full arc, in order.
+        arcs = [(a, b) for (_t, a, b) in breaker.transitions()]
+        i_open = arcs.index(("closed", "open"))
+        i_half = arcs.index(("open", "half-open"), i_open)
+        assert ("half-open", "closed") in arcs[i_half:]
+
+        assert_dispatcher_live(server)
+    finally:
+        chaos.disarm()
+        server.shutdown()
+
+
+def test_overload_storm_protection_off_queues_without_bound():
+    """The same 3x burst with every protection off: broker depth grows
+    monotonically past the ON arm's cap, nothing is shed — and the
+    server eventually works through ALL of it (unbounded queueing, not
+    data loss, is the failure mode the caps replace)."""
+    server = make_server(
+        eval_ready_cap=0,
+        admission_enabled=False,
+        breaker_enabled=False,
+    )
+    try:
+        seed_nodes(server)
+        quiesce(server)
+        depths = []
+        evals = []
+        for i in range(STORM):
+            job = mock.job()
+            job.id = f"off-{i}"
+            job.task_groups[0].count = 4
+            job.task_groups[0].tasks[0].resources.cpu = 20
+            job.task_groups[0].tasks[0].resources.memory_mb = 16
+            job.task_groups[0].tasks[0].resources.networks = []
+            ev_id, _ = server.job_register(job)
+            evals.append(ev_id)
+            depths.append(server.broker.ready_count())
+        # Monotonic growth to the full storm size, well past the ON
+        # arm's bound; zero sheds.
+        assert all(b >= a for a, b in zip(depths, depths[1:])), depths
+        assert depths[-1] == STORM > CAP
+        assert server.broker.stats()["shed"] == 0
+        # Disabled admission is transparent even at a forced red level.
+        server.admission.force_level("red")
+        try:
+            server.admission.check_http("PUT", "/v1/jobs", "job_update")
+        finally:
+            server.admission.force_level(None)
+        # Drain so shutdown is clean — and to show every queued eval
+        # still completes once the storm stops.
+        run_to_terminal(server, evals, timeout=120.0)
+        state = server.fsm.state
+        assert all(
+            state.eval_by_id(e).status == consts.EVAL_STATUS_COMPLETE
+            for e in evals)
+    finally:
+        server.shutdown()

@@ -491,7 +491,7 @@ def test_apply_baseline_count_is_a_ceiling(tmp_path):
 
 CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
-             "nomad_tpu/trace/")
+             "nomad_tpu/trace/", "nomad_tpu/admission/")
 
 
 def _tree_findings():
