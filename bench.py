@@ -261,6 +261,13 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     penalty = 5.0 if job.type == "batch" else 10.0
     config = PlacementConfig(anti_affinity_penalty=penalty,
                              pre_resolve=pre_resolve)
+    # Mirror the live dense scheduler (scheduler/tpu.py): a uniform
+    # distinct-hosts ask set takes the one-pass top_k program.
+    from nomad_tpu.ops.binpack import uniform_dh_flag
+
+    _probe_asks = ClusterMatrix(snap, job).build_asks(tg_cycle)
+    config = config._replace(uniform_dh=uniform_dh_flag(
+        tg_cycle, _probe_asks[5], _probe_asks[6]))
     from nomad_tpu.chaos import chaos
     from nomad_tpu.trace import (
         STAGE_DEVICE_DISPATCH,
@@ -434,6 +441,15 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         if dstats.get("dispatches") else 0.0)
     dstats["conflicts_per_eval"] = conflicted_evals / n_evals
     dstats["device_retries"] = device_retries[0]
+    # Device-residency columns (models/resident.py): host->device
+    # bytes per dispatched batch in steady state (a resident base
+    # rides the cache/delta paths — re-shipping the full [N,R] matrix
+    # here is the regression the design removed), and the jit
+    # compile-cache GROWTH across the measured (post-warmup) rounds —
+    # steady state must be 0; --check refuses dense numbers otherwise.
+    dstats["transfer_bytes_per_batch"] = (
+        dstats.get("upload_bytes", 0) / max(dstats.get("dispatches", 0), 1))
+    dstats["jit_recompiles"] = dstats.get("jit_cache_size", 0)
     return (n_evals / elapsed, float(np.percentile(latencies, 99)),
             dstats)
 
@@ -540,6 +556,8 @@ def config_4():
         "retries_per_eval": ds["conflicts_per_eval"],
         "retries_per_eval_nopre": ds_off["conflicts_per_eval"],
         "device_retries": ds["device_retries"] + ds_off["device_retries"],
+        "transfer_bytes_per_batch": ds["transfer_bytes_per_batch"],
+        "jit_recompiles": ds["jit_recompiles"],
     }
 
 
@@ -907,6 +925,11 @@ def _live_result(name, cpu_rate, cpu_success, cpu_lone_p99,
         "retries_per_eval": pipe.get("retries_per_eval", 0.0),
         "shed": (dstats.get("broker", {}).get("shed", 0)
                  + dstats.get("broker", {}).get("expired", 0)),
+        "transfer_bytes_per_batch": (
+            dstats.get("upload_bytes", 0)
+            / max(dstats.get("dispatches", 0), 1)),
+        "jit_recompiles": dstats.get("jit_cache_size", 0),
+        "prefetch_bytes": pipe.get("prefetch_bytes", 0),
     }
 
 
@@ -1354,6 +1377,55 @@ def _shed_gate(out, n):
         sys.exit(2)
 
 
+def _recompile_gate(out, n):
+    """--check: steady-state jit recompiles after warmup invalidate
+    dense-path numbers — the measured rounds paid trace+compile stalls
+    a long-running server would not (a shape-bucket leak, an unhashable
+    static arg, a drifting padding ladder). Refuse."""
+    rec = out.get("columns", {}).get("jit_recompiles", {}).get("median")
+    if rec:
+        print(f"bench: REFUSING to report config {n}: steady-state "
+              f"jit_recompiles = {rec} after warmup — the dense path "
+              f"recompiled mid-measurement (shape bucket leak?); fix "
+              f"the bucket ladder or extend warmup", file=sys.stderr)
+        sys.exit(2)
+
+
+def run_resident_ab(reps=DEFAULT_REPS):
+    """Device-resident state ON/OFF A/B of config 4 (the north-star
+    cluster shape) -> BENCH_r10.json: ON is the shipping default
+    (universe matrix + node-axis deltas + prefetch), OFF reverts to
+    the ready-subset rebuild-per-snapshot path. Reports both arms'
+    full summaries (stage p99 tables included) plus the headline
+    deltas; the parity gate is the ON arm's e2e_x — the A/B proves
+    the residency machinery costs nothing when the snapshot is static
+    and the live configs (6/8) show what the deltas save."""
+    from nomad_tpu.models import resident
+
+    resident.configure(enabled=True)
+    on = run_config(HEADLINE_CONFIG, reps=reps)
+    try:
+        resident.configure(enabled=False)
+        off = run_config(HEADLINE_CONFIG, reps=reps)
+    finally:
+        resident.configure(enabled=True)
+    on_dd = on.get("stage_p99_ms", {}).get("device.dispatch", 0.0)
+    off_dd = off.get("stage_p99_ms", {}).get("device.dispatch", 0.0)
+    return {
+        "metric": (
+            f"[config {HEADLINE_CONFIG} resident A/B] ON: "
+            f"e2e={on['value']:.1f} evals/s (e2e_x {on['e2e_x']:.2f}), "
+            f"device.dispatch p99 {on_dd:.1f}ms, "
+            f"transfer/batch {on['columns']['transfer_bytes_per_batch']['median']:.0f}B, "
+            f"recompiles {on['columns']['jit_recompiles']['median']:.0f}; "
+            f"OFF: e2e={off['value']:.1f} (e2e_x {off['e2e_x']:.2f}), "
+            f"device.dispatch p99 {off_dd:.1f}ms"
+        ),
+        "resident_on": on,
+        "resident_off": off,
+    }
+
+
 def ntalint_purity_gate():
     """Trace-purity findings in the kernel path (ops/, scheduler/)
     invalidate dense-path numbers BY CONSTRUCTION: an impure call or a
@@ -1409,6 +1481,10 @@ def main():
                              "storm at 3x, report shed_rate / goodput / "
                              "accepted-eval p99 with protection on vs "
                              "off")
+    parser.add_argument("--resident-ab", action="store_true",
+                        help="device-resident state ON/OFF A/B on "
+                             "config 4 (models/resident.py) — the "
+                             "BENCH_r10 arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -1445,6 +1521,14 @@ def main():
               "comparison (the purity gate above DID run)",
               file=sys.stderr)
 
+    if args.resident_ab:
+        out = run_resident_ab(reps=args.reps)
+        if args.check:
+            _shed_gate(out["resident_on"], HEADLINE_CONFIG)
+            _recompile_gate(out["resident_on"], HEADLINE_CONFIG)
+        print(json.dumps(out))
+        return
+
     if args.chaos is not None:
         print(json.dumps(run_chaos(args.chaos)))
         return
@@ -1458,6 +1542,7 @@ def main():
             out = run_config(n, reps=args.reps)
             if args.check:
                 _shed_gate(out, n)
+                _recompile_gate(out, n)
             print(json.dumps(out))
         return
 
@@ -1479,6 +1564,7 @@ def main():
         out = run_config(args.config, reps=args.reps)
     if args.check:
         _shed_gate(out, args.config)
+        _recompile_gate(out, args.config)
     print(json.dumps(out))
 
 

@@ -240,7 +240,7 @@ def analyze_paths(paths: List[str],
     """Run every checker over `paths`; returns findings with inline
     `# nta: disable=` suppressions already applied, sorted by
     (path, line, rule)."""
-    from . import locks, purity, robustness, snapshot
+    from . import locks, purity, residency, robustness, snapshot
 
     modules, parse_errors = load_modules(paths)
     registry = purity.build_jit_registry(modules)
@@ -250,6 +250,7 @@ def analyze_paths(paths: List[str],
         findings.extend(purity.check(mod, registry))
         findings.extend(snapshot.check(mod))
         findings.extend(robustness.check(mod))
+        findings.extend(residency.check(mod))
     by_rel = {m.rel: m for m in modules}
     kept = []
     for f in findings:

@@ -51,6 +51,13 @@ site                   wired into
                        (error = device fault the breaker counts — K of
                        them trip the dense path to the host iterators;
                        delay = a slow batch for the slow-trip rule)
+``matrix.stale_delta``  incremental cluster-base delta application
+                       (drop = one delta record is lost: a changed node
+                       row keeps its stale values on host AND device,
+                       so the scheduler plans against wrong state — the
+                       plan applier's exact verification must catch the
+                       bad placement and force a full rebuild,
+                       models/resident.py)
 =====================  =======================================================
 """
 
@@ -80,6 +87,7 @@ KNOWN_SITES = frozenset({
     "client.heartbeat",
     "admission.slow_consumer",
     "device.breaker_trip",
+    "matrix.stale_delta",
 })
 
 DROP = "drop"

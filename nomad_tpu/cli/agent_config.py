@@ -43,6 +43,10 @@ class ServerBlock:
     dispatch_pipeline: Optional[bool] = None
     dispatch_max_inflight: Optional[int] = None
     dense_pre_resolve: Optional[bool] = None
+    # Device-resident node state (models/resident.py): enable knob +
+    # the delta-vs-rebuild row threshold (0 = auto).
+    device_resident: Optional[bool] = None
+    resident_rebuild_rows: Optional[int] = None
     # Overload protection (nomad_tpu/admission; server/config.py):
     # bounded broker ready queues, eval deadlines, the token-bucket
     # intake gate, and the device-path circuit breaker.
@@ -207,6 +211,7 @@ _SCHEMA: Dict[str, Any] = {
     "server.eval_batch_size": int, "server.dense_min_batch": int,
     "server.dispatch_pipeline": bool, "server.dispatch_max_inflight": int,
     "server.dense_pre_resolve": bool,
+    "server.device_resident": bool, "server.resident_rebuild_rows": int,
     "server.eval_ready_cap": int, "server.eval_deadline_ttl": float,
     "server.admission_enabled": bool, "server.breaker_enabled": bool,
     "server.breaker_failure_threshold": int,

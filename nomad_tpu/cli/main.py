@@ -667,6 +667,12 @@ def cmd_agent(args) -> int:
                 cfg.server.dispatch_max_inflight)
         if cfg.server.dense_pre_resolve is not None:
             server_cfg.dense_pre_resolve = cfg.server.dense_pre_resolve
+        # Device-resident node state (models/resident.py).
+        if cfg.server.device_resident is not None:
+            server_cfg.device_resident = cfg.server.device_resident
+        if cfg.server.resident_rebuild_rows is not None:
+            server_cfg.resident_rebuild_rows = (
+                cfg.server.resident_rebuild_rows)
         # Overload protection (nomad_tpu/admission): bounded broker
         # queues, deadlines, intake gate, device-path breaker.
         if cfg.server.eval_ready_cap is not None:

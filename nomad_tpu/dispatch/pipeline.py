@@ -202,6 +202,8 @@ class DispatchPipeline:
         self.requeues = 0  # guarded-by: _lock (retries via accumulator)
         self.requeues_batched = 0  # guarded-by: _lock (joined a batch)
         self.inline_retries = 0  # guarded-by: _lock (classic retries)
+        self.prefetches = 0  # guarded-by: _lock (base prefetch calls)
+        self.prefetch_bytes = 0  # guarded-by: _lock (host->device bytes)
         self.t_drain = 0.0  # guarded-by: _lock (time in accumulator)
         self.t_process = 0.0  # guarded-by: _lock (scheduler invoke)
         self.t_submit = 0.0  # guarded-by: _lock (plan queue + commit)
@@ -549,7 +551,62 @@ class DispatchPipeline:
                 from ..scheduler.batcher import get_batcher
 
                 get_batcher().add_cohort(announce)
+            self._prefetch_bases(batch, snapshot)
         return snapshot, route_host
+
+    def _prefetch_bases(self, batch: List[_Pending], snapshot) -> None:
+        """Async double-buffering, host side: make this batch's cluster
+        base(s) device-resident NOW — on this stage thread, while the
+        PREVIOUS batch's device compute and plan submits are still in
+        flight (`dispatch_max_inflight` overlaps them) — so the batch's
+        evals find their base token already cached at place() time and
+        the (tiny) delta transfer hides under compute instead of
+        serializing in front of its own dispatch. The base is
+        job-independent; distinct datacenter sets across the batch's
+        jobs each resolve one base. Failures are non-fatal: place()
+        falls back to uploading synchronously, exactly as before."""
+        from ..models.matrix import prefetch_cluster_base
+        from ..models.resident import get_tracker
+        from ..scheduler.batcher import get_batcher
+
+        if not get_tracker().is_enabled():
+            return
+        dc_sets = {}
+        for entry in batch:
+            if entry.eval.type == consts.JOB_TYPE_SYSTEM:
+                # DenseSystemScheduler builds its matrix over explicit
+                # pinned nodes (a different cache family) and never
+                # touches the batcher — same exclusion as the cohort
+                # announce above.
+                continue
+            job = snapshot.job_by_id(entry.eval.job_id)
+            if job is None:
+                continue
+            dc_sets.setdefault(
+                tuple(sorted(job.datacenters or [])), []).append(entry)
+        batcher = get_batcher()
+        for dcs, entries in dc_sets.items():
+            t0 = time.monotonic()
+            try:
+                view, kind = prefetch_cluster_base(snapshot, list(dcs))
+                nbytes = batcher.prefetch_base(view) if view else 0
+            except Exception:
+                self.logger.warning(
+                    "base prefetch failed; place() will upload "
+                    "synchronously", exc_info=True)
+                continue
+            with self._lock:
+                self.prefetches += 1
+                self.prefetch_bytes += nbytes
+            metrics.incr_counter(("dispatch", "prefetch_bytes"), nbytes)
+            # One span per eval riding this base: stage attribution for
+            # the new path (the bytes shipped are the batch's WHOLE
+            # host->device traffic when the delta path holds).
+            for entry in entries:
+                trace.record_span(
+                    entry.eval.id, trace.STAGE_DEVICE_TRANSFER, t0,
+                    ann={"bytes": nbytes, "kind": kind},
+                    trace_id=entry.eval.trace_id)
 
     # ---------------------------------------------------------- stages
 
@@ -727,6 +784,8 @@ class DispatchPipeline:
                 "finish_dropped": self.finish_dropped,
                 "expired_dropped": self.expired_dropped,
                 "breaker_routed": self.breaker_routed,
+                "prefetches": self.prefetches,
+                "prefetch_bytes": self.prefetch_bytes,
                 "retries_per_eval": round(retries / done, 4) if done else 0.0,
                 # Cumulative stage latencies (divide by the matching
                 # counters for per-unit): microseconds, like the

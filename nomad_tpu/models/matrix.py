@@ -60,6 +60,10 @@ _BASE_CACHE: Dict[Tuple, "_ClusterBase"] = {}
 _BASE_FAMILY: Dict[Tuple, "_ClusterBase"] = {}
 # key -> Event while a build is in flight (single-flight guard).
 _BASE_PENDING: Dict[Tuple, object] = {}
+# Bumped (under _BASE_CACHE_LOCK) by every stale-purge: a builder that
+# delta'd from a pre-purge parent sees the epoch moved at store time
+# and must discard its chain instead of re-seeding the purged cache.
+_BASE_EPOCH = 0
 _BASE_CACHE_MAX = 8
 _BASE_CACHE_LOCK = __import__("threading").Lock()
 _BASE_TOKENS = __import__("itertools").count(1)
@@ -69,11 +73,11 @@ class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
                  "alloc_groups", "token", "allocs_index", "table_len",
-                 "delta_parent", "class_ids", "class_reps",
+                 "nodes_index", "delta_parent", "class_ids", "class_reps",
                  "_positions", "_positions_lock")
 
     def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
-                 table_len: int = -1):
+                 table_len: int = -1, nodes_index: int = -1):
         # Identity token: evals whose matrices share one base can share
         # a single device upload (scheduler/batcher.py groups by it).
         self.token = next(_BASE_TOKENS)
@@ -82,6 +86,11 @@ class _ClusterBase:
         # to the modify_index scan, so a shrinking table forces a full
         # rebuild (see delta_update).
         self.table_len = table_len
+        # Nodes-table watermark: node up/down/drain transitions bump it
+        # and delta as node_ok row flips (models/resident.py) — the
+        # node stays in the matrix, masked, instead of rebuilding the
+        # node axis. -1 = node-axis deltas off for this base.
+        self.nodes_index = nodes_index
         # (parent_token, changed_rows) when this base was produced by
         # delta_update: the batcher uses it to scatter-update the
         # parent's device-cached arrays instead of re-uploading
@@ -188,7 +197,10 @@ class _ClusterBase:
         self.alloc_groups[i] = groups
         self.ports_free[i] = (
             consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT - ports_used)
-        self.node_ok[i] = True
+        # Readiness is ROW state, not matrix membership: the resident
+        # universe keeps down/draining nodes in the matrix with node_ok
+        # masked, so their transitions are deltas (models/resident.py).
+        self.node_ok[i] = node.ready()
 
     def _fill_all(self, nodes, proposed_fn) -> None:
         """Full build, vectorized over allocs: statics per node (a
@@ -219,25 +231,56 @@ class _ClusterBase:
         self.ports_free[:n_real] = (
             consts.MAX_DYNAMIC_PORT - consts.MIN_DYNAMIC_PORT
             - static_ports - alloc_ports)
-        self.node_ok[:n_real] = True
+        self.node_ok[:n_real] = [node.ready() for node in nodes]
 
-    def delta_update(self, nodes, state,
-                     new_allocs_index: int) -> Optional["_ClusterBase"]:
+    def delta_update(self, nodes, state, new_allocs_index: int,
+                     new_nodes_index: int = -1) -> Optional["_ClusterBase"]:
         """A newer base for the same node set: only rows whose allocs
-        changed since our allocs_index are recomputed. Returns None when
-        a full rebuild is the better deal (too many touched rows) or
-        required for correctness (allocs were DELETED — GC removals
-        leave no modify_index trace, so their usage would stay baked
-        in), or self unchanged-but-rekeyed when no relevant alloc moved
-        (same token -> the device-cached upload is reused as-is)."""
-        # Snapshot the watermark pair ONCE: this base may be shared
+        changed since our allocs_index are recomputed — and, when the
+        NODES table advanced too, rows whose node object changed
+        (up/down/drain flips) are refilled with node_ok re-derived, so
+        a node transition is a delta record like a plan commit instead
+        of a node-axis rebuild. Returns None when a full rebuild is the
+        better deal (too many touched rows) or required for correctness
+        (allocs were DELETED — GC removals leave no modify_index trace,
+        so their usage would stay baked in; or a changed node's
+        capacity/class moved, which the device-shared immutable arrays
+        cannot express), or self unchanged-but-rekeyed when no relevant
+        alloc moved (same token -> the device-cached upload is reused
+        as-is)."""
+        # Snapshot the watermark set ONCE: this base may be shared
         # across worker threads, and a concurrent rekey mid-scan would
         # make us compare a mixed-era (table_len, allocs_index) pair.
         with _BASE_CACHE_LOCK:
             base_allocs_index = self.allocs_index
             base_table_len = self.table_len
+            base_nodes_index = self.nodes_index
         if base_allocs_index < 0 or base_table_len < 0:
             return None
+        if new_nodes_index != base_nodes_index and base_nodes_index < 0:
+            # The nodes table moved but this base can't attribute node
+            # changes (no watermark): rebuild.
+            return None
+        node_rows: List[int] = []
+        if 0 <= base_nodes_index < new_nodes_index:
+            for i, node in enumerate(nodes):
+                if node.modify_index <= base_nodes_index:
+                    continue
+                # The device keeps capacity/sched_capacity/bw_avail
+                # and the class index of a delta child BY REFERENCE to
+                # the parent (scheduler/batcher.py): a node whose
+                # computed class moved (or that IS its class's
+                # representative — the memoized verdicts were computed
+                # on its old attributes) can't ride a row delta.
+                ci = int(self.class_ids[i]) if i < self.n_real else -1
+                if ci >= 0:
+                    rep = self.class_reps[ci]
+                    if rep == i or (nodes[rep].computed_class
+                                    != node.computed_class):
+                        return None
+                elif node.computed_class:
+                    return None
+                node_rows.append(i)
         allocs = state.allocs()
         created = sum(1 for a in allocs if a.create_index > base_allocs_index)
         if len(allocs) != base_table_len + created:
@@ -266,7 +309,11 @@ class _ClusterBase:
         row_of = {node.id: i for i, node in enumerate(nodes)}
         adds = [a for a in adds
                 if a.node_id not in refill_nids and a.node_id in row_of]
-        refill_rows = [row_of[nid] for nid in refill_nids if nid in row_of]
+        node_row_set = set(node_rows)
+        refill_rows = sorted(
+            {row_of[nid] for nid in refill_nids if nid in row_of}
+            | node_row_set)
+        adds = [a for a in adds if row_of[a.node_id] not in node_row_set]
         rows = sorted({row_of[a.node_id] for a in adds}
                       | set(refill_rows))
         if not rows:
@@ -281,14 +328,34 @@ class _ClusterBase:
                 if new_allocs_index > self.allocs_index:
                     self.allocs_index = new_allocs_index
                     self.table_len = len(allocs)
+                if 0 <= self.nodes_index < new_nodes_index:
+                    self.nodes_index = new_nodes_index
             return self
-        if len(refill_rows) > max(64, self.n_real // 4):
+        from .resident import get_tracker
+
+        if len(refill_rows) > get_tracker().max_refill_rows(self.n_real):
             return None  # full rebuild is cheaper (refills only: the
             #              additive rows cost O(1) per new alloc)
+        from ..chaos import chaos
+
+        if chaos.enabled and chaos.fire(
+                "matrix.stale_delta", rows=len(rows)) == "drop":
+            # Injected staleness: one delta record is LOST — the row
+            # keeps its previous values on host AND device (the scatter
+            # below ships the un-recomputed row, so mirror and resident
+            # tensor agree with each other and disagree with the
+            # store). The plan applier's exact verification is the
+            # safety net that must catch the resulting bad placement
+            # and force a rebuild (models/resident.py note_rejection).
+            lost = rows[0]
+            refill_rows = [r for r in refill_rows if r != lost]
+            adds = [a for a in adds if row_of[a.node_id] != lost]
+            node_rows = [r for r in node_rows if r != lost]
         new = _ClusterBase.__new__(_ClusterBase)
         new.token = next(_BASE_TOKENS)
         new.allocs_index = new_allocs_index
         new.table_len = len(allocs)
+        new.nodes_index = max(base_nodes_index, new_nodes_index)
         new.delta_parent = (self.token, tuple(rows))
         new.n_real, new.n = self.n_real, self.n
         # Node-level class index is alloc-independent: share it.
@@ -308,6 +375,23 @@ class _ClusterBase:
             new._fill_row(
                 i, nodes[i],
                 state.allocs_by_node_terminal(nodes[i].id, False))
+        if node_rows:
+            # The device delta scatters only the MUTABLE arrays
+            # (util/bw_used/ports_free/node_ok); a node change that
+            # moved capacity, reserved headroom, or link bandwidth
+            # cannot be expressed as a row delta against the parent's
+            # shared immutable arrays — rebuild instead. Readiness and
+            # drain flips (the common transitions) leave these
+            # untouched.
+            nr = np.asarray(node_rows, np.intp)
+            if (not np.array_equal(new.capacity[nr], self.capacity[nr])
+                    or not np.array_equal(new.sched_capacity[nr],
+                                          self.sched_capacity[nr])
+                    or not np.array_equal(new.bw_avail[nr],
+                                          self.bw_avail[nr])):
+                return None
+        get_tracker().count_delta(len(rows) - len(node_rows),
+                                  len(node_rows))
         if adds:
             # Additive rows: one bulk scatter-add of the new allocs'
             # memoized usage — O(new allocs), not O(rows x allocs).
@@ -433,6 +517,87 @@ def ready_nodes_cached(state, datacenters):
             while len(_READY_NODES_CACHE) >= _READY_NODES_MAX:
                 _READY_NODES_CACHE.pop(next(iter(_READY_NODES_CACHE)))
             _READY_NODES_CACHE[key] = out
+    return out
+
+
+# Feasibility memo per (base token, job constraint signature): the
+# [N, G] mask depends only on the node set (pinned by the base token)
+# and the job's constraint/driver STRUCTURE — not its id. A placement
+# storm is N structurally identical jobs with distinct ids (one
+# service scaled out, the bench's e2e-0..e2e-119 shape), so every eval
+# of a drained batch was recomputing an identical mask under the GIL
+# while the batcher's cohort window ticked — the mask memo is to
+# node_feasibility what the base cache is to the [N, 4] build.
+_FEAS_CACHE: Dict[Tuple, Tuple[np.ndarray, Optional[np.ndarray]]] = {}
+_FEAS_MAX = 16
+# Compact overlay + zero job-count memo for jobs with NO live allocs
+# (every job of a placement storm, until its own plan commits): the
+# overlay is then a pure function of (base, constraint signature) and
+# its padded arrays are identical across the batch — per-eval numpy
+# materialization was the residual cohort-window stagger after the
+# mask memo. All cached arrays are read-only by contract (the batcher
+# stacks them; the kernel carries functional copies).
+_OVERLAY_CACHE: Dict[Tuple, Tuple] = {}
+_OVERLAY_MAX = 16
+
+
+def _constraint_sig(cons) -> Tuple:
+    return tuple((c.ltarget, c.operand, c.rtarget) for c in cons)
+
+
+def feasibility_signature(job) -> Tuple:
+    """Hashable signature of everything node_feasibility reads from the
+    job: job/TG/task constraints (order-sensitive, like the checkers)
+    and the TG driver sets. Two jobs with equal signatures get
+    identical masks on the same base."""
+    tg_sigs = []
+    for tg in job.task_groups:
+        tg_sigs.append((
+            _constraint_sig(tg.constraints),
+            tuple(_constraint_sig(t.constraints) for t in tg.tasks),
+            tuple(sorted({t.driver for t in tg.tasks})),
+        ))
+    return (_constraint_sig(job.constraints), tuple(tg_sigs))
+
+
+# Full node UNIVERSE per (snapshot nodes-index, dc set): every node of
+# the dc set regardless of readiness, plus the ready-only per-dc counts
+# (metric parity with the host path) and an identity signature over the
+# ordered node-id tuple. The resident dense path builds its matrix over
+# THIS list — readiness is a node_ok row bit, so up/down/drain flips
+# are delta records against the device-resident base instead of a
+# rebuild of the node axis (models/resident.py). The signature keys the
+# base FAMILY: it changes exactly when the node set (or its row order)
+# changes, which is when a delta chain must break.
+_UNIVERSE_CACHE: Dict[Tuple, Tuple[List[Node], Dict[str, int], int]] = {}
+_UNIVERSE_MAX = 4
+
+
+def universe_nodes_cached(state, datacenters):
+    """(nodes, ready_by_dc, ids_sig) over the full dc node universe;
+    memoized per snapshot nodes-index like ready_nodes_cached."""
+    key = None
+    if hasattr(state, "index") and getattr(state, "store_id", ""):
+        key = (state.store_id, state.index("nodes"),
+               tuple(sorted(datacenters or [])))
+        with _BASE_CACHE_LOCK:
+            hit = _UNIVERSE_CACHE.get(key)
+        if hit is not None:
+            return hit
+    dc_map = {dc: 0 for dc in (datacenters or [])}
+    nodes: List[Node] = []
+    for node in state.nodes():
+        if node.datacenter not in dc_map:
+            continue
+        nodes.append(node)
+        if node.ready():
+            dc_map[node.datacenter] += 1
+    out = (nodes, dc_map, hash(tuple(n.id for n in nodes)))
+    if key is not None:
+        with _BASE_CACHE_LOCK:
+            while len(_UNIVERSE_CACHE) >= _UNIVERSE_MAX:
+                _UNIVERSE_CACHE.pop(next(iter(_UNIVERSE_CACHE)))
+            _UNIVERSE_CACHE[key] = out
     return out
 
 
@@ -585,6 +750,186 @@ def _alloc_usage(alloc: Allocation) -> Tuple[float, float, float, float, float, 
     return usage
 
 
+def resolve_cluster_base(state, datacenters, nodes=None, explicit=False,
+                         proposed_fn=None, cacheable=True):
+    """Resolve the job-independent cluster base for one (snapshot, dc
+    set): exact-key cache hit, family delta-update, or full rebuild —
+    single-flighted, since a drained batch's evals all build matrices
+    CONCURRENTLY against one fresh snapshot (without the pending gate
+    every thread misses at once and builds its own base with its own
+    token, fragmenting the batcher's token-keyed queues AND paying one
+    ~full base upload per thread; observed: 24 uploads of one identical
+    10k-node base through the device tunnel).
+
+    Module-level (job-free) on purpose: the dispatch pipeline prefetches
+    batch k+1's base under batch k's in-flight compute with no job in
+    hand (dispatch/pipeline.py), and ClusterMatrix delegates here for
+    its own build. With `nodes=None` the node list derives from the
+    resident universe (or the ready set when resident state is off).
+
+    Returns (base, kind) with kind in "hit" | "rekey" | "delta" |
+    "full". Family keying is the residency core: with device-resident
+    state enabled the family keys on the node-SET identity instead of
+    the nodes-table index, so node up/down/drain transitions (which
+    bump the index but keep the set) delta against the previous base
+    instead of starting a new family — the delta chain only breaks when
+    nodes register/deregister (the universe signature moves)."""
+    from .resident import get_tracker
+
+    tracker = get_tracker()
+    resident = tracker.is_enabled() and not explicit
+    if nodes is None:
+        if resident:
+            nodes, _by_dc, _sig = universe_nodes_cached(state, datacenters)
+        else:
+            nodes, _by_dc = ready_nodes_cached(state, datacenters)
+    if proposed_fn is None:
+        from ..scheduler.util import proposed_allocs_for_node
+
+        def proposed_fn(node_id, _state=state):
+            return proposed_allocs_for_node(_state, None, node_id)
+
+    key = family = prev = done = None
+    allocs_idx = nodes_idx = -1
+    if (cacheable and hasattr(state, "index")
+            and getattr(state, "store_id", "")):
+        dcs = tuple(sorted(datacenters or []))
+        # Caller-provided node lists (the system path's pinned
+        # subsets) need their identity in the key: two different
+        # subsets of equal size on one snapshot must not collide.
+        # The derived full-ready-set is determined by (nodes index,
+        # dcs), so a constant marker suffices there.
+        nodes_sig = (hash(tuple(n.id for n in nodes)) if explicit else 0)
+        nodes_idx = state.index("nodes")
+        allocs_idx = state.index("allocs")
+        key = (state.store_id, nodes_idx, allocs_idx, dcs,
+               len(nodes), nodes_sig)
+        if resident:
+            _unodes, _by_dc, usig = universe_nodes_cached(
+                state, datacenters)
+            family = (state.store_id, "resident", dcs, usig)
+        else:
+            family = (state.store_id, nodes_idx, dcs,
+                      len(nodes), nodes_sig)
+        if tracker.consume_stale():
+            # A plan-apply rejection marked the resident chain suspect:
+            # whatever matrix the scheduler planned against disagreed
+            # with the store. The rejection doesn't say WHOSE state was
+            # wrong, so purge every cached base (the exact-key entries
+            # included — a rejected plan commits nothing, so the next
+            # build may land on the SAME snapshot index and would
+            # otherwise be served the poisoned entry) and pay one full
+            # rebuild to re-anchor (models/resident.py counts it in
+            # stale_rebuilds).
+            with _BASE_CACHE_LOCK:
+                global _BASE_EPOCH
+                _BASE_EPOCH += 1
+                _BASE_CACHE.clear()
+                _BASE_FAMILY.clear()
+        while True:
+            with _BASE_CACHE_LOCK:
+                cached = _BASE_CACHE.get(key)
+                if cached is not None:
+                    return cached, "hit"
+                pending = _BASE_PENDING.get(key)
+                if pending is None:
+                    done = __import__("threading").Event()
+                    _BASE_PENDING[key] = done
+                    prev = _BASE_FAMILY.get(family)
+                    epoch = _BASE_EPOCH
+                    break
+            pending.wait(60.0)
+    base = None
+    kind = "full"
+    try:
+        while True:
+            if prev is not None and 0 <= prev.allocs_index <= allocs_idx:
+                base = prev.delta_update(
+                    nodes, state, allocs_idx,
+                    new_nodes_index=nodes_idx if resident else -1)
+                if base is prev:
+                    kind = "rekey"
+                elif base is not None:
+                    kind = "delta"
+            if base is None:
+                table_len = (state.alloc_count()
+                             if key is not None
+                             and hasattr(state, "alloc_count") else -1)
+                base = _ClusterBase(
+                    nodes, proposed_fn,
+                    allocs_index=allocs_idx if key is not None else -1,
+                    table_len=table_len,
+                    nodes_index=nodes_idx if (key is not None and resident)
+                    else -1)
+                kind = "full"
+                if key is not None:
+                    tracker.count_full()
+                    if resident and prev is None:
+                        # No family base to delta from: first build, or
+                        # the node SET itself changed (register/
+                        # deregister) — the one transition that must
+                        # re-anchor.
+                        tracker.count_universe()
+            if key is None:
+                return base, kind
+            with _BASE_CACHE_LOCK:
+                # A full build derives from the snapshot alone, so it
+                # is clean regardless of purges; a delta/rekey result
+                # extends a pre-registration parent and is suspect if
+                # a stale-purge landed since — checking the epoch
+                # atomically with the store means an in-flight delta
+                # can never re-seed a purged cache.
+                if kind == "full" or epoch == _BASE_EPOCH:
+                    while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
+                        _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
+                    _BASE_CACHE[key] = base
+                    _BASE_FAMILY[family] = base
+                    while len(_BASE_FAMILY) > _BASE_CACHE_MAX:
+                        _BASE_FAMILY.pop(next(iter(_BASE_FAMILY)))
+                    return base, kind
+                epoch = _BASE_EPOCH
+            prev = None
+            base = None
+    finally:
+        if key is not None:
+            with _BASE_CACHE_LOCK:
+                _BASE_PENDING.pop(key, None)
+            done.set()
+
+
+class _BaseView:
+    """A _ClusterBase under the attribute names the batcher's
+    device-residency entry points expect (ClusterMatrix's surface) —
+    what prefetch_cluster_base hands to PlacementBatcher.prefetch_base."""
+
+    __slots__ = ("base_token", "base_delta", "capacity", "sched_capacity",
+                 "util", "bw_avail", "bw_used", "ports_free", "node_ok",
+                 "class_ids")
+
+    def __init__(self, base: "_ClusterBase"):
+        self.base_token = base.token
+        self.base_delta = base.delta_parent
+        self.capacity = base.capacity
+        self.sched_capacity = base.sched_capacity
+        self.util = base.util
+        self.bw_avail = base.bw_avail
+        self.bw_used = base.bw_used
+        self.ports_free = base.ports_free
+        self.node_ok = base.node_ok
+        self.class_ids = base.class_ids
+
+
+def prefetch_cluster_base(state, datacenters):
+    """Resolve the cacheable cluster base for (snapshot, dc set) and
+    return (view-or-None, kind) — the dispatch pipeline's double-buffer
+    prefetch entry. The base is job-independent, so no job is needed;
+    un-cacheable snapshots (no store identity) return None."""
+    base, kind = resolve_cluster_base(state, datacenters)
+    if base.allocs_index < 0:
+        return None, kind
+    return _BaseView(base), kind
+
+
 class ClusterMatrix:
     """Dense view of the schedulable cluster for one job's placements."""
 
@@ -595,7 +940,17 @@ class ClusterMatrix:
         self.plan = plan
         self._explicit_nodes = nodes is not None
         if nodes is None:
-            nodes, by_dc = ready_nodes_cached(state, job.datacenters)
+            from .resident import get_tracker
+
+            if get_tracker().is_enabled():
+                # Resident universe: ALL dc nodes, readiness as the
+                # node_ok row bit — up/down/drain flips become deltas
+                # against the device-resident base instead of changing
+                # the matrix shape (models/resident.py).
+                nodes, by_dc, _sig = universe_nodes_cached(
+                    state, job.datacenters)
+            else:
+                nodes, by_dc = ready_nodes_cached(state, job.datacenters)
             self.nodes_by_dc = by_dc
         else:
             self.nodes_by_dc = {}
@@ -614,78 +969,29 @@ class ClusterMatrix:
         return proposed_allocs_for_node(self.state, self.plan, node_id)
 
     def _cached_base(self) -> "_ClusterBase":
-        """The job-independent base, cached by (nodes index, allocs
-        index, datacenters): snapshots sharing those see identical
-        clusters. A snapshot that only advanced the allocs table
-        delta-updates the family's previous base (touched rows only)
-        instead of a full O(N x allocs) rebuild. A non-empty plan
-        changes proposed allocs, so it bypasses the cache."""
         cacheable = self.plan is None or self.plan.is_no_op()
-        key = family = prev = None
-        allocs_idx = -1
-        if (cacheable and hasattr(self.state, "index")
-                and getattr(self.state, "store_id", "")):
-            dcs = tuple(sorted(self.job.datacenters or []))
-            # Caller-provided node lists (the system path's pinned
-            # subsets) need their identity in the key: two different
-            # subsets of equal size on one snapshot must not collide.
-            # The derived full-ready-set is determined by (nodes index,
-            # dcs), so a constant marker suffices there.
-            nodes_sig = (hash(tuple(n.id for n in self.nodes))
-                         if self._explicit_nodes else 0)
-            nodes_idx = self.state.index("nodes")
-            allocs_idx = self.state.index("allocs")
-            key = (self.state.store_id, nodes_idx, allocs_idx, dcs,
-                   len(self.nodes), nodes_sig)
-            family = (self.state.store_id, nodes_idx, dcs,
-                      len(self.nodes), nodes_sig)
-            # Single-flight per key: a drained batch's evals all build
-            # their matrices CONCURRENTLY against one fresh snapshot —
-            # without the pending gate every thread misses at once and
-            # builds its own base with its own token, which fragments
-            # the batcher's token-keyed queues AND pays one ~full base
-            # upload per thread (observed: 24 uploads of one identical
-            # 10k-node base through the device tunnel).
-            while True:
-                with _BASE_CACHE_LOCK:
-                    cached = _BASE_CACHE.get(key)
-                    if cached is not None:
-                        return cached
-                    pending = _BASE_PENDING.get(key)
-                    if pending is None:
-                        done = __import__("threading").Event()
-                        _BASE_PENDING[key] = done
-                        prev = _BASE_FAMILY.get(family)
-                        break
-                pending.wait(60.0)
-        base = None
-        try:
-            if prev is not None and 0 <= prev.allocs_index <= allocs_idx:
-                base = prev.delta_update(self.nodes, self.state, allocs_idx)
-            if base is None:
-                table_len = (self.state.alloc_count()
-                             if key is not None
-                             and hasattr(self.state, "alloc_count") else -1)
-                base = _ClusterBase(self.nodes, self._proposed_allocs,
-                                    allocs_index=allocs_idx if key else -1,
-                                    table_len=table_len)
-        finally:
-            if key is not None:
-                with _BASE_CACHE_LOCK:
-                    if base is not None:
-                        while len(_BASE_CACHE) >= _BASE_CACHE_MAX:
-                            _BASE_CACHE.pop(next(iter(_BASE_CACHE)))
-                        _BASE_CACHE[key] = base
-                        _BASE_FAMILY[family] = base
-                        while len(_BASE_FAMILY) > _BASE_CACHE_MAX:
-                            _BASE_FAMILY.pop(next(iter(_BASE_FAMILY)))
-                    _BASE_PENDING.pop(key, None)
-                done.set()
+        base, self.build_kind = resolve_cluster_base(
+            self.state, self.job.datacenters, nodes=self.nodes,
+            explicit=self._explicit_nodes,
+            proposed_fn=self._proposed_allocs, cacheable=cacheable)
+        self.delta_rows = (len(base.delta_parent[1])
+                           if self.build_kind == "delta"
+                           and base.delta_parent else 0)
         return base
 
     def _build(self) -> None:
         n, g = self.n, self.g
         base = self._cached_base()
+        if self.plan is not None and hasattr(self.state, "index"):
+            # Any nodes/allocs change the matrix could have seen has
+            # modify_index <= this watermark; anything later is an
+            # optimistic race the applier must not blame on the
+            # resident chain. max() keeps the strictest watermark when
+            # several builds feed one plan — over-purging is safe,
+            # under-purging is not.
+            wm = max(self.state.index("allocs"), self.state.index("nodes"))
+            if wm > self.plan.matrix_index:
+                self.plan.matrix_index = wm
         # Share the immutable base arrays; the kernel never mutates its
         # inputs (functional scan carries copies).
         self.base_token = base.token
@@ -703,10 +1009,35 @@ class ClusterMatrix:
 
         # Job-specific overlay: this job's per-node alloc counts, from
         # the base's lazy positions index (O(this job's allocs)).
+        positions = base.job_positions(self.job.id)
+        if not positions and base.allocs_index >= 0:
+            # No live allocs (the storm shape): the whole overlay —
+            # zero counts, feasibility, compact form — is a function
+            # of (base, constraint signature); share one memo across
+            # the batch instead of re-materializing ~N-sized arrays
+            # per eval under the GIL.
+            okey = (base.token, feasibility_signature(self.job))
+            with _BASE_CACHE_LOCK:
+                hit = _OVERLAY_CACHE.get(okey)
+            if hit is not None:
+                (self.job_count, self.tg_count, self.feasible,
+                 self.compact_overlay) = hit
+                return
+            self.job_count = np.zeros(n, np.int32)
+            self.tg_count = np.zeros((n, g), np.int32)
+            self.feasible, verdicts = self._build_feasibility(base)
+            self._build_compact_overlay(base, verdicts)
+            with _BASE_CACHE_LOCK:
+                while len(_OVERLAY_CACHE) >= _OVERLAY_MAX:
+                    _OVERLAY_CACHE.pop(next(iter(_OVERLAY_CACHE)))
+                _OVERLAY_CACHE[okey] = (
+                    self.job_count, self.tg_count, self.feasible,
+                    self.compact_overlay)
+            return
         job_count = np.zeros(n, np.int32)
         tg_count = np.zeros((n, g), np.int32)
         gi_by_name = {tg.name: gi for gi, tg in enumerate(self.groups)}
-        for task_group, rows in base.job_positions(self.job.id).items():
+        for task_group, rows in positions.items():
             np.add.at(job_count, rows, 1)
             gi = gi_by_name.get(task_group)
             if gi is not None:
@@ -778,13 +1109,28 @@ class ClusterMatrix:
 
     def _build_feasibility(self, base):
         """([N, G] padded mask, per-class verdicts or None); see
-        node_feasibility."""
+        node_feasibility. Memoized per (base token, job constraint
+        signature): a storm's structurally identical jobs share one
+        mask computation per base instead of one per eval (the memo'd
+        arrays are treated as immutable by every consumer)."""
+        key = None
+        if base.allocs_index >= 0:  # cacheable bases only
+            key = (base.token, feasibility_signature(self.job))
+            with _BASE_CACHE_LOCK:
+                hit = _FEAS_CACHE.get(key)
+            if hit is not None:
+                return hit
         feasible = np.zeros((self.n, self.g), bool)
         real, verdicts = node_feasibility(
             self.state, self.job, self.groups, self.nodes,
             base.class_ids[: self.n_real], base.class_reps,
             return_verdicts=True)
         feasible[: self.n_real] = real
+        if key is not None:
+            with _BASE_CACHE_LOCK:
+                while len(_FEAS_CACHE) >= _FEAS_MAX:
+                    _FEAS_CACHE.pop(next(iter(_FEAS_CACHE)))
+                _FEAS_CACHE[key] = (feasible, verdicts)
         return feasible, verdicts
 
     # ------------------------------------------------------------------

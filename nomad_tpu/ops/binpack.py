@@ -72,6 +72,17 @@ class PlacementConfig(NamedTuple):
     # couple of points; 2.0 reproduces that quality band while
     # decorrelating concurrent evals.
     noise_scale: float = 2.0
+    # Uniform distinct-hosts fast path: when EVERY active ask of an
+    # eval is identical (one task group scaled to count=K, the storm
+    # shape) AND distinct-hosts applies to it, the K sequential
+    # argmax steps collapse to ONE scoring pass + top_k — placing on a
+    # node never changes any OTHER node's score, and distinct-hosts
+    # excludes the chosen node from the remaining asks, so the K-step
+    # scan provably selects the K best-scoring feasible nodes: ~8x
+    # fewer [N]-wide passes per eval. uniform_dh_flag() decides
+    # eligibility host-side; the flag is compile-time like the rest of
+    # the config, so each case is its own cached program.
+    uniform_dh: bool = False
 
 
 class NodeState(NamedTuple):
@@ -171,30 +182,38 @@ def host_prng_key(seed: int) -> "_np.ndarray":
 
 
 @jax.jit
-def apply_base_delta(util, bw_used, ports_free, rows,
-                     util_rows, bw_rows, ports_rows):
+def apply_base_delta(util, bw_used, ports_free, node_ok, rows,
+                     util_rows, bw_rows, ports_rows, ok_rows):
     """Scatter-update the mutable arrays of a device-resident cluster
     base with recomputed node rows. Plan applies touch a handful of
     nodes; shipping those rows (a few hundred bytes) and updating on
     device beats re-uploading the full [N,4] base per snapshot — the
     device-side half of models/matrix.py's incremental delta path.
     Padding duplicates the first changed row (same value, so the
-    duplicate-index scatter is benign); capacity/bandwidth-avail/
-    node_ok never change with allocs and keep the parent's device
-    arrays by reference."""
+    duplicate-index scatter is benign); capacity/bandwidth-avail never
+    change with allocs and keep the parent's device arrays by
+    reference. node_ok rows ride the same scatter: a node-down/drain
+    transition is a delta too (models/resident.py) — the row stays in
+    the matrix, masked, instead of forcing a full rebuild of the node
+    axis."""
     return (
         util.at[rows].set(util_rows),
         bw_used.at[rows].set(bw_rows),
         ports_free.at[rows].set(ports_rows),
+        node_ok.at[rows].set(ok_rows),
     )
 
 
-def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,
-                    job_dh, tg_dh_all, config: PlacementConfig, noise):
+def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, feas_row,
+                    tg_onehot, job_dh, tg_dh, config: PlacementConfig,
+                    noise):
     """One placement's dense pass: feasibility mask + score over all N
-    nodes. tg_onehot is the [G] one-hot of the ask's task group —
-    one-hot contractions instead of dynamic gathers keep the scan body
-    free of scatter/gather ops. Returns masked_score [N]."""
+    nodes. feas_row is the [N] constraint-feasibility column for this
+    ask's task group (gathered ONCE per eval outside the scan — the
+    [N, G] one-hot contraction per step was pure wasted traffic);
+    tg_onehot is the [G] one-hot still used for the carried tg_count
+    contraction, tg_dh the scalar distinct-hosts flag for this ask's
+    group. Returns masked_score [N]."""
     new_util = state.util + ask_res[None, :]
 
     # AllocsFit: full capacity superset on every dimension.
@@ -202,12 +221,11 @@ def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,
     # Bandwidth and dynamic-port count.
     fits &= state.bw_used + ask_bw <= state.bw_avail
     fits &= state.ports_free >= ask_ports
-    # Constraint feasibility for this TG (precomputed per class).
-    fits &= jnp.any(state.feasible & tg_onehot[None, :], axis=1)
-    fits &= state.node_ok
+    # Constraint feasibility for this TG (precomputed per class) and
+    # node readiness, pre-ANDed into feas_row by the caller.
+    fits &= feas_row
     # distinct_hosts: job-level blocks any co-placement of the job;
     # TG-level blocks only same-TG co-placement (feasible.go:211-238).
-    tg_dh = jnp.any(tg_dh_all & tg_onehot)
     tg_cnt = jnp.sum(state.tg_count * tg_onehot[None, :], axis=1)
     fits &= jnp.where(job_dh, state.job_count == 0, True)
     fits &= jnp.where(tg_dh, tg_cnt == 0, True)
@@ -238,12 +256,20 @@ def _score_and_mask(state: NodeState, ask_res, ask_bw, ask_ports, tg_onehot,
 def placement_step(state: NodeState, ask, config: PlacementConfig, noise):
     """Place one ask: pick the argmax-score node and update the carried
     state. Returns (new_state, (choice, score)); choice is -1 when no
-    node fits or the ask row is padding."""
-    ask_res, ask_bw, ask_ports, tg_onehot, active, job_dh, tg_dh_all = ask
+    node fits or the ask row is padding.
+
+    The state update is a single-row scatter (`.at[choice]`, OOB-drop
+    for the no-fit case) instead of the old [N]-wide one-hot
+    multiply-adds: the update side read+wrote every carried array per
+    step, roughly half the scan body's memory traffic for work that
+    touches exactly one row."""
+    (ask_res, ask_bw, ask_ports, feas_row, tg_onehot, active,
+     job_dh, tg_dh) = ask
     n = state.util.shape[0]
 
     score = _score_and_mask(
-        state, ask_res, ask_bw, ask_ports, tg_onehot, job_dh, tg_dh_all, config, noise
+        state, ask_res, ask_bw, ask_ports, feas_row, tg_onehot, job_dh,
+        tg_dh, config, noise
     )
     choice = jnp.argmax(score)
     valid = (score[choice] > NEG_INF / 2) & active
@@ -251,21 +277,77 @@ def placement_step(state: NodeState, ask, config: PlacementConfig, noise):
     # carry the node's actual fitness, not the per-eval PRNG draw.
     clean_score = score[choice] - noise[choice]
 
-    onehot = (jnp.arange(n) == choice) & valid
-    onehot_f = onehot.astype(jnp.float32)
-    onehot_i = onehot.astype(jnp.int32)
-
+    # Row n is out of range: mode="drop" makes the invalid case a no-op.
+    safe = jnp.where(valid, choice, n)
     new_state = state._replace(
-        util=state.util + onehot_f[:, None] * ask_res[None, :],
-        bw_used=state.bw_used + onehot_f * ask_bw,
-        ports_free=state.ports_free - onehot_f * ask_ports,
-        job_count=state.job_count + onehot_i,
-        tg_count=state.tg_count
-        + onehot_i[:, None] * tg_onehot[None, :].astype(jnp.int32),
+        util=state.util.at[safe].add(ask_res, mode="drop"),
+        bw_used=state.bw_used.at[safe].add(ask_bw, mode="drop"),
+        ports_free=state.ports_free.at[safe].add(-ask_ports, mode="drop"),
+        job_count=state.job_count.at[safe].add(1, mode="drop"),
+        tg_count=state.tg_count.at[safe].add(
+            tg_onehot.astype(jnp.int32), mode="drop"),
     )
     out_choice = jnp.where(valid, choice, -1).astype(jnp.int32)
     out_score = jnp.where(valid, clean_score, 0.0)
     return new_state, (out_choice, out_score)
+
+
+def _uniform_topk_program(state: NodeState, asks: Asks, key,
+                          config: PlacementConfig):
+    """The uniform distinct-hosts placement: ONE scoring pass + top_k
+    instead of K sequential argmax steps (see PlacementConfig.
+    uniform_dh for the equivalence argument). The caller guarantees
+    every active ask row is identical (uniform_dh_flag); ask row 0 is
+    the representative (active rows are a prefix, padding rows are
+    masked by `active` exactly like the sequential path)."""
+    n = state.util.shape[0]
+    g = state.feasible.shape[1]
+    k_count = asks.resources.shape[0]
+    ask_res = asks.resources[0]
+    ask_bw = asks.bw[0]
+    ask_ports = asks.ports[0]
+    tg_onehot = jnp.arange(g) == asks.tg_index[0]
+    feas_row = jnp.any(state.feasible & tg_onehot[None, :],
+                       axis=1) & state.node_ok
+    tg_dh = jnp.any(asks.tg_distinct_hosts & tg_onehot)
+    noise = jax.random.uniform(key, (n,), minval=0.0,
+                               maxval=config.noise_scale)
+    score = _score_and_mask(
+        state, ask_res, ask_bw, ask_ports, feas_row, tg_onehot,
+        asks.job_distinct_hosts, tg_dh, config, noise)
+    # top_k requires k <= n, and the ask bucket (k_count) can pad past
+    # the node bucket (n) when count > cluster size. Surplus asks can
+    # never place under distinct-hosts anyway, so clamp and pad them
+    # back as unplaceable — the same choice=-1 the sequential scan
+    # yields once every node carries the job.
+    k_eff = min(k_count, n)
+    top_scores, top_idx = jax.lax.top_k(score, k_eff)
+    if k_eff < k_count:
+        pad = k_count - k_eff
+        top_scores = jnp.concatenate(
+            [top_scores, jnp.full((pad,), NEG_INF, top_scores.dtype)])
+        top_idx = jnp.concatenate(
+            [top_idx, jnp.zeros((pad,), top_idx.dtype)])
+    valid = (top_scores > NEG_INF / 2) & asks.active
+    choices = jnp.where(valid, top_idx, -1).astype(jnp.int32)
+    scores_out = jnp.where(valid, top_scores - noise[top_idx], 0.0)
+    # Each chosen node receives exactly one ask (distinct by top_k);
+    # invalid rows scatter to row n and drop.
+    safe = jnp.where(valid, top_idx, n)
+    vi = valid.astype(jnp.int32)
+    new_state = state._replace(
+        util=state.util.at[safe].add(
+            jnp.where(valid[:, None], ask_res[None, :], 0.0), mode="drop"),
+        bw_used=state.bw_used.at[safe].add(
+            jnp.where(valid, ask_bw, 0.0), mode="drop"),
+        ports_free=state.ports_free.at[safe].add(
+            jnp.where(valid, -ask_ports, 0.0), mode="drop"),
+        job_count=state.job_count.at[safe].add(vi, mode="drop"),
+        tg_count=state.tg_count.at[safe].add(
+            vi[:, None] * tg_onehot[None, :].astype(jnp.int32),
+            mode="drop"),
+    )
+    return choices, scores_out, new_state
 
 
 def placement_program(
@@ -273,6 +355,8 @@ def placement_program(
 ):
     """Run K sequential placements over the cluster as one compiled
     program. Returns (choices [K] int32, scores [K] f32, final_state)."""
+    if config.uniform_dh:
+        return _uniform_topk_program(state, asks, key, config)
 
     k_count = asks.resources.shape[0]
     n = state.util.shape[0]
@@ -284,13 +368,20 @@ def placement_program(
     tg_onehots = (
         jnp.arange(g)[None, :] == asks.tg_index[:, None]
     )  # [K, G]
+    # Per-ask feasibility rows, gathered ONCE: the constraint mask is
+    # static through the eval (only capacity/counters are carried), so
+    # the per-step [N, G] contraction was pure overhead.
+    feas_rows = (jnp.take(state.feasible, asks.tg_index, axis=1).T
+                 & state.node_ok[None, :])  # [K, N]
+    tg_dhs = jnp.take(asks.tg_distinct_hosts, asks.tg_index)  # [K]
 
     def body(carry, xs):
-        ask_res, ask_bw, ask_ports, tg_onehot, active, noise_row = xs
+        (ask_res, ask_bw, ask_ports, feas_row, tg_onehot, tg_dh, active,
+         noise_row) = xs
         new_state, out = placement_step(
             carry,
-            (ask_res, ask_bw, ask_ports, tg_onehot, active,
-             asks.job_distinct_hosts, asks.tg_distinct_hosts),
+            (ask_res, ask_bw, ask_ports, feas_row, tg_onehot, active,
+             asks.job_distinct_hosts, tg_dh),
             config,
             noise_row,
         )
@@ -299,7 +390,8 @@ def placement_program(
     final_state, (choices, scores) = jax.lax.scan(
         body,
         state,
-        (asks.resources, asks.bw, asks.ports, tg_onehots, asks.active, noise),
+        (asks.resources, asks.bw, asks.ports, feas_rows, tg_onehots,
+         tg_dhs, asks.active, noise),
     )
     return choices, scores, final_state
 
@@ -495,7 +587,7 @@ def batched_placement_program_compact(
 @functools.partial(jax.jit, static_argnames=("config",))
 def batched_placement_program_compact_delta(
     capacity, sched_capacity, util, bw_avail, bw_used, ports_free,
-    node_ok, class_ids, rows, util_rows, bw_rows, ports_rows,
+    node_ok, class_ids, rows, util_rows, bw_rows, ports_rows, ok_rows,
     overlays: CompactOverlay, asks: Asks, keys,
     config: PlacementConfig
 ):
@@ -504,16 +596,17 @@ def batched_placement_program_compact_delta(
     changed rows ride this very call's arguments — deriving the child
     base costs zero extra round-trips, decisive through a remote-device
     tunnel where every RPC is ~100ms. Returns the batch results plus
-    the updated (util, bw_used, ports_free) for the batcher to cache
-    under the child's token. Padding rows duplicate a real row (same
-    value, so the duplicate-index scatter is benign)."""
+    the updated (util, bw_used, ports_free, node_ok) for the batcher to
+    cache under the child's token. Padding rows duplicate a real row
+    (same value, so the duplicate-index scatter is benign)."""
     util2 = util.at[rows].set(util_rows)
     bw2 = bw_used.at[rows].set(bw_rows)
     ports2 = ports_free.at[rows].set(ports_rows)
+    ok2 = node_ok.at[rows].set(ok_rows)
     choices, scores, final = _compact_batch(
         capacity, sched_capacity, util2, bw_avail, bw2, ports2,
-        node_ok, class_ids, overlays, asks, keys, config)
-    return choices, scores, util2, bw2, ports2
+        ok2, class_ids, overlays, asks, keys, config)
+    return choices, scores, util2, bw2, ports2, ok2
 
 
 @jax.jit
@@ -523,3 +616,60 @@ def device_resident(*arrays):
     array while jitted-call arguments all ride the call itself — this
     is the cheap way to upload a cluster base."""
     return arrays
+
+
+def uniform_dh_flag(placements, job_dh, tg_dh) -> bool:
+    """Host-side eligibility check for PlacementConfig.uniform_dh:
+    True when every placement asks for the SAME task group (identical
+    resources by construction — asks are per-TG) and distinct-hosts
+    applies to it (job-level, or TG-level for that group). The flag is
+    static, so mixed batches never share a program with uniform ones
+    (it joins the batcher's shape key via the config)."""
+    if not placements:
+        return False
+    gi = placements[0]
+    if any(p != gi for p in placements):
+        return False
+    return bool(job_dh) or bool(_np.asarray(tg_dh).reshape(-1)[gi])
+
+
+# ------------------------------------------------------- jit accounting
+#
+# Every jitted entry point of the placement path, so the compile-cache
+# size (programs compiled this process) is one number: steady state is
+# FLAT — a growing count under load is a recompile storm (a shape
+# bucket leak, an unhashable static arg, a drifting ladder) silently
+# eating multi-second trace+compile stalls. Exposed via
+# server.stats()["device_state"], /v1/metrics, and bench.py's
+# jit_recompiles column (whose --check gate refuses dense numbers when
+# it moves after warmup).
+
+_JIT_ENTRY_POINTS = ()
+
+
+def _jit_entry_points():
+    global _JIT_ENTRY_POINTS
+    if not _JIT_ENTRY_POINTS:
+        _JIT_ENTRY_POINTS = (
+            placement_program_jit,
+            batched_placement_program,
+            batched_placement_program_shared,
+            batched_placement_program_overlay,
+            batched_placement_program_compact,
+            batched_placement_program_compact_delta,
+            apply_base_delta,
+            device_resident,
+        )
+    return _JIT_ENTRY_POINTS
+
+
+def jit_cache_size() -> int:
+    """Total compiled-program count across the placement entry points
+    (jax's per-function in-process jit cache)."""
+    total = 0
+    for fn in _jit_entry_points():
+        try:
+            total += fn._cache_size()
+        except Exception:  # noqa: BLE001 - accounting must never raise
+            pass
+    return total

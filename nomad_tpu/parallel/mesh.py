@@ -95,6 +95,18 @@ def base_specs() -> Tuple:
             s.bw_used, s.ports_free, s.node_ok, P(NODE_AXIS))
 
 
+def delta_row_specs() -> Tuple:
+    """PartitionSpecs for the resident-base delta payload, IN
+    apply_base_delta's argument order after the four target arrays:
+    (rows, util_rows, bw_rows, ports_rows, ok_rows). Replicated on
+    purpose: a delta touches a handful of rows whose home shard the
+    scatter resolves on device — pre-splitting each row to its shard
+    would cost more host work than the few-hundred-byte payload it
+    ships. Lives here (with base_specs) so a sharded resident base and
+    its update path can't drift apart."""
+    return (P(), P(None, None), P(), P(), P())
+
+
 def _asks_specs(batched: bool) -> Asks:
     b = (DP_AXIS,) if batched else ()
     return Asks(

@@ -74,6 +74,15 @@ MAX_INFLIGHT = 3
 COHORT_WAIT_MAX = 1.0
 
 
+# ntalint residency manifest (analysis/residency.py): the ONE function
+# allowed to ship a full cluster base host->device. Everything else on
+# the dispatch/scheduler steady state must ride the delta/cached paths
+# — a full-matrix device_put creeping back into a hot path is exactly
+# the per-batch re-ship the device-resident design removed, and it
+# regresses silently (the code still works, just 10-100x the bytes).
+NTA_REBUILD_ENTRYPOINTS = ("PlacementBatcher._build_device_base",)
+
+
 class _Request:
     __slots__ = ("token", "base", "overlay", "compact", "asks", "key",
                  "delta", "event", "choices", "scores", "error")
@@ -296,7 +305,7 @@ class PlacementBatcher:
                     # True LRU: a hit refreshes recency, so alternating
                     # hot snapshots don't thrash the eviction order.
                     self._device_bases.move_to_end(token)
-                    return cached
+                    return cached, 0
                 pending = self._base_pending.get(token)
                 if pending is None:
                     # We are the builder.
@@ -307,12 +316,40 @@ class PlacementBatcher:
             # cache insert instead of paying a duplicate transfer.
             pending.wait(30.0)
         try:
-            dev = self._build_device_base(token, base, delta)
+            dev, nbytes = self._build_device_base(token, base, delta)
         finally:
             with self._lock:
                 self._base_pending.pop(token, None)
             done.set()
-        return dev
+        return dev, nbytes
+
+    def prefetch_base(self, state) -> int:
+        """Double-buffering entry point (dispatch/pipeline.py): make
+        `state`'s cluster base device-resident NOW, on the caller's
+        (stage) thread — batch k+1's base upload/delta derivation runs
+        under batch k's in-flight device compute instead of serializing
+        in front of its own dispatch. `state` is a ClusterMatrix (or
+        anything place() accepts); un-tokened states have nothing
+        cacheable and return 0. Returns the bytes that crossed
+        host->device (0 on a cache hit)."""
+        token = getattr(state, "base_token", None)
+        if token is None:
+            return 0
+        with self._lock:
+            if token in self._device_bases:
+                return 0
+        class_ids = getattr(state, "class_ids", None)
+        if class_ids is None:
+            class_ids = np.full(np.shape(state.node_ok), -1, np.int32)
+        base = (state.capacity, state.sched_capacity, state.util,
+                state.bw_avail, state.bw_used, state.ports_free,
+                state.node_ok, class_ids)
+        # Bytes come back from THIS call's build (0 on a lost
+        # build race): a global counter-diff here would attribute
+        # concurrent uploads of other tokens to this prefetch.
+        _dev, nbytes = self._device_base(
+            token, base, getattr(state, "base_delta", None))
+        return int(nbytes)
 
     def _base_mesh(self, n: int):
         """nodes-axis mesh for big clusters on multi-device backends
@@ -359,18 +396,36 @@ class PlacementBatcher:
                 from ..ops.binpack import apply_base_delta
 
                 rows_p = _pad_rows(rows)
-                nbytes = rows_p.nbytes + len(rows_p) * (4 * 4 + 4 + 4)
-                util2, bw2, ports2 = apply_base_delta(
-                    parent[2], parent[4], parent[5], rows_p,
-                    np.asarray(base[2])[rows_p],
-                    np.asarray(base[4])[rows_p],
-                    np.asarray(base[5])[rows_p],
-                )
-                # capacity/sched_capacity/bw_avail/node_ok/class_ids
-                # never change with allocs: share the parent's device
-                # arrays.
+                nbytes = rows_p.nbytes + len(rows_p) * (4 * 4 + 4 + 4 + 1)
+                payload = (rows_p,
+                           np.asarray(base[2])[rows_p],
+                           np.asarray(base[4])[rows_p],
+                           np.asarray(base[5])[rows_p],
+                           np.asarray(base[6])[rows_p])
+                psh = getattr(parent[2], "sharding", None)
+                if (psh is not None and getattr(psh, "mesh", None)
+                        is not None and len(psh.device_set) > 1):
+                    # Sharded resident parent: place the (replicated)
+                    # delta payload on the SAME mesh up front so the
+                    # scatter keeps the node axis sharded instead of
+                    # gathering it to one device (parallel/mesh.py
+                    # pins the payload specs next to base_specs).
+                    from jax.sharding import NamedSharding
+
+                    from ..parallel.mesh import delta_row_specs
+
+                    payload = jax.device_put(
+                        payload,
+                        tuple(NamedSharding(psh.mesh, s)
+                              for s in delta_row_specs()))
+                util2, bw2, ports2, ok2 = apply_base_delta(
+                    parent[2], parent[4], parent[5], parent[6], *payload)
+                # capacity/sched_capacity/bw_avail/class_ids never
+                # change with allocs: share the parent's device arrays.
+                # node_ok rides the scatter (node-down deltas mask rows
+                # in place, models/resident.py).
                 dev = (parent[0], parent[1], util2, parent[3],
-                       bw2, ports2, parent[6], parent[7])
+                       bw2, ports2, ok2, parent[7])
         delta_derived = dev is not None
         # Delta children of a sharded parent are themselves sharded.
         sharded = delta_derived and len(dev[0].sharding.device_set) > 1
@@ -417,7 +472,7 @@ class PlacementBatcher:
             while len(self._device_bases) >= DEVICE_BASE_CACHE:
                 self._device_bases.popitem(last=False)
             self._device_bases[token] = dev
-        return dev
+        return dev, nbytes
 
     def _claim_fused_delta(self, token, delta):
         """Claim the right to derive `token`'s base INSIDE the compact
@@ -536,18 +591,20 @@ class PlacementBatcher:
                         util_rows = np.asarray(hb[2])[rows_p]
                         bw_rows = np.asarray(hb[4])[rows_p]
                         ports_rows = np.asarray(hb[5])[rows_p]
+                        ok_rows = np.asarray(hb[6])[rows_p]
                         payload += (rows_p.nbytes + util_rows.nbytes
-                                    + bw_rows.nbytes + ports_rows.nbytes)
+                                    + bw_rows.nbytes + ports_rows.nbytes
+                                    + ok_rows.nbytes)
                         t1 = _time.perf_counter()
-                        (choices, scores, util2, bw2, ports2) = \
+                        (choices, scores, util2, bw2, ports2, ok2) = \
                             batched_placement_program_compact_delta(
                                 parent[0], parent[1], parent[2],
                                 parent[3], parent[4], parent[5],
                                 parent[6], parent[7], rows_p, util_rows,
-                                bw_rows, ports_rows, overlays, asks,
-                                keys, config)
+                                bw_rows, ports_rows, ok_rows, overlays,
+                                asks, keys, config)
                         dev = (parent[0], parent[1], util2, parent[3],
-                               bw2, ports2, parent[6], parent[7])
+                               bw2, ports2, ok2, parent[7])
                         with self._lock:
                             self.base_delta_updates += 1
                             while len(self._device_bases) >= DEVICE_BASE_CACHE:
@@ -558,7 +615,7 @@ class PlacementBatcher:
                             self._base_pending.pop(token, None)
                         done.set()
                 else:
-                    dev = self._device_base(
+                    dev, _ = self._device_base(
                         token, batch[0].base, batch[0].delta)
                     t1 = _time.perf_counter()
                     choices, scores, _ = batched_placement_program_compact(
@@ -566,7 +623,7 @@ class PlacementBatcher:
                         dev[6], dev[7], overlays, asks, keys, config)
                 compact_dispatch = True
             else:
-                dev = self._device_base(
+                dev, _ = self._device_base(
                     token, batch[0].base, batch[0].delta)
                 state = NodeState(
                     capacity=dev[0], sched_capacity=dev[1], util=dev[2],
@@ -755,6 +812,11 @@ class PlacementBatcher:
                 self._spawn_dispatcher(shape_key, config)
 
     def stats(self) -> dict:
+        from ..ops.binpack import jit_cache_size
+
+        # Read OUTSIDE the lock: jax's cache introspection is not ours
+        # to serialize, and it never tears (a single int).
+        jit_programs = jit_cache_size()
         with self._lock:
             # Under the lock: a reader racing a dispatcher's update
             # would otherwise tear the breakdown (e.g. dispatches
@@ -778,6 +840,11 @@ class PlacementBatcher:
                 "upload_us": int(self.t_upload * 1e6),
                 "payload_bytes": int(self.bytes_overlay),
                 "upload_bytes": int(self.bytes_upload),
+                # Compiled XLA programs this process holds (all the
+                # placement entry points): steady state is FLAT — a
+                # climb under load is a recompile storm (bench.py's
+                # jit_recompiles column gates on it).
+                "jit_cache_size": jit_programs,
             }
 
 

@@ -97,7 +97,12 @@ class BatchedTPUScheduler(GenericScheduler):
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
-        from ..ops.binpack import PlacementConfig, host_prng_key, make_asks
+        from ..ops.binpack import (
+            PlacementConfig,
+            host_prng_key,
+            make_asks,
+            uniform_dh_flag,
+        )
         from .batcher import get_batcher
         from .stack import (
             BATCH_JOB_ANTI_AFFINITY_PENALTY,
@@ -167,13 +172,26 @@ class BatchedTPUScheduler(GenericScheduler):
 
         _t0 = time.monotonic()
         matrix = ClusterMatrix(self.state, self.job, self.plan)
+        _t_base = time.monotonic()
         tg_indices = {tg.name: i for i, tg in enumerate(self.job.task_groups)}
         placements = [tg_indices[m.task_group.name] for m in bulk]
 
-        asks = make_asks(*matrix.build_asks(placements))
+        ask_arrays = matrix.build_asks(placements)
+        asks = make_asks(*ask_arrays)
         trace.record_span(self.eval.id, trace.STAGE_MATRIX_BUILD, _t0,
                           ann={"placements": len(bulk)},
                           trace_id=self.eval.trace_id)
+        # Attribution for the device-resident path: how this eval's
+        # base came to be (cache hit / incremental delta / full
+        # rebuild) and how many node rows the delta touched — the
+        # resident design's win IS this span staying "hit"/"delta"
+        # with small row counts under steady load (models/resident.py).
+        kind = getattr(matrix, "build_kind", None)
+        if kind is not None:
+            trace.record_span(
+                self.eval.id, trace.STAGE_MATRIX_UPDATE, _t0, _t_base,
+                ann={"kind": kind, "rows": matrix.delta_rows},
+                trace_id=self.eval.trace_id)
         penalty = (
             BATCH_JOB_ANTI_AFFINITY_PENALTY
             if self.batch
@@ -188,6 +206,13 @@ class BatchedTPUScheduler(GenericScheduler):
         config = PlacementConfig(
             anti_affinity_penalty=penalty,
             pre_resolve=bool(getattr(self.planner, "pre_resolve", False)),
+            # Uniform distinct-hosts fast path: one TG scaled to count=K
+            # under distinct-hosts (the storm shape) collapses the
+            # K-step scan to one scoring pass + top_k (ops/binpack.py
+            # _uniform_topk_program). Static, so mixed batches never
+            # share a program with uniform ones.
+            uniform_dh=uniform_dh_flag(
+                placements, ask_arrays[5], ask_arrays[6]),
         )
         # Host-side key: a device PRNGKey here would cost a tunnel
         # round-trip per eval and force the batcher to pull keys back

@@ -79,6 +79,17 @@ class ServerConfig:
     # dispatch round-trip. False = independent (vmapped) evals.
     dense_pre_resolve: bool = True
 
+    # ---- Device-resident node state (models/resident.py) ----
+    # The dense path's [N, R] node matrix lives on device; plan commits
+    # and node up/down/drain transitions apply as small scatter deltas
+    # keyed on raft index instead of re-shipping the full matrix per
+    # batch. False reverts to per-snapshot rebuild + re-upload (the
+    # bench A/B arm).
+    device_resident: bool = True
+    # Max delta-refilled rows before a full rebuild is the better deal;
+    # 0 = auto (max(64, N/4)).
+    resident_rebuild_rows: int = 0
+
     # ---- Overload protection (nomad_tpu/admission) ----
     # Bounded broker ready queues: default per-scheduler-type depth cap
     # (0 = unbounded) plus per-type overrides. A full queue sheds the

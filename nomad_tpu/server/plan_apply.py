@@ -269,6 +269,39 @@ class PlanApplier:
             self.logger.exception("plan commit failed")
             return False
 
+    def _note_stale_state(self) -> None:
+        """A node verification failed in a way ordinary optimistic
+        concurrency cannot explain: the matrix claimed a fit that its
+        OWN snapshot refutes. Mark the resident delta chain suspect so
+        the next cacheable matrix build pays one full rebuild instead
+        of trusting it (models/resident.py; the carve-over of the
+        reference's plan_apply.go:318 exactness)."""
+        from ..models.resident import note_rejection
+
+        note_rejection()
+
+    @staticmethod
+    def _ordinary_conflict(snapshot, plan: Plan, node_id: str) -> bool:
+        """Whether this node's rejection is explained by state the
+        scheduler's matrix could not have seen: an in-flight pipelined
+        plan's accepted allocs, or node/alloc changes committed after
+        the plan's matrix watermark. True means a routine optimistic-
+        concurrency loss (the replan refreshes past it) — purging the
+        whole device-resident base cache for it would degenerate a
+        conflict-heavy storm back into rebuild-per-snapshot. False (or
+        no watermark) means the resident chain itself is suspect."""
+        if plan.matrix_index < 0:
+            return False
+        extra = getattr(snapshot, "_extra_by_node", None)
+        if extra and extra.get(node_id):
+            return True
+        base = getattr(snapshot, "base", snapshot)
+        node = base.node_by_id(node_id)
+        if node is not None and node.modify_index > plan.matrix_index:
+            return True
+        return any(a.modify_index > plan.matrix_index
+                   for a in base.allocs_by_node(node_id))
+
     def _evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
         """Per-node verification with partial commit
         (plan_apply.go:194 evaluatePlan)."""
@@ -285,12 +318,15 @@ class PlanApplier:
         }
         self.plans_evaluated += 1
         rejected = 0
+        suspect = False
         for node_id, fut in futures.items():
             if fut.result():
                 continue
             # This node's changes don't fit anymore.
             rejected += 1
             metrics.incr_counter(("plan", "node_rejected"))
+            if not self._ordinary_conflict(snapshot, plan, node_id):
+                suspect = True
             if plan.all_at_once:
                 # Gang commit: reject everything, force a refresh.
                 result.node_update = {}
@@ -298,6 +334,8 @@ class PlanApplier:
                 result.refresh_index = snapshot.latest_index()
                 self.plans_rejected += 1
                 self.nodes_rejected += rejected
+                if suspect:
+                    self._note_stale_state()
                 trace.record_span(
                     plan.eval_id, trace.STAGE_PLAN_EVALUATE, _t0,
                     ann={"nodes_rejected": rejected, "gang": True},
@@ -309,6 +347,8 @@ class PlanApplier:
         if rejected:
             self.plans_rejected += 1
             self.nodes_rejected += rejected
+            if suspect:
+                self._note_stale_state()
         # create=False: the applier serves remote (follower-worker)
         # plans too — their lifecycle trace lives in the follower's
         # process, not this one.

@@ -32,6 +32,7 @@ from .. import trace
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import FAILED_QUEUE, EvalBroker
+from ..models.resident import device_state_stats as _device_state_stats
 from .config import ServerConfig
 from .core_gc import CoreScheduler
 from .fsm import FSM, DevLog
@@ -113,6 +114,15 @@ class Server:
             slow_batches=self.config.breaker_slow_batches,
             cooldown=self.config.breaker_cooldown,
             enabled=self.config.breaker_enabled,
+        )
+        # Device-resident node state (models/resident.py): process-
+        # global like the breaker and the batcher's device cache it
+        # fronts; configure() updates policy without dropping counters.
+        from ..models.resident import configure as configure_resident
+
+        configure_resident(
+            enabled=self.config.device_resident,
+            rebuild_rows=self.config.resident_rebuild_rows,
         )
         self._leader = False
         self._shutdown = False
@@ -227,6 +237,28 @@ class Server:
                     # their own HTTP intake); snapshot() refreshes the
                     # cached level and emits the gauge itself.
                     self.admission.pressure.snapshot()
+                    # Device-resident state is process-global (the
+                    # batcher's device cache serves every server in
+                    # this process): recompile storms (jit_cache_size
+                    # climbing under steady load) and staleness
+                    # rebuilds must be visible on a live agent, not
+                    # just in bench.
+                    ds = _device_state_stats()
+                    metrics.set_gauge(
+                        ("device_state", "jit_cache_size"),
+                        ds["jit_cache_size"])
+                    metrics.set_gauge(
+                        ("device_state", "full_rebuilds"),
+                        ds["full_rebuilds"])
+                    metrics.set_gauge(
+                        ("device_state", "stale_rebuilds"),
+                        ds["stale_rebuilds"])
+                    metrics.set_gauge(
+                        ("device_state", "delta_updates"),
+                        ds["delta_updates"])
+                    metrics.set_gauge(
+                        ("device_state", "upload_bytes"),
+                        ds["upload_bytes"])
                     if not self._leader:
                         # Broker/plan-queue/heartbeats are leader-only
                         # (eval_broker.go:650 runs in the leader loop);
@@ -1248,6 +1280,12 @@ class Server:
             # count/mean/max + log-bucket p50/p95/p99 per stage, plus
             # the e2e row — the north-star p99, attributed.
             "trace": trace.get_recorder().stage_stats(),
+            # Device-resident node state (models/resident.py): delta/
+            # rebuild counters + the jit compile-cache size — a
+            # CLIMBING cache under steady load is a recompile storm,
+            # and stale_rebuilds says how often plan-apply verification
+            # had to re-anchor the delta chain.
+            "device_state": _device_state_stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

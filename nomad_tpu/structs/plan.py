@@ -28,6 +28,14 @@ class Plan:
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     annotations: Optional["PlanAnnotations"] = None
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    # Raft watermark of the snapshot the dense node matrix serving this
+    # plan was built from (-1 = unknown/host path). On rejection the
+    # plan applier reads it to tell an ordinary optimistic-concurrency
+    # loss (the node moved PAST this index before verification) from
+    # resident-matrix staleness (it didn't — the matrix claimed a fit
+    # its own snapshot refutes), which decides whether the device-
+    # resident delta chain must be purged (models/resident.py).
+    matrix_index: int = -1
 
     def append_update(
         self, alloc: Allocation, desired_status: str, description: str

@@ -21,9 +21,11 @@ from .span import (  # noqa: F401
     STAGE_ALLOC_UPSERT,
     STAGE_BROKER_WAIT,
     STAGE_DEVICE_DISPATCH,
+    STAGE_DEVICE_TRANSFER,
     STAGE_DISPATCH_ACCUMULATE,
     STAGE_DISPATCH_LAUNCH,
     STAGE_MATRIX_BUILD,
+    STAGE_MATRIX_UPDATE,
     STAGE_PLAN_COMMIT,
     STAGE_PLAN_EVALUATE,
     STAGE_PLAN_SUBMIT,

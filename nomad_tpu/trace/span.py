@@ -26,6 +26,8 @@ STAGE_DISPATCH_ACCUMULATE = "dispatch.accumulate"  # pipeline admit -> batch cut
 STAGE_DISPATCH_LAUNCH = "dispatch.launch"  # launch prologue (catch-up + snapshot)
 STAGE_SCHED_PROCESS = "scheduler.process"  # scheduler invoke, end to end
 STAGE_MATRIX_BUILD = "matrix.build"        # ClusterMatrix + ask construction
+STAGE_MATRIX_UPDATE = "matrix.update"      # incremental delta vs full rebuild
+STAGE_DEVICE_TRANSFER = "device.transfer"  # base prefetch host->device
 STAGE_DEVICE_DISPATCH = "device.dispatch"  # batcher.place round-trip
 STAGE_PLAN_SUBMIT = "plan.submit"          # plan queue wait + commit (worker view)
 STAGE_PLAN_EVALUATE = "plan.evaluate"      # applier per-node verification
@@ -38,6 +40,8 @@ ALL_STAGES = (
     STAGE_DISPATCH_LAUNCH,
     STAGE_SCHED_PROCESS,
     STAGE_MATRIX_BUILD,
+    STAGE_MATRIX_UPDATE,
+    STAGE_DEVICE_TRANSFER,
     STAGE_DEVICE_DISPATCH,
     STAGE_PLAN_SUBMIT,
     STAGE_PLAN_EVALUATE,

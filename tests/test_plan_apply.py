@@ -370,3 +370,54 @@ def test_gang_commit_all_at_once_rejects_whole_plan():
     (result,) = run_applier(fsm, log, [plan])
     assert result.node_allocation == {} and result.node_update == {}
     assert result.refresh_index > 0
+
+
+def test_rejection_past_matrix_watermark_is_ordinary_conflict():
+    """A rejection explained by allocs that landed AFTER the plan's
+    matrix watermark is an ordinary optimistic-concurrency loss: the
+    device-resident chain must NOT be marked stale for it (a
+    conflict-heavy storm would otherwise purge the base cache per
+    rejection and degenerate into rebuild-per-snapshot)."""
+    from nomad_tpu.models.resident import get_tracker
+
+    fsm, log, nodes = build_world(n_nodes=1, cpu=500)
+    get_tracker().consume_stale()  # clear any leftover flag
+    wm = fsm.state.latest_index()
+    (first,) = run_applier(fsm, log, [make_plan(nodes[0], 300)])
+    assert not first.is_no_op()
+    loser = make_plan(nodes[0], 300)
+    loser.matrix_index = wm  # planned before the winner committed
+    (result,) = run_applier(fsm, log, [loser])
+    assert nodes[0].id not in result.node_allocation
+    assert not get_tracker().consume_stale()
+
+
+def test_rejection_at_own_watermark_marks_resident_chain_stale():
+    """A rejection with NO node/alloc change past the watermark means
+    the matrix claimed a fit its own snapshot refutes — only resident
+    staleness explains that, so the safety net must fire."""
+    from nomad_tpu.models.resident import get_tracker
+
+    fsm, log, nodes = build_world(n_nodes=1, cpu=500)
+    (first,) = run_applier(fsm, log, [make_plan(nodes[0], 300)])
+    assert not first.is_no_op()
+    get_tracker().consume_stale()
+    doomed = make_plan(nodes[0], 300)
+    doomed.matrix_index = fsm.state.latest_index()  # saw everything
+    (result,) = run_applier(fsm, log, [doomed])
+    assert nodes[0].id not in result.node_allocation
+    assert get_tracker().consume_stale()
+
+
+def test_rejection_without_watermark_stays_conservative():
+    """Plans minted off the host path carry no watermark: a rejection
+    keeps marking the chain suspect (the safe pre-watermark default)."""
+    from nomad_tpu.models.resident import get_tracker
+
+    fsm, log, nodes = build_world(n_nodes=1, cpu=500)
+    (first,) = run_applier(fsm, log, [make_plan(nodes[0], 300)])
+    assert not first.is_no_op()
+    get_tracker().consume_stale()
+    (result,) = run_applier(fsm, log, [make_plan(nodes[0], 300)])
+    assert nodes[0].id not in result.node_allocation
+    assert get_tracker().consume_stale()

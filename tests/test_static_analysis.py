@@ -485,13 +485,85 @@ def test_apply_baseline_count_is_a_ceiling(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# device residency: full-matrix-reship
+
+
+RESHIP_BAD = """\
+import jax
+import numpy as np
+
+class Batcher:
+    def place(self, state):
+        # Full matrix re-shipped per batch: the regression the
+        # resident design removed.
+        dev = jax.device_put(np.zeros((1024, 4)))
+        return dev
+
+def upload(base):
+    return device_resident(*base)
+"""
+
+RESHIP_GOOD = """\
+import jax
+import numpy as np
+
+NTA_REBUILD_ENTRYPOINTS = ("Batcher._build_device_base",)
+
+class Batcher:
+    def _build_device_base(self, token, base, delta):
+        # The ONE sanctioned full-upload path (first touch + the
+        # staleness-rebuild safety net).
+        return jax.device_put(base)
+
+    def place(self, state):
+        return self._build_device_base(state, None, None)
+"""
+
+
+def test_reship_flags_transfers_outside_manifest(tmp_path):
+    findings = run_on(tmp_path, RESHIP_BAD, subdir="dispatch")
+    assert rules_of(findings) == ["full-matrix-reship"] * 2
+    assert {f.symbol for f in findings} == {"Batcher.place", "upload"}
+
+
+def test_reship_quiet_inside_manifest(tmp_path):
+    assert run_on(tmp_path, RESHIP_GOOD, subdir="scheduler") == []
+
+
+def test_reship_out_of_scope_dirs_quiet(tmp_path):
+    # parallel/ (sharding infrastructure) and server/ are not dispatch
+    # steady state; the rule stays out of them.
+    assert run_on(tmp_path, RESHIP_BAD, subdir="parallel") == []
+    assert run_on(tmp_path, RESHIP_BAD, subdir="server") == []
+
+
+def test_reship_inline_suppression(tmp_path):
+    src = RESHIP_BAD.replace(
+        "dev = jax.device_put(np.zeros((1024, 4)))",
+        "dev = jax.device_put(np.zeros((1024, 4)))  "
+        "# nta: disable=full-matrix-reship")
+    findings = run_on(tmp_path, src, subdir="models")
+    assert rules_of(findings) == ["full-matrix-reship"]
+    assert findings[0].symbol == "upload"
+
+
+def test_real_batcher_passes_its_own_manifest():
+    """The actual device cache: every transfer call in
+    scheduler/batcher.py sits inside its declared rebuild entry point."""
+    findings = analyze_paths(
+        [os.path.join(REPO, "nomad_tpu", "scheduler", "batcher.py")])
+    assert [f for f in findings if f.rule == "full-matrix-reship"] == []
+
+
+# ---------------------------------------------------------------------
 # the tier-1 gate: whole tree clean modulo baseline, baseline
 # non-growing, concurrency-core dirs baseline-free
 
 
 CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
-             "nomad_tpu/trace/", "nomad_tpu/admission/")
+             "nomad_tpu/trace/", "nomad_tpu/admission/",
+             "nomad_tpu/models/")
 
 
 def _tree_findings():

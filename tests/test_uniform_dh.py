@@ -1,0 +1,79 @@
+"""Uniform distinct-hosts top_k fast path (ops/binpack.py
+_uniform_topk_program): parity with the K-step sequential scan, and the
+ask-bucket > node-bucket overflow shape, which must pad surplus asks as
+unplaceable instead of crashing top_k at trace time."""
+
+import jax
+import numpy as np
+
+from nomad_tpu.ops.binpack import (
+    PlacementConfig,
+    make_asks,
+    make_node_state,
+    placement_program_jit,
+)
+
+
+def uniform_world(n, k, active=None):
+    util = np.tile([100.0, 256.0, 4096.0, 0.0], (n, 1))
+    # Strictly distinct per-node packing so both paths order nodes
+    # identically with tie-break noise off.
+    util[:, 0] += np.arange(n, dtype=np.float64) * 3.0
+    state = make_node_state(
+        capacity=np.tile([4000.0, 8192, 100000, 150], (n, 1)),
+        sched_capacity=np.tile([3900.0, 7936, 96000, 150], (n, 1)),
+        util=util,
+        bw_avail=np.full(n, 1000.0),
+        bw_used=np.zeros(n),
+        ports_free=np.full(n, 40000.0),
+        job_count=np.zeros(n, np.int32),
+        tg_count=np.zeros((n, 2), np.int32),
+        feasible=np.ones((n, 2), bool),
+        node_ok=np.ones(n, bool),
+    )
+    if active is None:
+        active = np.ones(k, bool)
+    asks = make_asks(
+        resources=np.tile([500.0, 256, 150, 0], (k, 1)),
+        bw=np.full(k, 50.0),
+        ports=np.full(k, 2.0),
+        tg_index=np.zeros(k, np.int32),
+        active=active,
+        job_distinct_hosts=True,
+        tg_distinct_hosts=np.zeros(2, bool),
+    )
+    return state, asks, jax.random.PRNGKey(7)
+
+
+SEQ = PlacementConfig(anti_affinity_penalty=10.0, noise_scale=0.0)
+TOPK = SEQ._replace(uniform_dh=True)
+
+
+def test_topk_matches_sequential_scan():
+    state, asks, key = uniform_world(n=128, k=8)
+    c_seq, s_seq, f_seq = placement_program_jit(state, asks, key, SEQ)
+    c_top, s_top, f_top = placement_program_jit(state, asks, key, TOPK)
+    np.testing.assert_array_equal(np.asarray(c_seq), np.asarray(c_top))
+    np.testing.assert_allclose(
+        np.asarray(s_seq), np.asarray(s_top), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(f_seq.util), np.asarray(f_top.util), rtol=1e-5)
+
+
+def test_ask_bucket_larger_than_node_bucket():
+    """count > cluster size: the padded ask bucket (256) exceeds the
+    node bucket (128). top_k must clamp to N and report the surplus
+    asks unplaceable (choice -1) — exactly what the sequential scan
+    yields once every node carries the job."""
+    n, k = 128, 256
+    active = np.ones(k, bool)
+    active[200:] = False  # padding tail, like a real 200-count job
+    state, asks, key = uniform_world(n=n, k=k, active=active)
+    c_top, _, _ = placement_program_jit(state, asks, key, TOPK)
+    c_top = np.asarray(c_top)
+    placed = c_top[c_top >= 0]
+    assert len(placed) == n  # every node used exactly once
+    assert len(set(placed.tolist())) == n
+    assert (c_top[n:] == -1).all()  # surplus + padding unplaceable
+    c_seq, _, _ = placement_program_jit(state, asks, key, SEQ)
+    np.testing.assert_array_equal(c_top, np.asarray(c_seq))
