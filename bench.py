@@ -1457,6 +1457,36 @@ def ntalint_purity_gate():
     return new
 
 
+def ntalint_concurrency_gate():
+    """Deadlock-cycle / raft-funnel findings in the dispatch, scheduler
+    or server paths invalidate dense-path numbers the same way purity
+    findings do: a lock-order cycle means the measured throughput is
+    one unlucky interleaving away from a frozen pipeline, and a
+    raft-funnel violation means the eval terminals the benchmark
+    counts can double-commit or never commit. Whole-tree analysis
+    (these are whole-program rules — edges through utils/ and models/
+    are the point), findings filtered to the gated dirs. Returns the
+    non-baselined findings."""
+    import os
+
+    from nomad_tpu.analysis import (
+        analyze_paths,
+        apply_baseline,
+        load_baseline,
+    )
+    from nomad_tpu.analysis.deadlock import RULE_DEADLOCK
+    from nomad_tpu.analysis.protocol import RULE_FUNNEL
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    findings = analyze_paths(
+        [os.path.join(root, "nomad_tpu")],
+        rules={RULE_DEADLOCK, RULE_FUNNEL, "parse-error"})
+    new, _stale = apply_baseline(findings, load_baseline())
+    gated = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
+             "nomad_tpu/server/")
+    return [f for f in new if f.path.startswith(gated)]
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, default=HEADLINE_CONFIG,
@@ -1508,6 +1538,17 @@ def main():
                   file=sys.stderr)
             sys.exit(2)
         print("bench: ntalint trace-purity gate clean", file=sys.stderr)
+        bad = ntalint_concurrency_gate()
+        if bad:
+            for f in bad:
+                print(f.render(), file=sys.stderr)
+            print(f"bench: REFUSING to report dense-path numbers: "
+                  f"{len(bad)} deadlock-cycle/raft-funnel finding(s) "
+                  f"in dispatch//scheduler//server/ (fix them or run "
+                  f"without --check)", file=sys.stderr)
+            sys.exit(2)
+        print("bench: ntalint deadlock/raft-funnel gate clean",
+              file=sys.stderr)
 
     if args.check and not args.no_trace and (args.all
                                              or args.chaos is not None):

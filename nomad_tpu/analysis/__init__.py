@@ -1,28 +1,49 @@
 """ntalint: AST-based static analysis specialized to this codebase's
 concurrency and JAX-purity invariants (see analysis/README.md).
 
-Three checker families, run over `nomad_tpu/` as a tier-1 test
+Checker families, run over `nomad_tpu/` as a tier-1 test
 (tests/test_static_analysis.py) and from the CLI (tools/ntalint.py):
 
 - ``locks``    — lock-discipline: `# guarded-by:` attributes, blocking
-  calls under locks, and never-block dispatcher-thread entrypoints.
+  calls under locks, and never-block dispatcher-thread entrypoints
+  (whole-program reachability from `NTA_DISPATCHER_ENTRYPOINTS`).
 - ``purity``   — JAX trace-purity: impure/host calls, closure
   mutation, Python branching on traced values, unhashable static args.
 - ``snapshot`` — scheduler/dispatch modules read cluster state only
   through StateStore.snapshot() handles, never the live store.
-- ``robustness`` — no unbounded waits in server//dispatch//trace/, no
-  silently-swallowed broad exceptions in server//dispatch//client//
-  trace/ (the failure classes nomad_tpu/chaos fault injection hunts),
+- ``robustness`` — no unbounded waits in (or cross-module reachable
+  from) server//dispatch//trace//admission/, no silently-swallowed
+  broad exceptions in server//dispatch//client//trace//admission/
+  (the failure classes nomad_tpu/chaos fault injection hunts),
   and no blocking call or unbounded container growth on the flight
   recorder's record path (`NTA_RECORD_PATH` manifest — the functions
   the broker lock and the dispatcher thread run).
+- ``residency`` — no host->device transfer on the steady-state
+  dispatch/scheduler/models paths outside `NTA_REBUILD_ENTRYPOINTS`.
+- ``deadlock`` — whole-program lock-acquisition-order graph (lexical
+  nesting + lock-held call reachability); any cycle between distinct
+  locks is reported with a full witness path.
+- ``protocol`` — the raft funnel: state-store mutators and terminal
+  status/trigger stamps only inside (or flowing into) the funnels an
+  `NTA_RAFT_FUNNELS` manifest declares.
+
+All manifest rules share ONE definition of "reachable from":
+`core.Program`, the cross-module call graph (imports, module-attr
+calls, self-methods through inheritance, constructor-typed
+attributes; dynamic dispatch and pool/thread handoffs deliberately
+not followed).
 """
 
 from .core import (  # noqa: F401
     Finding,
+    Program,
+    RULESET_VERSION,
     analyze_paths,
     apply_baseline,
+    clear_caches,
     load_baseline,
+    load_disk_cache,
+    save_disk_cache,
     write_baseline,
 )
 
@@ -40,4 +61,7 @@ ALL_RULES = (
     "unbounded-wait",
     "swallowed-exception",
     "record-path-blocking",
+    "full-matrix-reship",
+    "deadlock-cycle",
+    "raft-funnel",
 )

@@ -1,0 +1,470 @@
+"""Lock-order deadlock detector.
+
+Rule ``deadlock-cycle``: build the whole-program lock-acquisition-
+order graph and report every cycle between DISTINCT locks with a full
+witness path.
+
+An edge A -> B exists when code holding A acquires B, either
+
+- lexically (``with self._a: ... with self._b:``), or
+- through the call graph: ``with self._a:`` encloses a call whose
+  whole-program closure (core.Program — the same "reachable from"
+  every manifest rule uses) contains a ``with``-acquisition of B. The
+  classic two-thread wrap-around needs no nesting in any single
+  function: broker holds its lock and calls into the recorder; a
+  recorder path holding its stripe lock calls back into the broker —
+  each function looks innocent, the cycle only exists cross-module.
+
+Lock identity is the DECLARATION site: ``(module, class, attr)`` for
+``self._lock = threading.Lock()`` in ``__init__``, ``(module, '',
+name)`` for module-level locks. ``Condition(self._lock)`` aliases the
+condition to its backing lock (holding either is holding the same
+lock), so a cond-vs-its-lock pair can never produce a spurious
+two-node cycle.
+
+Deliberate precision choices:
+
+- Self-edges (re-acquiring the lock you hold) are NOT reported: the
+  call graph over-approximates (a helper called both with and without
+  the lock held would self-edge), and the codebase's RLocks make
+  re-entry legal. Cycles require >= 2 distinct locks.
+- Nested ``def`` bodies are excluded from both the held-walk and the
+  acquisition summaries: they run on whatever thread calls them,
+  under that thread's locks, not these.
+- References handed to pools/``Thread(target=...)`` are not calls and
+  are not followed (consistent with every other ntalint rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FnKey, Module, Program
+from .locks import _ctor_kind, _self_attr
+
+RULE_DEADLOCK = "deadlock-cycle"
+
+# (module rel, class name or "", attribute/name)
+LockKey = Tuple[str, str, str]
+
+
+def _display(lock: LockKey) -> str:
+    rel, cls, attr = lock
+    short = rel.rsplit("/", 1)[-1]
+    return f"{short}::{cls}.{attr}" if cls else f"{short}::{attr}"
+
+
+class _Registry:
+    """Every lock declaration in the program, with cond->lock
+    aliasing resolved at registration."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.module_locks: Dict[str, Dict[str, LockKey]] = {}
+        self.class_locks: Dict[Tuple[str, str], Dict[str, LockKey]] = {}
+        for mod in program.modules:
+            self._scan(mod)
+
+    def _scan(self, mod: Module) -> None:
+        mlocks = self.module_locks.setdefault(mod.rel, {})
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                kind = _ctor_kind(node.value)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    backing = None
+                    if kind == "cond" and node.value.args:
+                        arg = node.value.args[0]
+                        if isinstance(arg, ast.Name):
+                            backing = arg.id
+                    mlocks[tgt.id] = (mod.rel, "", backing or tgt.id)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(mod, node)
+
+    def _scan_class(self, mod: Module, cls: ast.ClassDef) -> None:
+        locks = self.class_locks.setdefault((mod.rel, cls.name), {})
+        for sub in cls.body:
+            if not isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                continue
+            if sub.name != "__init__":
+                continue
+            for stmt in ast.walk(sub):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                value = stmt.value
+                if not isinstance(value, ast.Call):
+                    continue
+                kind = _ctor_kind(value)
+                if kind is None:
+                    continue
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    backing = None
+                    if kind == "cond" and value.args:
+                        backing = _self_attr(value.args[0])
+                    locks[attr] = (mod.rel, cls.name, backing or attr)
+
+    def resolve(self, rel: str, cls: Optional[str],
+                expr: ast.AST) -> Optional[LockKey]:
+        """LockKey for a with-item expression: self.X, module NAME,
+        or self.<typed attr>.X through Program.attr_types."""
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None:
+            return self.class_locks.get((rel, cls), {}).get(attr)
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(rel, {}).get(expr.id)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Attribute)):
+            owner = _self_attr(expr.value)
+            if owner is not None and cls is not None:
+                t = self.program.attr_types.get((rel, cls), {}).get(owner)
+                if t is not None:
+                    return self.class_locks.get(t, {}).get(expr.attr)
+        return None
+
+
+class _Summary:
+    __slots__ = ("acquires", "calls", "calls_under_lock")
+
+    def __init__(self):
+        # direct `with` acquisitions: (lock, line)
+        self.acquires: List[Tuple[LockKey, int]] = []
+        # resolved callees (nested defs excluded)
+        self.calls: Set[FnKey] = set()
+        # (callee, held locks, call line)
+        self.calls_under_lock: List[
+            Tuple[FnKey, frozenset, int]] = []
+
+
+class _SummaryWalker:
+    """One function: track held locks statement-wise (the same
+    traversal shape as locks._FunctionWalker), recording acquisitions
+    and calls-with-held-locks. Nested defs are skipped."""
+
+    def __init__(self, registry: _Registry, key: FnKey, fn: ast.AST):
+        self.registry = registry
+        self.program = registry.program
+        self.rel, qual = key
+        self.cls = qual.split(".")[0] if "." in qual else None
+        self.key = key
+        self.fn = fn
+        self.local_types = self.program._local_types(
+            self.rel, self.cls, fn)
+        self.out = _Summary()
+
+    def run(self) -> _Summary:
+        self._stmts(self.fn.body, frozenset())
+        return self.out
+
+    def _stmts(self, body, held) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held) -> None:
+        if isinstance(stmt, ast.With):
+            cur = set(held)
+            for item in stmt.items:
+                self._expr(item.context_expr, frozenset(cur))
+                lock = self.registry.resolve(
+                    self.rel, self.cls, item.context_expr)
+                if lock is not None:
+                    self.out.acquires.append((lock, stmt.lineno))
+                    cur.add(lock)
+            self._stmts(stmt.body, frozenset(cur))
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # runs elsewhere, under that caller's locks
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held)
+            self._expr(stmt.target, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                self._expr(child, held)
+
+    def _expr(self, node, held) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            target = self.program.resolve_call(
+                self.rel, self.cls, sub.func, self.local_types)
+            if target is None or target == self.key:
+                continue
+            self.out.calls.add(target)
+            if held:
+                self.out.calls_under_lock.append(
+                    (target, held, sub.lineno))
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "holder", "hold_line", "chain",
+                 "acquire_site")
+
+    def __init__(self, src, dst, holder, hold_line, chain,
+                 acquire_site):
+        self.src = src
+        self.dst = dst
+        self.holder = holder          # FnKey holding src
+        self.hold_line = hold_line    # line of the call / nested with
+        self.chain = chain            # [FnKey] from holder to acquirer
+        self.acquire_site = acquire_site  # (rel, line) of `with dst`
+
+    def describe(self) -> str:
+        path = " -> ".join(q for (_r, q) in self.chain)
+        return (f"{_display(self.src)} held at "
+                f"{self.holder[0]}:{self.hold_line} "
+                f"[{self.holder[1]}], then {_display(self.dst)} "
+                f"acquired at {self.acquire_site[0]}:"
+                f"{self.acquire_site[1]}"
+                + (f" via {path}" if len(self.chain) > 1 else ""))
+
+
+def program_check(program: Program) -> List[Finding]:
+    registry = _Registry(program)
+    summaries: Dict[FnKey, _Summary] = {}
+    for key, fn in program.functions.items():
+        summaries[key] = _SummaryWalker(registry, key, fn).run()
+
+    # Transitive acquisition closure per function (over the nested-
+    # def-free call sets the summaries recorded). Worklist fixpoint,
+    # not memoized DFS: recursion cycles in the call graph would force
+    # a DFS to cut a back-edge and cache the partial result, silently
+    # dropping locks reachable through the cycle (and with them real
+    # deadlock edges).
+    trans: Dict[FnKey, Set[LockKey]] = {
+        key: {lock for (lock, _line) in s.acquires}
+        for key, s in summaries.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, s in summaries.items():
+            cur = trans[key]
+            before = len(cur)
+            for callee in s.calls:
+                callee_locks = trans.get(callee)
+                if callee_locks:
+                    cur |= callee_locks
+            if len(cur) != before:
+                changed = True
+
+    def trans_acquires(key: FnKey) -> Set[LockKey]:
+        return trans.get(key, set())
+
+    def acquire_path(start: FnKey, lock: LockKey):
+        """([FnKey] chain start..acquirer, (rel, line)) for the first
+        function reachable from `start` that directly acquires
+        `lock`."""
+        seen = set()
+        todo = [(start, [start])]
+        while todo:
+            cur, chain = todo.pop(0)
+            if cur in seen:
+                continue
+            seen.add(cur)
+            s = summaries.get(cur)
+            if s is None:
+                continue
+            for (lk, line) in s.acquires:
+                if lk == lock:
+                    return chain, (cur[0], line)
+            for callee in sorted(s.calls):
+                if callee not in seen:
+                    todo.append((callee, chain + [callee]))
+        return [start], (start[0], 0)
+
+    # Edge set over distinct locks.
+    edges: Dict[Tuple[LockKey, LockKey], _Edge] = {}
+
+    def add_edge(src, dst, holder, line, chain, site):
+        if src == dst:
+            return
+        key = (src, dst)
+        if key not in edges:
+            edges[key] = _Edge(src, dst, holder, line, chain, site)
+
+    for key in sorted(summaries):
+        s = summaries[key]
+        # lexical with-in-with nesting inside this function
+        _LexicalEdges(registry, key, program.functions[key],
+                      add_edge).run()
+        for (callee, held, line) in s.calls_under_lock:
+            reachable_locks = trans_acquires(callee)
+            for dst in sorted(reachable_locks):
+                for src in sorted(held):
+                    if src == dst:
+                        continue
+                    if (src, dst) in edges:
+                        continue
+                    chain, site = acquire_path(callee, dst)
+                    add_edge(src, dst, key, line, [key] + chain, site)
+
+    # ---- cycle detection over the lock graph
+    graph: Dict[LockKey, Set[LockKey]] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+        graph.setdefault(dst, set())
+
+    sccs = _tarjan(graph)
+    findings: List[Finding] = []
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        cycle = _find_cycle(graph, scc)
+        if not cycle:
+            continue
+        cycle_edges = [edges[(cycle[i], cycle[(i + 1) % len(cycle)])]
+                       for i in range(len(cycle))]
+        first = cycle_edges[0]
+        locks_str = " -> ".join(_display(l) for l in cycle
+                                ) + f" -> {_display(cycle[0])}"
+        witness = "; ".join(e.describe() for e in cycle_edges)
+        related = []
+        for e in cycle_edges:
+            related.append(f"{e.holder[0]}:{e.hold_line}")
+            related.append(f"{e.acquire_site[0]}:{e.acquire_site[1]}")
+        findings.append(Finding(
+            RULE_DEADLOCK, first.holder[0], first.hold_line, 0,
+            f"lock-order cycle {locks_str}: two threads taking these "
+            f"locks in opposite orders deadlock. Witness: {witness}",
+            first.holder[1], related=related))
+    return findings
+
+
+class _LexicalEdges:
+    """with-in-with edges inside one function (including multi-item
+    `with a, b:` which acquires left to right)."""
+
+    def __init__(self, registry: _Registry, key: FnKey, fn, add_edge):
+        self.registry = registry
+        self.rel, qual = key
+        self.cls = qual.split(".")[0] if "." in qual else None
+        self.key = key
+        self.fn = fn
+        self.add_edge = add_edge
+
+    def run(self):
+        self._stmts(self.fn.body, [])
+
+    def _stmts(self, body, held):
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held):
+        if isinstance(stmt, ast.With):
+            cur = list(held)
+            for item in stmt.items:
+                lock = self.registry.resolve(
+                    self.rel, self.cls, item.context_expr)
+                if lock is None:
+                    continue
+                for (src, src_line) in cur:
+                    self.add_edge(
+                        src, lock, self.key, src_line,
+                        [self.key], (self.rel, stmt.lineno))
+                cur.append((lock, stmt.lineno))
+            self._stmts(stmt.body, cur)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.For):
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+
+
+def _tarjan(graph: Dict[LockKey, Set[LockKey]]) -> List[List[LockKey]]:
+    index: Dict[LockKey, int] = {}
+    lowlink: Dict[LockKey, int] = {}
+    on_stack: Set[LockKey] = set()
+    stack: List[LockKey] = []
+    counter = [0]
+    out: List[List[LockKey]] = []
+
+    def strongconnect(v, depth=0):
+        index[v] = lowlink[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w, depth + 1)
+                lowlink[v] = min(lowlink[v], lowlink[w])
+            elif w in on_stack:
+                lowlink[v] = min(lowlink[v], index[w])
+        if lowlink[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _find_cycle(graph: Dict[LockKey, Set[LockKey]],
+                scc: List[LockKey]) -> Optional[List[LockKey]]:
+    """An elementary cycle within one SCC (DFS from its smallest
+    node), as an ordered lock list [a, b, c] meaning a->b->c->a."""
+    members = set(scc)
+    start = scc[0]
+    stack = [(start, [start])]
+    seen_paths = set()
+    while stack:
+        node, path = stack.pop()
+        for nxt in sorted(graph.get(node, ())):
+            if nxt not in members:
+                continue
+            if nxt == start and len(path) >= 2:
+                return path
+            if nxt in path:
+                continue
+            key = (nxt, tuple(path))
+            if key in seen_paths:
+                continue
+            seen_paths.add(key)
+            stack.append((nxt, path + [nxt]))
+    # 2-cycles: a->b->a
+    for a in scc:
+        for b in graph.get(a, ()):
+            if b in members and a in graph.get(b, ()):
+                return [a, b]
+    return None

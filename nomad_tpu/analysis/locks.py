@@ -20,10 +20,13 @@ Three rules:
   no blocking call at all. The only exemption is a BOUNDED
   ``cond.wait(timeout)`` on a condition whose lock is held — that is
   the dispatcher's scheduling primitive, not a foreign dependency.
-  Reachability follows direct intra-module calls (``self.m()``,
-  module-level ``f()``); references handed to thread pools or
-  ``Thread(target=...)`` run on OTHER threads and are not followed —
-  that is exactly the sanctioned fix for a finding.
+  Reachability is WHOLE-PROGRAM (core.Program): ``self.m()``,
+  module-level ``f()``, imported functions, module-attribute calls and
+  constructor/typed-attribute calls are all followed across modules —
+  a blocking call hidden two modules deep behind a ``utils`` helper is
+  the dispatcher's problem, not the helper's. References handed to
+  thread pools or ``Thread(target=...)`` run on OTHER threads and are
+  not followed — that is exactly the sanctioned fix for a finding.
 
 Locks are recognized from ``threading.Lock()/RLock()/Condition()``
 construction: module-level names and ``self.<attr>`` assignments in
@@ -36,7 +39,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Module, direct_calls, reachable_from
+from .core import Finding, Module, Program
 
 RULE_GUARDED = "guarded-by"
 RULE_LOCK_BLOCKING = "lock-blocking-call"
@@ -92,20 +95,18 @@ def _self_attr(node: ast.AST) -> Optional[str]:
 
 
 class _ModuleIndex:
-    """Pass 1: lock registry, guarded attrs, dispatcher manifest, and
-    the intra-module call graph."""
+    """Pass 1: lock registry, guarded attrs, and the per-module
+    function table. (Dispatcher reachability moved to the
+    whole-program graph — core.Program — in PR 7.)"""
 
     def __init__(self, mod: Module):
         self.mod = mod
         self.module_locks: Dict[str, LockId] = {}  # name -> LockId
         self.module_conds: Set[str] = set()
         self.classes: Dict[str, _ClassInfo] = {}
-        self.entrypoints: List[str] = []
         # qualname -> FunctionDef for every def (methods qualified as
         # Class.method, module funcs bare).
         self.functions: Dict[str, ast.FunctionDef] = {}
-        # qualname -> set of directly-called qualnames
-        self.calls: Dict[str, Set[str]] = {}
         self._build()
 
     def _build(self) -> None:
@@ -117,26 +118,6 @@ class _ModuleIndex:
                 self._scan_class(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.functions[node.name] = node
-        # manifest
-        for node in tree.body:
-            if isinstance(node, ast.Assign):
-                for tgt in node.targets:
-                    if (isinstance(tgt, ast.Name)
-                            and tgt.id == "NTA_DISPATCHER_ENTRYPOINTS"):
-                        self.entrypoints.extend(
-                            self._string_elems(node.value))
-        # call graph
-        for qual, fn in self.functions.items():
-            self.calls[qual] = self._direct_calls(qual, fn)
-
-    def _string_elems(self, node: ast.AST) -> List[str]:
-        out = []
-        if isinstance(node, (ast.Tuple, ast.List)):
-            for el in node.elts:
-                if isinstance(el, ast.Constant) and isinstance(
-                        el.value, str):
-                    out.append(el.value)
-        return out
 
     def _module_assign(self, node: ast.Assign) -> None:
         if not isinstance(node.value, ast.Call):
@@ -207,12 +188,6 @@ class _ModuleIndex:
             if kind == "self" and name in info.locks:
                 info.guarded[attr] = info.locks[name]
 
-    def _direct_calls(self, qual: str, fn: ast.FunctionDef) -> Set[str]:
-        # Shared walker (core.direct_calls): the dispatcher rule and
-        # robustness' record-path rule must agree on what "reachable"
-        # means.
-        return direct_calls(qual, fn, self.functions)
-
     # ------------------------------------------------------ resolution
 
     def resolve_lock_expr(self, expr: ast.AST,
@@ -239,16 +214,13 @@ class _ModuleIndex:
         return False
 
 
-def _dispatcher_reachable(index: _ModuleIndex) -> Set[str]:
-    return reachable_from(index.entrypoints, index.functions, index.calls)
-
-
 class _FunctionWalker:
     """Pass 2: walk one function's statements tracking held locks."""
 
     def __init__(self, index: _ModuleIndex, mod: Module, qual: str,
                  fn: ast.FunctionDef, dispatcher: bool,
-                 findings: List[Finding]):
+                 findings: List[Finding], emit_lock_rules: bool = True,
+                 entry_note: str = "", related=None):
         self.index = index
         self.mod = mod
         self.qual = qual
@@ -257,6 +229,9 @@ class _FunctionWalker:
         self.fn = fn
         self.dispatcher = dispatcher
         self.findings = findings
+        self.emit_lock_rules = emit_lock_rules
+        self.entry_note = entry_note
+        self.related = related
 
     def run(self) -> None:
         self._stmts(self.fn.body, frozenset())
@@ -356,7 +331,7 @@ class _FunctionWalker:
                     and self.index.is_condition(receiver, self.cls)):
                 own_cond_wait = True
 
-        if held and not own_cond_wait:
+        if held and not own_cond_wait and self.emit_lock_rules:
             self.findings.append(Finding(
                 RULE_LOCK_BLOCKING, self.mod.rel, call.lineno,
                 call.col_offset,
@@ -368,17 +343,47 @@ class _FunctionWalker:
                 RULE_DISPATCHER_BLOCKING, self.mod.rel, call.lineno,
                 call.col_offset,
                 f"blocking call '{name}' reachable from dispatcher "
-                f"entrypoint (manifest NTA_DISPATCHER_ENTRYPOINTS); "
-                f"move it to a stage thread",
-                self.qual))
+                f"entrypoint (manifest NTA_DISPATCHER_ENTRYPOINTS"
+                f"{self.entry_note}); move it to a stage thread",
+                self.qual, related=self.related))
 
 
 def check(mod: Module) -> List[Finding]:
+    """Local lock-discipline rules (guarded-by, lock-blocking-call).
+    The dispatcher rule moved to program_check: it is a reachability
+    rule and reachability is whole-program now."""
     index = _ModuleIndex(mod)
-    reachable = _dispatcher_reachable(index)
     findings: List[Finding] = []
     for qual, fn in index.functions.items():
-        _FunctionWalker(index, mod, qual, fn,
-                        dispatcher=qual in reachable,
+        _FunctionWalker(index, mod, qual, fn, dispatcher=False,
                         findings=findings).run()
+    return findings
+
+
+def program_check(program: Program) -> List[Finding]:
+    """dispatcher-blocking-call over the whole-program call graph:
+    every function reachable (cross-module) from any module's
+    NTA_DISPATCHER_ENTRYPOINTS manifest is walked with the dispatcher
+    rule armed. The finding lands where the blocking call lives — a
+    helper in utils/ that sleeps is flagged in utils/, with the
+    entry chain in the message and `related`."""
+    entries = program.manifest_entries("NTA_DISPATCHER_ENTRYPOINTS")
+    if not entries:
+        return []
+    via = program.reachable_with_paths(entries)
+    findings: List[Finding] = []
+    indexes: Dict[str, _ModuleIndex] = {}
+    for key in sorted(via):
+        rel, qual = key
+        mod = program.by_rel.get(rel)
+        if mod is None:
+            continue
+        index = indexes.get(rel)
+        if index is None:
+            index = indexes[rel] = _ModuleIndex(mod)
+        fn = program.functions[key]
+        note, related = program.witness_info(via, key)
+        _FunctionWalker(index, mod, qual, fn, dispatcher=True,
+                        findings=findings, emit_lock_rules=False,
+                        entry_note=note, related=related).run()
     return findings

@@ -1,0 +1,254 @@
+"""Raft-funnel protocol checker.
+
+Rule ``raft-funnel`` — the static half of the exactly-once-terminal
+guarantee the chaos soaks assert dynamically: **nothing commits
+cluster state outside the raft apply path, and no terminal outcome is
+stamped without routing through it.**
+
+Sanctioned funnels are declared in ``NTA_RAFT_FUNNELS`` manifests::
+
+    NTA_RAFT_FUNNELS = ("FSM._apply_eval_update", ...)
+
+(`server/fsm.py` declares the FSM apply handlers + restore;
+`scheduler/testing.py` declares the CPU-oracle harness's apply — the
+Harness IS the raft stand-in for differential tests.) The checker
+computes the whole-program closure of those entrypoints
+(core.Program) and enforces two sub-rules over every module in scope:
+
+1. **Commit calls**: a call to a ``StateStore`` mutator
+   (``upsert_evals`` / ``upsert_allocs`` /
+   ``update_allocs_from_client`` / ``delete_*`` / ``update_node_*`` /
+   ...) may only appear inside a funnel-reachable function. Anything
+   else is a write to replicated state that raft never saw — followers
+   diverge silently.
+
+2. **Terminal stamps**: an assignment of a terminal constant —
+   ``.status = EVAL_STATUS_COMPLETE/FAILED/CANCELLED``,
+   ``.client_status = ALLOC_CLIENT_LOST``, or the failed-queue park
+   triggers ``.triggered_by = EVAL_TRIGGER_SHED/EXPIRED/DEAD_LETTER``
+   — must either sit inside a funnel-reachable function, or the
+   stamped object must flow into a funnel call in the SAME function
+   (the codebase's stamp-a-copy-then-``eval_update([upd])`` idiom;
+   ``cancelled.append(upd)`` followed by ``eval_update(cancelled)``
+   also counts — one container hop is tracked). A terminal stamped on
+   a shared eval and never submitted is exactly the double-terminal /
+   lost-terminal bug class.
+
+Precision notes: values must be terminal CONSTANT names (a helper
+stamping a status passed as a parameter is invisible — call sites
+passing the constant as an argument are the reference idiom and commit
+through the funnel anyway); ``client/`` is out of scope (the client
+owns its local status lifecycle and reports through the
+``alloc_client_update`` RPC, which IS the funnel). Escape hatch, as
+everywhere: ``# nta: disable=raft-funnel`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Program
+
+RULE_FUNNEL = "raft-funnel"
+
+FUNNEL_MANIFEST = "NTA_RAFT_FUNNELS"
+
+# StateStore's mutating surface. Matched by attribute NAME on any
+# receiver: the store is reached through self.state / snapshot
+# restores / harness fields, and a name this distinctive appearing
+# outside the funnel is wrong no matter what the receiver turns out
+# to be at runtime.
+STORE_MUTATORS = {
+    "upsert_node", "delete_node", "update_node_status",
+    "update_node_drain", "upsert_job", "delete_job", "upsert_evals",
+    "delete_evals", "upsert_allocs", "update_allocs_from_client",
+    "upsert_periodic_launch", "delete_periodic_launch",
+    "upsert_vault_accessors", "delete_vault_accessors",
+}
+
+# Submit funnels: calling one of these WITH the stamped object is the
+# sanctioned way to commit a terminal outcome from outside the apply
+# path (the call routes through raft; the fsm handler re-applies the
+# status on every replica).
+SUBMIT_FUNNELS = {"eval_update", "upsert_evals", "upsert_allocs",
+                  "update_allocs_from_client", "alloc_client_update"}
+
+TERMINAL_BY_FIELD = {
+    "status": {"EVAL_STATUS_COMPLETE", "EVAL_STATUS_FAILED",
+               "EVAL_STATUS_CANCELLED"},
+    "client_status": {"ALLOC_CLIENT_LOST"},
+    "triggered_by": {"EVAL_TRIGGER_SHED", "EVAL_TRIGGER_EXPIRED",
+                     "EVAL_TRIGGER_DEAD_LETTER"},
+}
+
+# The client owns its local status lifecycle (pending->running->
+# complete/failed) and commits through the alloc_client_update RPC.
+EXCLUDE_MARKERS = ("/client/",)
+
+
+def _in_scope(rel: str) -> bool:
+    p = "/" + rel
+    return not any(m in p for m in EXCLUDE_MARKERS)
+
+
+def _const_name(node: ast.AST) -> Optional[str]:
+    """Trailing name of a constant reference: `EVAL_STATUS_FAILED` or
+    `consts.EVAL_STATUS_FAILED`."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class _FlowScan:
+    """Names that flow into a funnel call within one function —
+    ORDER-SENSITIVE on the submit: a stamp is only covered by a funnel
+    call at or below it (a terminal stamped AFTER the submit mutates
+    the shared object without committing — the lost-terminal bug
+    class). One container hop is tracked, and the append may sit on
+    EITHER side of the stamp: the container holds a reference, so
+    `out.append(upd); upd.status = ...; eval_update(out)` commits the
+    stamp exactly like stamp-then-append does.
+
+    What counts as a funnel call is decided by `is_funnel(node)`:
+    RESOLUTION against the declared funnel entries (plus the
+    fixed SUBMIT_FUNNELS name set) — matching manifest entries by
+    bare method name would let `FSM.apply` sanction every call
+    spelled `.apply()` anywhere in the tree."""
+
+    def __init__(self, fn: ast.AST, is_funnel):
+        # name -> latest line where it appears inside a funnel call's
+        # arguments
+        self.flows: Dict[str, int] = {}
+        # (container, member) pairs with at least one append
+        self.hops: Set[tuple] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if is_funnel(node):
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            self.flows[sub.id] = max(
+                                self.flows.get(sub.id, 0), node.lineno)
+            elif (name in ("append", "extend", "insert", "add")
+                    and isinstance(node.func, ast.Attribute)):
+                container = _root_name(node.func.value)
+                if container is not None:
+                    for arg in node.args:
+                        r = _root_name(arg)
+                        if r is not None:
+                            self.hops.add((container, r))
+
+    def covers(self, name: Optional[str], stamp_line: int) -> bool:
+        if name is None:
+            return False
+        if self.flows.get(name, 0) >= stamp_line:
+            return True
+        for (container, member) in self.hops:
+            if (member == name
+                    and self.flows.get(container, 0) >= stamp_line):
+                return True
+        return False
+
+
+def program_check(program: Program) -> List[Finding]:
+    entries = program.manifest_entries(FUNNEL_MANIFEST)
+    reachable = set(program.reachable_with_paths(entries)) if entries \
+        else set()
+    funnel_entries = set(entries)
+    # Witness: the manifest declaration sites. The sanctioned set is a
+    # function of the manifests, so an edit to any manifest module can
+    # surface findings in OTHERWISE-unchanged files — `related` is how
+    # ntalint --diff attributes those to the edit.
+    manifest_sites = [
+        f"{rel}:{line}" for rel, line in sorted(
+            program.manifest_lines.get(FUNNEL_MANIFEST, {}).items())]
+    findings: List[Finding] = []
+
+    for key in sorted(program.functions):
+        rel, qual = key
+        if not _in_scope(rel):
+            continue
+        if key in reachable:
+            continue  # inside the funnel: sanctioned by construction
+        fn = program.functions[key]
+        mod = program.by_rel.get(rel)
+        if mod is None:
+            continue
+        cls = qual.split(".")[0] if "." in qual else None
+        flow: Optional[_FlowScan] = None
+
+        def make_flow(rel=rel, cls=cls, fn=fn):
+            local_types = program._local_types(rel, cls, fn)
+
+            def is_funnel(node: ast.Call) -> bool:
+                if _call_name(node.func) in SUBMIT_FUNNELS:
+                    return True
+                target = program.resolve_call(rel, cls, node.func,
+                                              local_types)
+                return target is not None and target in funnel_entries
+
+            return _FlowScan(fn, is_funnel)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if (name in STORE_MUTATORS
+                        and isinstance(node.func, ast.Attribute)):
+                    findings.append(Finding(
+                        RULE_FUNNEL, rel, node.lineno, node.col_offset,
+                        f"state-store mutator '.{name}()' outside the "
+                        f"raft funnel ({FUNNEL_MANIFEST}): only the "
+                        f"fsm/apply path may commit replicated state — "
+                        f"submit through raft (eval_update / "
+                        f"alloc_update RPCs) instead",
+                        qual, related=manifest_sites or None))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                value_name = _const_name(getattr(node, "value", None))
+                if value_name is None:
+                    continue
+                for tgt in targets:
+                    if not isinstance(tgt, ast.Attribute):
+                        continue
+                    terminals = TERMINAL_BY_FIELD.get(tgt.attr)
+                    if terminals is None or value_name not in terminals:
+                        continue
+                    if flow is None:
+                        flow = make_flow()
+                    if flow.covers(_root_name(tgt.value), node.lineno):
+                        continue
+                    findings.append(Finding(
+                        RULE_FUNNEL, rel, node.lineno,
+                        node.col_offset,
+                        f"terminal stamp '.{tgt.attr} = {value_name}' "
+                        f"outside the raft funnel and never submitted "
+                        f"through it: a terminal outcome that does not "
+                        f"flow into eval_update/upsert_allocs (or a "
+                        f"{FUNNEL_MANIFEST} funnel) in this function "
+                        f"either never commits or commits twice",
+                        qual, related=manifest_sites or None))
+    return findings

@@ -8,11 +8,19 @@ injected fault into a hung thread instead of a recovered one, and a
 swallowed exception is exactly how injection findings hide:
 
 - ``unbounded-wait`` (``server/``, ``dispatch/``, ``trace/``,
-  ``admission/``): a
+  ``admission/``, ``scheduler/`` — the dense path parks worker
+  threads in scheduler/ code, so it gets the same discipline): a
   no-argument ``.wait()`` / ``.get()`` / ``.join()`` call blocks
   forever with no shutdown re-check; every such wait must be bounded
   (pass a timeout and re-check stop/shutdown in a loop). ``dict.get``
   is untouched — it always takes at least one argument.
+  Whole-program extension (PR 7): an unbounded wait OUTSIDE the scope
+  dirs is still flagged when it is reachable (core.Program, cross-
+  module) from a function defined IN them — `worker.process` calling
+  into a scheduler/ helper that parks on a bare ``event.wait()`` hangs
+  the same worker thread the in-scope rule protects. References
+  handed to pools/threads are not followed: a daemon worker loop that
+  parks on its queue by design stays quiet.
 
 - ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``,
   ``trace/``, ``admission/``): an ``except Exception:`` /
@@ -28,8 +36,8 @@ swallowed exception is exactly how injection findings hide:
 
       NTA_RECORD_PATH = ("FlightRecorder.record_span", ...)
 
-  gets every function reachable from those entrypoints (direct
-  intra-module calls, the same reachability the dispatcher rule uses —
+  gets every function reachable from those entrypoints (whole-program
+  core.Program reachability, the same graph the dispatcher rule uses —
   these are the functions the broker lock and the dispatcher thread's
   ``NTA_DISPATCHER_ENTRYPOINTS`` chain run) checked for:
 
@@ -48,21 +56,16 @@ swallowed exception is exactly how injection findings hide:
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import List
 
-from .core import (
-    Finding,
-    Module,
-    direct_calls,
-    module_functions,
-    reachable_from,
-)
+from .core import Finding, Module, Program
 
 RULE_UNBOUNDED_WAIT = "unbounded-wait"
 RULE_SWALLOWED = "swallowed-exception"
 RULE_RECORD_PATH = "record-path-blocking"
 
-WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/", "/admission/")
+WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/",
+                      "/admission/", "/scheduler/")
 SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
                          "/admission/")
 
@@ -146,37 +149,6 @@ def _check_swallowed(mod: Module, findings: List[Finding]) -> None:
 # ------------------------------------------------- record-path rule
 
 
-def _functions_and_calls(mod: Module):
-    """(qualname -> FunctionDef, qualname -> direct callee qualnames):
-    THE intra-module call graph (core.module_functions/direct_calls) —
-    shared with the dispatcher rule so the two manifests' notions of
-    "reachable" cannot drift. References handed to pools/threads are
-    not followed (they run on other threads; for the RECORD path there
-    is no such escape hatch — handing work off would itself be an
-    allocation per record)."""
-    functions = module_functions(mod.tree)
-    calls: Dict[str, Set[str]] = {
-        qual: direct_calls(qual, fn, functions)
-        for qual, fn in functions.items()
-    }
-    return functions, calls
-
-
-def _record_manifest(mod: Module) -> List[str]:
-    out: List[str] = []
-    for node in mod.tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        for tgt in node.targets:
-            if isinstance(tgt, ast.Name) and tgt.id == RECORD_MANIFEST:
-                if isinstance(node.value, (ast.Tuple, ast.List)):
-                    for el in node.value.elts:
-                        if isinstance(el, ast.Constant) and isinstance(
-                                el.value, str):
-                            out.append(el.value)
-    return out
-
-
 def _attribute_rooted(expr: ast.AST) -> bool:
     """True when the receiver chain goes through an attribute access —
     i.e. the container outlives the call (self.x, entry.spans,
@@ -184,53 +156,111 @@ def _attribute_rooted(expr: ast.AST) -> bool:
     return any(isinstance(n, ast.Attribute) for n in ast.walk(expr))
 
 
-def _check_record_path(mod: Module, findings: List[Finding]) -> None:
-    entries = _record_manifest(mod)
-    if not entries:
-        return
-    functions, calls = _functions_and_calls(mod)
-    reachable = reachable_from(entries, functions, calls)
-    for qual in sorted(reachable):
-        for node in ast.walk(functions[qual]):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if isinstance(func, ast.Name):
-                if func.id in RECORD_BLOCKING_NAMES:
-                    findings.append(Finding(
-                        RULE_RECORD_PATH, mod.rel, node.lineno,
-                        node.col_offset,
-                        f"blocking call '{func.id}' on the flight-"
-                        f"recorder record path (manifest "
-                        f"{RECORD_MANIFEST}); the record path must "
-                        f"never park", qual))
-                continue
-            if not isinstance(func, ast.Attribute):
-                continue
-            if func.attr in RECORD_BLOCKING_ATTRS:
+def _check_record_fn(mod: Module, qual: str, fn: ast.AST,
+                     note: str, related,
+                     findings: List[Finding]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in RECORD_BLOCKING_NAMES:
                 findings.append(Finding(
                     RULE_RECORD_PATH, mod.rel, node.lineno,
                     node.col_offset,
-                    f"blocking call '.{func.attr}()' on the flight-"
+                    f"blocking call '{func.id}' on the flight-"
                     f"recorder record path (manifest "
-                    f"{RECORD_MANIFEST}); the record path must never "
-                    f"park, bounded or not", qual))
-            elif (func.attr in RECORD_GROWTH_ATTRS
-                    and _attribute_rooted(func.value)):
-                findings.append(Finding(
-                    RULE_RECORD_PATH, mod.rel, node.lineno,
-                    node.col_offset,
-                    f"unbounded growth '.{func.attr}()' on an "
-                    f"attribute-rooted container on the record path — "
-                    f"write into preallocated slots by index "
-                    f"(drop-oldest ring), never grow", qual))
+                    f"{RECORD_MANIFEST}{note}); the record path must "
+                    f"never park", qual, related=related))
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr in RECORD_BLOCKING_ATTRS:
+            findings.append(Finding(
+                RULE_RECORD_PATH, mod.rel, node.lineno,
+                node.col_offset,
+                f"blocking call '.{func.attr}()' on the flight-"
+                f"recorder record path (manifest "
+                f"{RECORD_MANIFEST}{note}); the record path must never "
+                f"park, bounded or not", qual, related=related))
+        elif (func.attr in RECORD_GROWTH_ATTRS
+                and _attribute_rooted(func.value)):
+            findings.append(Finding(
+                RULE_RECORD_PATH, mod.rel, node.lineno,
+                node.col_offset,
+                f"unbounded growth '.{func.attr}()' on an "
+                f"attribute-rooted container on the record path — "
+                f"write into preallocated slots by index "
+                f"(drop-oldest ring), never grow", qual,
+                related=related))
 
 
 def check(mod: Module) -> List[Finding]:
+    """Local rules: in-scope unbounded waits + swallowed exceptions.
+    The record-path and cross-module wait rules are whole-program —
+    see program_check."""
     findings: List[Finding] = []
     if _in_scope(mod.rel, WAIT_SCOPE_MARKERS):
         _check_unbounded_waits(mod, findings)
     if _in_scope(mod.rel, SWALLOW_SCOPE_MARKERS):
         _check_swallowed(mod, findings)
-    _check_record_path(mod, findings)
+    return findings
+
+
+def program_check(program: Program) -> List[Finding]:
+    """Whole-program robustness rules.
+
+    - record-path-blocking: every function reachable from any module's
+      NTA_RECORD_PATH manifest, across modules, is held to the
+      never-park / never-grow contract.
+    - unbounded-wait (cross-module leg): no-arg wait/get/join in an
+      OUT-of-scope module, reachable from a function defined in a
+      wait-scope dir. In-scope sites are reported by the local pass;
+      this leg only adds the helpers those dirs call into.
+    """
+    findings: List[Finding] = []
+
+    entries = program.manifest_entries(RECORD_MANIFEST)
+    if entries:
+        via = program.reachable_with_paths(entries)
+        for key in sorted(via):
+            rel, qual = key
+            mod = program.by_rel.get(rel)
+            if mod is None:
+                continue
+            note, related = program.witness_info(via, key)
+            _check_record_fn(mod, qual, program.functions[key], note,
+                             related, findings)
+
+    origins = [key for key in program.functions
+               if _in_scope(key[0], WAIT_SCOPE_MARKERS)]
+    if origins:
+        via = program.reachable_with_paths(origins)
+        for key in sorted(via):
+            rel, qual = key
+            if _in_scope(rel, WAIT_SCOPE_MARKERS):
+                continue  # local pass owns in-scope sites
+            mod = program.by_rel.get(rel)
+            if mod is None:
+                continue
+            entry = via[key][0]
+            _note, related = program.witness_info(via, key)
+            for node in ast.walk(program.functions[key]):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr not in UNBOUNDED_WAIT_ATTRS:
+                    continue
+                if node.args or node.keywords:
+                    continue
+                findings.append(Finding(
+                    RULE_UNBOUNDED_WAIT, mod.rel, node.lineno,
+                    node.col_offset,
+                    f"unbounded '.{func.attr}()' reachable from "
+                    f"'{entry[1]}' ({entry[0]}) — pass a timeout and "
+                    f"re-check shutdown in a loop (a wedged peer pins "
+                    f"that thread forever)",
+                    qual, related=related))
     return findings

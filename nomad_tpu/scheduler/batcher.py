@@ -65,6 +65,10 @@ DEVICE_BASE_CACHE = 8
 # tunnel) behind the next batch's accumulation. XLA serializes the
 # programs on-device; overlap buys transfer/queueing concurrency.
 MAX_INFLIGHT = 3
+# Requester park slice while its batch is in flight: long enough that
+# re-checks are noise (the window + device call usually complete in
+# one slice), short enough that a dead dispatcher is noticed fast.
+REQUEST_WAIT_SLICE_S = 0.1
 # Hard ceiling on cohort-extended accumulation (add_cohort): the
 # window stretches while ANNOUNCED requests are still on their way —
 # their matrix builds are GIL-serialized host work the RTT-driven
@@ -284,7 +288,41 @@ class PlacementBatcher:
                 run_dispatch = True
         if run_dispatch:
             self._dispatch(shape_key, config, wait_window=True)
-        req.event.wait()
+        # Bounded park (ntalint unbounded-wait): slices with an
+        # ownership re-check instead of a bare event.wait() — a
+        # dispatcher that could not spawn (Thread.start under OS
+        # thread pressure) or died in a way the _dispatch finally
+        # could not cover must not wedge this worker forever.
+        # Ownership has a legal gap (between a dispatcher's queue pop
+        # and its finally running), so act only on the SECOND
+        # consecutive ownerless observation.
+        suspect = False
+        while not req.event.wait(REQUEST_WAIT_SLICE_S):
+            claim = orphaned = False
+            with self._lock:
+                live = self._dispatchers.get(shape_key, 0)
+                queued = any(r is req
+                             for r in self._queues.get(shape_key, ()))
+                if live > 0:
+                    suspect = False
+                elif suspect and queued:
+                    # Self-rescue: still queued with no dispatcher (a
+                    # respawn's Thread.start failed) — become the
+                    # dispatcher, exactly like the first-in path above.
+                    self._dispatchers[shape_key] = 1
+                    claim = True
+                elif suspect:
+                    orphaned = True
+                else:
+                    suspect = True
+            if claim:
+                self._dispatch(shape_key, config, wait_window=False)
+            elif orphaned and not req.event.is_set():
+                raise RuntimeError(
+                    "placement request orphaned: no live dispatcher "
+                    "for its shape key and the request left the queue "
+                    "without a result (dispatcher thread died between "
+                    "queue pop and completion)")
         if req.error is not None:
             raise req.error
         return req.choices, req.scores
@@ -713,9 +751,26 @@ class PlacementBatcher:
                 self._full.wait(deadline - now)
 
     def _spawn_dispatcher(self, shape_key, config) -> None:
-        threading.Thread(
+        t = threading.Thread(
             target=self._dispatch, args=(shape_key, config, False),
-            daemon=True, name="placement-batch").start()
+            daemon=True, name="placement-batch")
+        try:
+            t.start()
+        except (RuntimeError, OSError):
+            # OS thread pressure. Un-claim the dispatcher slot the
+            # caller counted for us; the parked requesters' bounded
+            # wait in place() observes the ownerless queue and one of
+            # them claims dispatchership inline (self-rescue) — the
+            # work is late, never lost.
+            with self._lock:
+                remaining = self._dispatchers.get(shape_key, 1) - 1
+                if remaining > 0:
+                    self._dispatchers[shape_key] = remaining
+                else:
+                    self._dispatchers.pop(shape_key, None)
+            self.logger.warning(
+                "placement dispatcher thread failed to spawn; parked "
+                "requesters will self-rescue", exc_info=True)
 
     def _dispatch(self, shape_key, config, wait_window: bool) -> None:
         """Everything — including imports and the queue pop — runs

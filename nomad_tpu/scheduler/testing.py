@@ -16,6 +16,14 @@ from ..state import StateStore
 from ..structs import Evaluation, Plan, PlanResult, consts
 from . import new_scheduler
 
+# ntalint raft-funnel manifest (analysis/protocol.py): the Harness IS
+# the raft apply path of the CPU oracle — its sequential submit_plan
+# plays the role DevLog/FSM.apply play in a live cluster (and the
+# dry-run Job.Plan RPC runs it against a shadow store copy that is
+# never the live one). Store mutators inside it are the oracle's
+# commit, not a bypass.
+NTA_RAFT_FUNNELS = ("Harness.submit_plan",)
+
 
 class RejectPlan:
     """Planner that rejects every plan and forces a state refresh —

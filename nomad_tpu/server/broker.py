@@ -42,6 +42,15 @@ _TERMINAL_PARK_TRIGGERS = (
     consts.EVAL_TRIGGER_EXPIRED,
 )
 
+# ntalint raft-funnel manifest (analysis/protocol.py): the failed-queue
+# park is the broker's exactly-once terminal funnel. A shed/expired/
+# dead-letter stamp is only legal on a copy that flows into it — the
+# park feeds the leader reaper, which persists the terminal status
+# through raft (server.py _reap_failed_evals -> eval_update). The
+# _TERMINAL_PARK_TRIGGERS guard above is the dynamic half of the same
+# exactly-once contract.
+NTA_RAFT_FUNNELS = ("EvalBroker._park_failed_locked",)
+
 
 class _Heap:
     """Max-priority, FIFO-within-priority eval heap."""

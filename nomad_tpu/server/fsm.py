@@ -19,6 +19,32 @@ from ..structs import Allocation, Evaluation, Job, Node, consts
 from .. import trace
 from .timetable import TimeTable
 
+# ntalint raft-funnel manifest (analysis/protocol.py): THE sanctioned
+# commit path. State-store mutators and terminal status stamps are
+# only legal inside these handlers' whole-program call closure (or,
+# for stamps, on a copy that flows into an eval_update/alloc_update
+# submit in the same function). Everything here runs on the serialized
+# raft apply thread on every replica — the one place a write cannot
+# diverge or double-commit.
+NTA_RAFT_FUNNELS = (
+    "FSM.apply",
+    "FSM._apply_node_register",
+    "FSM._apply_node_deregister",
+    "FSM._apply_node_status",
+    "FSM._apply_node_drain",
+    "FSM._apply_job_register",
+    "FSM._apply_job_deregister",
+    "FSM._apply_eval_update",
+    "FSM._apply_eval_delete",
+    "FSM._apply_alloc_update",
+    "FSM._apply_alloc_client_update",
+    "FSM._apply_periodic_launch",
+    "FSM._apply_periodic_launch_delete",
+    "FSM._apply_vault_accessor_register",
+    "FSM._apply_vault_accessor_deregister",
+    "FSM.restore",
+)
+
 # Log message types (structs.go:40-53)
 NODE_REGISTER = "node_register"
 NODE_DEREGISTER = "node_deregister"

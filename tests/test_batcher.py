@@ -185,3 +185,60 @@ def test_pad_ladders():
     assert len(_pad_rows(list(range(300)))) == ROW_BUCKETS[1]
     # Beyond the ladder: fall back to pow2.
     assert len(_pad_rows(list(range(5000)))) == 8192
+
+
+def test_place_self_rescues_when_dispatcher_never_runs(monkeypatch):
+    """PR 7 regression (ntalint unbounded-wait): place() used to park
+    on a bare event.wait() — a dispatcher whose thread failed to spawn
+    (Thread.start under OS thread pressure) left its requesters wedged
+    forever. The bounded wait now observes the ownerless queue twice
+    and claims dispatchership inline (self-rescue)."""
+    batcher = PlacementBatcher(window=0.01)
+    state, asks, key = tiny_inputs(seed=5)
+
+    real_dispatch = PlacementBatcher._dispatch
+    died_once = []
+
+    def flaky(self, shape_key, config, wait_window):
+        if not died_once:
+            # First dispatcher: its thread "never starts" — the
+            # failed-spawn path un-claims the slot and does no work.
+            died_once.append(shape_key)
+            with self._lock:
+                self._dispatchers.pop(shape_key, None)
+            return None
+        return real_dispatch(self, shape_key, config, wait_window)
+
+    monkeypatch.setattr(PlacementBatcher, "_dispatch", flaky)
+
+    result = {}
+
+    def run():
+        result["v"] = batcher.place(state, asks, key, CONFIG)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "place() wedged: self-rescue did not fire"
+    assert "v" in result
+    choices, scores = result["v"]
+    direct_c, direct_s, _ = placement_program_jit(state, asks, key, CONFIG)
+    np.testing.assert_array_equal(choices, np.asarray(direct_c))
+    np.testing.assert_allclose(scores, np.asarray(direct_s), rtol=1e-5)
+
+
+def test_spawn_dispatcher_start_failure_unclaims_slot(monkeypatch):
+    """Thread.start failing inside _spawn_dispatcher must release the
+    dispatcher slot it was counted for — otherwise the queue looks
+    owned forever and no self-rescue can trigger."""
+    batcher = PlacementBatcher(window=0.01)
+
+    def boom(self):
+        raise RuntimeError("can't start new thread")
+
+    monkeypatch.setattr(threading.Thread, "start", boom)
+    with batcher._lock:
+        batcher._dispatchers["shape"] = 1
+    batcher._spawn_dispatcher("shape", CONFIG)
+    with batcher._lock:
+        assert batcher._dispatchers.get("shape", 0) == 0

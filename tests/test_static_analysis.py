@@ -952,3 +952,750 @@ def test_real_recorder_record_path_is_clean():
     assert rec_mod.NTA_RECORD_PATH  # the manifest exists and is non-empty
     assert [f for f in findings
             if f.rule == "record-path-blocking"] == []
+
+
+# =====================================================================
+# PR 7: whole-program analysis — cross-module reachability, deadlock
+# detection, raft-funnel protocol, caches, SARIF.
+# =====================================================================
+
+
+def run_dir(tmp_path, files):
+    """Write {relpath: source} under tmp_path and analyze the tree."""
+    for rel, src in files.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    return analyze_paths([str(tmp_path)])
+
+
+# ---------------------------------------------------------------------
+# cross-module dispatcher reachability: the acceptance fixture pair.
+# The SAME logic, split across two modules: analyzed one module at a
+# time (the PR 2-era intra-module graph), the pipeline looks clean —
+# whole-program analysis follows the import and flags the sleep two
+# calls deep in the helper.
+
+
+XMOD_PIPE = """\
+from helper import nap_for
+
+NTA_DISPATCHER_ENTRYPOINTS = ("Pipe._run",)
+
+class Pipe:
+    def _run(self):
+        while True:
+            self._accumulate()
+            nap_for(7)
+
+    def _accumulate(self):
+        pass
+"""
+
+XMOD_HELPER = """\
+import time
+
+def nap_for(n):
+    _snooze(n)
+
+def _snooze(n):
+    time.sleep(0.01)
+"""
+
+XMOD_PIPE_POOLED = """\
+from helper import nap_for
+
+NTA_DISPATCHER_ENTRYPOINTS = ("Pipe._run",)
+
+class Pipe:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def _run(self):
+        while True:
+            self._accumulate()
+            # handed to a stage thread, not called: not followed
+            self.pool.submit(nap_for, 7)
+
+    def _accumulate(self):
+        pass
+"""
+
+
+def test_cross_module_dispatcher_blocking_v1_intra_module_is_blind(
+        tmp_path):
+    """v1 of the pair: the pipeline module ALONE (exactly what the
+    intra-module call graph saw) carries no finding — the blocking
+    call lives behind the import boundary."""
+    (tmp_path / "helper.py").write_text(XMOD_HELPER)
+    pipe = tmp_path / "pipe.py"
+    pipe.write_text(XMOD_PIPE)
+    assert analyze_paths([str(pipe)]) == []
+
+
+def test_cross_module_dispatcher_blocking_v2_whole_program_flags(
+        tmp_path):
+    """v2: the same code analyzed whole-program — the sleep TWO
+    modules deep (pipe._run -> helper.nap_for -> helper._snooze) is a
+    dispatcher-blocking-call, reported at the sleep with the entry
+    chain as the witness."""
+    findings = run_dir(tmp_path, {"pipe.py": XMOD_PIPE,
+                                  "helper.py": XMOD_HELPER})
+    assert rules_of(findings) == ["dispatcher-blocking-call"]
+    f = findings[0]
+    assert f.path.endswith("helper.py")
+    assert f.symbol == "_snooze"
+    assert "Pipe._run" in f.message
+    assert f.related and any("pipe.py" in loc for loc in f.related)
+
+
+def test_cross_module_dispatcher_quiet_on_pool_submitted_reference(
+        tmp_path):
+    """The pool-submitted reference is NOT followed: handing the
+    helper to a stage thread is the sanctioned fix."""
+    assert run_dir(tmp_path, {"pipe.py": XMOD_PIPE_POOLED,
+                              "helper.py": XMOD_HELPER}) == []
+
+
+# ---------------------------------------------------------------------
+# cross-module unbounded-wait: a wait-scope dir calling into a utils
+# helper that parks forever.
+
+
+XWAIT_SERVER = """\
+from helper import wait_done
+
+class Serv:
+    def run(self, ev):
+        wait_done(ev)
+"""
+
+XWAIT_HELPER = """\
+def wait_done(ev):
+    ev.wait()
+"""
+
+XWAIT_SERVER_POOLED = """\
+from helper import wait_done
+
+class Serv:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def run(self, ev):
+        self.pool.submit(wait_done, ev)
+"""
+
+
+def test_cross_module_unbounded_wait_flagged_in_helper(tmp_path):
+    findings = run_dir(tmp_path, {"server/mod.py": XWAIT_SERVER,
+                                  "utils/helper.py": XWAIT_HELPER})
+    assert rules_of(findings) == ["unbounded-wait"]
+    f = findings[0]
+    assert f.path.endswith("utils/helper.py")
+    assert f.symbol == "wait_done"
+    assert "Serv.run" in f.message
+
+
+def test_cross_module_unbounded_wait_pooled_reference_not_followed(
+        tmp_path):
+    assert run_dir(tmp_path, {"server/mod.py": XWAIT_SERVER_POOLED,
+                              "utils/helper.py": XWAIT_HELPER}) == []
+
+
+def test_unbounded_wait_now_covers_scheduler_dir(tmp_path):
+    """scheduler/ joined the wait scope in PR 7 (the dense path parks
+    worker threads there — the batcher's request wait was the real
+    finding this surfaced)."""
+    findings = run_on(tmp_path, UNBOUNDED_BAD, subdir="scheduler")
+    assert rules_of(findings) == ["unbounded-wait"] * 3
+
+
+# ---------------------------------------------------------------------
+# deadlock-cycle: seeded TP/TN fixtures.
+
+
+DEADLOCK_2 = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+DEADLOCK_2_CONSISTENT = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ab2(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+DEADLOCK_3 = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def step1(self):
+        with self._a:
+            self._grab_b()
+
+    def _grab_b(self):
+        with self._b:
+            pass
+
+    def step2(self):
+        with self._b:
+            self._grab_c()
+
+    def _grab_c(self):
+        with self._c:
+            pass
+
+    def step3(self):
+        with self._c:
+            self._grab_a()
+
+    def _grab_a(self):
+        with self._a:
+            pass
+"""
+
+DEADLOCK_COND_ALIAS_TP = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other = threading.Lock()
+
+    def through_cond(self):
+        # Holding the cond IS holding _lock: this is a _lock -> _other
+        # edge.
+        with self._cond:
+            with self._other:
+                pass
+
+    def reverse(self):
+        with self._other:
+            with self._lock:
+                pass
+"""
+
+DEADLOCK_COND_ALIAS_TN = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def nested_alias(self):
+        # cond and its backing lock are ONE lock: no distinct-lock
+        # edge, no cycle.
+        with self._cond:
+            with self._lock:
+                pass
+
+    def other_order(self):
+        with self._lock:
+            with self._cond:
+                pass
+"""
+
+
+def test_deadlock_two_lock_cycle(tmp_path):
+    findings = run_on(tmp_path, DEADLOCK_2)
+    assert rules_of(findings) == ["deadlock-cycle"]
+    msg = findings[0].message
+    assert "_a" in msg and "_b" in msg and "Witness" in msg
+
+
+def test_deadlock_quiet_on_consistent_order(tmp_path):
+    assert run_on(tmp_path, DEADLOCK_2_CONSISTENT) == []
+
+
+def test_deadlock_three_lock_cycle_with_witness_path(tmp_path):
+    """The acceptance fixture: a->b->c->a through three functions,
+    each acquisition behind a call — the witness must carry the full
+    acquisition path."""
+    findings = run_on(tmp_path, DEADLOCK_3)
+    assert rules_of(findings) == ["deadlock-cycle"]
+    f = findings[0]
+    msg = f.message
+    for lock in ("_a", "_b", "_c"):
+        assert lock in msg
+    # the witness names the call chain into at least one acquisition
+    assert "_grab_" in msg
+    assert f.related  # edge sites for CI annotation surfaces
+
+
+def test_deadlock_condition_alias_edge_fires(tmp_path):
+    findings = run_on(tmp_path, DEADLOCK_COND_ALIAS_TP)
+    assert rules_of(findings) == ["deadlock-cycle"]
+    assert "_other" in findings[0].message
+
+
+def test_deadlock_condition_alias_is_not_a_cycle(tmp_path):
+    assert run_on(tmp_path, DEADLOCK_COND_ALIAS_TN) == []
+
+
+DEADLOCK_XMOD_A = """\
+import threading
+from other import grab_right
+
+LEFT = threading.Lock()
+
+def left_then_right():
+    with LEFT:
+        grab_right()
+
+def grab_left():
+    with LEFT:
+        pass
+"""
+
+DEADLOCK_XMOD_B = """\
+import threading
+from mod import grab_left
+
+RIGHT = threading.Lock()
+
+def grab_right():
+    with RIGHT:
+        pass
+
+def right_then_left():
+    with RIGHT:
+        grab_left()
+"""
+
+
+def test_deadlock_cross_module_cycle(tmp_path):
+    """The classic two-thread wrap-around with no nesting in any one
+    module: mod holds LEFT and calls into other (RIGHT); other holds
+    RIGHT and calls back into mod (LEFT)."""
+    findings = run_dir(tmp_path, {"mod.py": DEADLOCK_XMOD_A,
+                                  "other.py": DEADLOCK_XMOD_B})
+    assert rules_of(findings) == ["deadlock-cycle"]
+    msg = findings[0].message
+    assert "LEFT" in msg and "RIGHT" in msg
+
+
+def test_deadlock_detector_silent_on_real_tree():
+    """The real (fixed) tree has a cycle-free lock order."""
+    assert [f for f in _tree_findings()
+            if f.rule == "deadlock-cycle"] == []
+
+
+# ---------------------------------------------------------------------
+# raft-funnel protocol checker.
+
+
+FUNNEL_STAMP_BAD = """\
+class Broker:
+    def finish(self, ev):
+        # terminal stamped on a shared eval, never routed through the
+        # funnel: commits nowhere (or twice, later).
+        ev.status = consts.EVAL_STATUS_COMPLETE
+        return ev
+"""
+
+FUNNEL_MUTATOR_BAD = """\
+class Svc:
+    def rewrite(self, store, evals):
+        store.upsert_evals(7, evals)
+"""
+
+FUNNEL_SUBMIT_GOOD = """\
+class Reaper:
+    def reap(self, ev):
+        upd = ev.copy()
+        upd.status = consts.EVAL_STATUS_FAILED
+        self.server.eval_update([upd])
+
+    def reap_many(self, evs):
+        cancelled = []
+        for ev in evs:
+            upd = ev.copy()
+            upd.status = consts.EVAL_STATUS_CANCELLED
+            cancelled.append(upd)
+        self.server.eval_update(cancelled)
+"""
+
+FUNNEL_MANIFEST_GOOD = """\
+NTA_RAFT_FUNNELS = ("Fsm.apply_eval",)
+
+class Fsm:
+    def apply_eval(self, index, evals):
+        self._commit(index, evals)
+
+    def _commit(self, index, evals):
+        # reachable from the declared funnel: sanctioned
+        self.state.upsert_evals(index, evals)
+"""
+
+FUNNEL_PARK_GOOD = """\
+NTA_RAFT_FUNNELS = ("Broker._park",)
+
+class Broker:
+    def shed(self, ev):
+        dead = ev.copy()
+        dead.triggered_by = consts.EVAL_TRIGGER_SHED
+        self._park(dead)
+
+    def _park(self, ev):
+        self.failed[ev.id] = ev
+"""
+
+FUNNEL_PARK_BAD = """\
+class Broker:
+    def shed(self, ev):
+        ev.triggered_by = consts.EVAL_TRIGGER_SHED
+        return ev
+"""
+
+
+def test_raft_funnel_flags_unrouted_terminal_stamp(tmp_path):
+    findings = run_on(tmp_path, FUNNEL_STAMP_BAD, subdir="server")
+    assert rules_of(findings) == ["raft-funnel"]
+    assert findings[0].symbol == "Broker.finish"
+    assert "EVAL_STATUS_COMPLETE" in findings[0].message
+
+
+def test_raft_funnel_flags_store_mutator_outside_funnel(tmp_path):
+    findings = run_on(tmp_path, FUNNEL_MUTATOR_BAD, subdir="dispatch")
+    assert rules_of(findings) == ["raft-funnel"]
+    assert "upsert_evals" in findings[0].message
+
+
+def test_raft_funnel_quiet_when_stamp_flows_into_eval_update(tmp_path):
+    """Both the direct [upd] argument and the one-container-hop
+    (cancelled.append(upd); eval_update(cancelled)) idioms are the
+    sanctioned stamp-a-copy-then-submit shape."""
+    assert run_on(tmp_path, FUNNEL_SUBMIT_GOOD, subdir="server") == []
+
+
+def test_raft_funnel_quiet_inside_declared_funnel(tmp_path):
+    assert run_on(tmp_path, FUNNEL_MANIFEST_GOOD, subdir="server") == []
+
+
+def test_raft_funnel_park_trigger_needs_funnel_flow(tmp_path):
+    good = run_on(tmp_path, FUNNEL_PARK_GOOD, subdir="server",
+                  name="good.py")
+    assert good == []
+    bad = run_on(tmp_path, FUNNEL_PARK_BAD, subdir="server2",
+                 name="bad.py")
+    assert rules_of(bad) == ["raft-funnel"]
+    assert "EVAL_TRIGGER_SHED" in bad[0].message
+
+
+def test_raft_funnel_client_dir_out_of_scope(tmp_path):
+    """The client owns its local status lifecycle; it commits through
+    the alloc_client_update RPC, which IS the funnel."""
+    assert run_on(tmp_path, FUNNEL_STAMP_BAD, subdir="client") == []
+
+
+def test_raft_funnel_inline_suppression(tmp_path):
+    src = FUNNEL_MUTATOR_BAD.replace(
+        "store.upsert_evals(7, evals)",
+        "store.upsert_evals(7, evals)  # nta: disable=raft-funnel")
+    assert run_on(tmp_path, src, subdir="state") == []
+
+
+def test_raft_funnel_clean_on_real_tree_with_fsm_manifest():
+    """Acceptance: the real tree passes with NTA_RAFT_FUNNELS naming
+    the fsm/apply funnels (+ the broker's exactly-once park and the
+    CPU-oracle harness apply), with ZERO baseline entries for the
+    rule."""
+    from nomad_tpu.server import fsm
+
+    assert fsm.NTA_RAFT_FUNNELS
+    assert all(q.startswith("FSM.") for q in fsm.NTA_RAFT_FUNNELS)
+    assert [f for f in _tree_findings() if f.rule == "raft-funnel"] == []
+    assert [e for e in load_baseline() if e["rule"] == "raft-funnel"] == []
+
+
+# ---------------------------------------------------------------------
+# self-checks: the concurrency core passes every NEW rule with no
+# baseline and no findings at all (not even baselined ones).
+
+
+NEW_RULES = ("deadlock-cycle", "raft-funnel", "dispatcher-blocking-call",
+             "record-path-blocking", "unbounded-wait")
+
+
+def test_new_rules_raw_clean_in_baseline_free_dirs():
+    core = CORE_DIRS  # dispatch/scheduler/ops/parallel/trace/admission/models
+    offenders = [f for f in _tree_findings()
+                 if f.rule in NEW_RULES and f.path.startswith(core)]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+
+
+def test_real_server_dispatch_admission_pass_program_rules():
+    """The acceptance self-check: the live server/, dispatch/ and
+    admission/ modules satisfy the whole-program rules with an empty
+    baseline (server/ allows inline-suppressed findings — the shadow
+    store dry-run — but nothing baselined)."""
+    findings = _tree_findings()
+    new, _stale = apply_baseline(findings, load_baseline())
+    dirs = ("nomad_tpu/server/", "nomad_tpu/dispatch/",
+            "nomad_tpu/admission/")
+    offenders = [f for f in new
+                 if f.rule in NEW_RULES and f.path.startswith(dirs)]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["rule"] in NEW_RULES and e["path"].startswith(dirs)] == []
+
+
+# ---------------------------------------------------------------------
+# caches.
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    """The per-file cache keys on content sha: editing the file must
+    re-analyze it (a mtime-keyed cache would serve stale findings)."""
+    f = tmp_path / "m.py"
+    f.write_text(GUARDED_BAD)
+    assert rules_of(analyze_paths([str(f)])) == ["guarded-by"] * 2
+    f.write_text(GUARDED_GOOD)
+    assert analyze_paths([str(f)]) == []
+    f.write_text(GUARDED_BAD)
+    assert rules_of(analyze_paths([str(f)])) == ["guarded-by"] * 2
+
+
+def test_repeated_whole_tree_analysis_is_cached():
+    """Second whole-tree run must come from the in-process caches —
+    this is what keeps the tier-1 suite inside its wall-clock now that
+    the program pass exists."""
+    import time as _time
+
+    _tree_findings()  # ensure warm
+    t0 = _time.monotonic()
+    _tree_findings()
+    warm = _time.monotonic() - t0
+    assert warm < 1.0, f"cached whole-tree run took {warm:.2f}s"
+
+
+def test_disk_cache_round_trip(tmp_path):
+    from nomad_tpu.analysis import (clear_caches, load_disk_cache,
+                                    save_disk_cache)
+
+    target = os.path.join(REPO, "nomad_tpu", "trace")
+    try:
+        clear_caches()
+        before = [f.render() for f in analyze_paths([target])]
+        cache_file = str(tmp_path / "cache.json")
+        save_disk_cache(cache_file)
+        clear_caches()
+        load_disk_cache(cache_file)
+        after = [f.render() for f in analyze_paths([target])]
+        assert after == before
+    finally:
+        clear_caches()  # leave no half-primed state for other tests
+
+
+# ---------------------------------------------------------------------
+# CLI: SARIF + cache flags (the tools/ smoke tests).
+
+
+def test_cli_sarif_mode(tmp_path):
+    f = tmp_path / "fix.py"
+    f.write_text(GUARDED_BAD)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ntalint.py"),
+         "--sarif", "--no-baseline", "--no-cache", str(f)],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1, res.stderr
+    sarif = json.loads(res.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ntalint"
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"deadlock-cycle", "raft-funnel",
+            "dispatcher-blocking-call"} <= rules
+    results = run["results"]
+    assert [r["ruleId"] for r in results] == ["guarded-by"] * 2
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 11
+    assert loc["artifactLocation"]["uri"].endswith("fix.py")
+
+
+def test_cli_disk_cache_flag(tmp_path):
+    f = tmp_path / "fix.py"
+    f.write_text(GUARDED_BAD)
+    cache = str(tmp_path / "c.json")
+    for _ in range(2):
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ntalint.py"),
+             "--json", "--no-baseline", "--cache", cache, str(f)],
+            capture_output=True, text=True, timeout=120)
+        assert res.returncode == 1, res.stderr
+        out = json.loads(res.stdout)
+        assert [e["rule"] for e in out["findings"]] == ["guarded-by"] * 2
+    assert os.path.exists(cache)
+
+
+DEADLOCK_THROUGH_RECURSION = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._l1 = threading.Lock()
+        self._l2 = threading.Lock()
+
+    def a_warm(self):
+        # Sorts before 'holder' and walks the recursive pair first: a
+        # memoized-DFS closure would cache the cycle-cut partial
+        # result for g and mask the edge below.
+        self.g(1)
+
+    def g(self, n):
+        self.h(n)
+
+    def h(self, n):
+        self.g(n - 1)
+        self.z()
+
+    def z(self):
+        with self._l2:
+            pass
+
+    def holder(self):
+        with self._l1:
+            self.g(3)
+
+    def reverse(self):
+        with self._l2:
+            with self._l1:
+                pass
+"""
+
+
+def test_deadlock_edge_survives_call_graph_recursion(tmp_path):
+    """The acquisition closure is a worklist fixpoint, not a memoized
+    DFS: locks reachable only through a call-graph cycle (g <-> h,
+    with h also reaching the acquire) must still produce the edge —
+    and the cycle — no matter which function warms the closure
+    first."""
+    findings = run_on(tmp_path, DEADLOCK_THROUGH_RECURSION)
+    assert rules_of(findings) == ["deadlock-cycle"]
+    assert "_l1" in findings[0].message and "_l2" in findings[0].message
+
+
+FUNNEL_STAMP_AFTER_SUBMIT = """\
+class Reaper:
+    def reap(self, ev):
+        self.server.eval_update([ev])
+        # stamped AFTER the submit: the terminal never reaches raft
+        ev.status = consts.EVAL_STATUS_FAILED
+"""
+
+
+def test_raft_funnel_stamp_after_submit_is_flagged(tmp_path):
+    """The flow scan is order-sensitive: a funnel call ABOVE the stamp
+    does not sanction it — mutating the shared eval after submitting
+    is the lost-terminal bug, not the stamp-a-copy idiom."""
+    findings = run_on(tmp_path, FUNNEL_STAMP_AFTER_SUBMIT,
+                      subdir="server")
+    assert rules_of(findings) == ["raft-funnel"]
+
+
+def test_stdlib_import_does_not_suffix_match_repo_modules():
+    """In-repo importers resolve imports exactly: `import select` in a
+    nomad_tpu module must NOT resolve to nomad_tpu/scheduler/select.py
+    (a phantom edge into scheduler/ would mint false deadlock/
+    dispatcher findings the moment a name collides). The suffix
+    fallback exists only for fixture trees, whose rel paths are
+    absolute."""
+    from nomad_tpu.analysis.core import Module, Program
+
+    importer = Module(
+        "fake.py", "nomad_tpu/utils/fake_pool.py",
+        "import select\nimport http\n\n"
+        "def tick():\n    select.poll()\n    http.client()\n")
+    target = Module(
+        "select.py", "nomad_tpu/scheduler/select.py",
+        "def poll():\n    pass\n")
+    program = Program([importer, target])
+    key = ("nomad_tpu/utils/fake_pool.py", "tick")
+    assert program.calls[key] == set(), (
+        f"stdlib import misresolved: {program.calls[key]}")
+    # the fixture-tree fallback still works for out-of-repo importers
+    fix_imp = Module("/tmp/x/main.py", "/tmp/x/main.py",
+                     "from helper import nap\n\ndef f():\n    nap()\n")
+    fix_help = Module("/tmp/x/helper.py", "/tmp/x/helper.py",
+                      "def nap():\n    pass\n")
+    p2 = Program([fix_imp, fix_help])
+    assert p2.calls[("/tmp/x/main.py", "f")] == {
+        ("/tmp/x/helper.py", "nap")}
+
+
+FUNNEL_GENERIC_NAME_LEAK = """\
+NTA_RAFT_FUNNELS = ("FSM.apply",)
+
+class FSM:
+    def apply(self, index, payload):
+        pass
+
+class Other:
+    def leak(self, ev):
+        ev.status = consts.EVAL_STATUS_CANCELLED
+        self.breaker.apply(ev)
+"""
+
+FUNNEL_APPEND_THEN_STAMP = """\
+class R:
+    def reap(self, evs):
+        out = []
+        for ev in evs:
+            upd = ev.copy()
+            out.append(upd)
+            upd.status = consts.EVAL_STATUS_FAILED
+        self.server.eval_update(out)
+"""
+
+
+def test_raft_funnel_generic_manifest_name_does_not_sanction(tmp_path):
+    """Funnel calls are matched by RESOLUTION against the declared
+    entries, not by bare method name: 'FSM.apply' in the manifest must
+    not let any `.apply()` call anywhere sanction a terminal stamp."""
+    findings = run_on(tmp_path, FUNNEL_GENERIC_NAME_LEAK,
+                      subdir="server")
+    assert rules_of(findings) == ["raft-funnel"]
+    assert findings[0].symbol == "Other.leak"
+
+
+def test_raft_funnel_append_before_stamp_is_sanctioned(tmp_path):
+    """The container holds a reference: append-then-stamp-then-submit
+    commits the terminal exactly like stamp-then-append. Only the
+    SUBMIT must come after the stamp."""
+    assert run_on(tmp_path, FUNNEL_APPEND_THEN_STAMP,
+                  subdir="server") == []

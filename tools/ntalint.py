@@ -3,9 +3,20 @@
 
 Usage:
     python tools/ntalint.py nomad_tpu/              # full tree
-    python tools/ntalint.py --diff                  # changed files only
+    python tools/ntalint.py --diff                  # changed region only
     python tools/ntalint.py --json nomad_tpu/ops    # machine-readable
+    python tools/ntalint.py --sarif nomad_tpu/      # CI annotations
     python tools/ntalint.py --write-baseline nomad_tpu/
+
+Caching: findings are cached on (file sha, jit-registry digest,
+RULESET_VERSION) per file for local rules and on the whole-tree digest
+for program rules, persisted in .ntalint-cache.json at the repo root
+(--no-cache disables). `--diff` analyzes the full tree (whole-program
+rules NEED the full graph — that is the point of them) but reuses the
+cache for everything unchanged and REPORTS only the changed region:
+findings in changed files, plus program-rule findings whose witness
+chain (`related`) touches a changed file — the strongly-connected
+slice of the call graph the edit could have affected.
 
 Exit codes: 0 = no non-baselined findings (stale baseline entries are
 reported but do not fail the CLI; the tier-1 test DOES fail on them so
@@ -25,15 +36,25 @@ _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 
 from nomad_tpu.analysis import (  # noqa: E402
+    ALL_RULES,
+    RULESET_VERSION,
     analyze_paths,
     apply_baseline,
     load_baseline,
+    load_disk_cache,
+    save_disk_cache,
     write_baseline,
 )
 
+DEFAULT_CACHE = os.path.join(_ROOT, ".ntalint-cache.json")
+
 
 def _git_changed_files() -> list:
-    """Tracked-changed + untracked .py files under nomad_tpu/."""
+    """Tracked-changed + untracked .py files under nomad_tpu/.
+    DELETED files stay in the list: removing a module (or a manifest)
+    changes the whole-program graph in ways no witness chain can name
+    — the caller detects the missing path and disables region
+    filtering for that run rather than exit 0 on a real regression."""
     out = []
     for cmd in (
         ["git", "diff", "--name-only", "HEAD"],
@@ -49,10 +70,70 @@ def _git_changed_files() -> list:
         for line in res.stdout.splitlines():
             line = line.strip()
             if line.endswith(".py") and line.startswith("nomad_tpu/"):
-                path = os.path.join(_ROOT, line)
-                if os.path.exists(path):
-                    out.append(path)
+                out.append(line)
     return sorted(set(out))
+
+
+def _in_region(f, changed: set) -> bool:
+    """True when a finding belongs to the changed region: it lives in
+    a changed file, or its witness chain passes through one."""
+    if f.path in changed:
+        return True
+    for loc in f.related or ():
+        rel = loc.rsplit(":", 1)[0]
+        if rel in changed:
+            return True
+    return False
+
+
+def _to_sarif(findings) -> dict:
+    """SARIF 2.1.0 for CI annotation surfaces (GitHub code scanning
+    et al.). Witness chains ride along as relatedLocations."""
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message
+                        + (f" [{f.symbol}]" if f.symbol else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+            }],
+        }
+        if f.related:
+            related = []
+            for loc in f.related:
+                rel, _sep, line = loc.rpartition(":")
+                try:
+                    lineno = max(1, int(line))
+                except ValueError:
+                    rel, lineno = loc, 1
+                related.append({
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": rel},
+                        "region": {"startLine": lineno},
+                    },
+                    "message": {"text": "witness path"},
+                })
+            result["relatedLocations"] = related
+        results.append(result)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ntalint",
+                "version": RULESET_VERSION,
+                "rules": [{"id": r} for r in ALL_RULES],
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -64,9 +145,11 @@ def main(argv=None) -> int:
                              "(default: nomad_tpu/)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output")
+    parser.add_argument("--sarif", action="store_true",
+                        help="SARIF 2.1.0 output (CI annotations)")
     parser.add_argument("--diff", action="store_true",
-                        help="analyze only files changed vs git HEAD "
-                             "(plus untracked)")
+                        help="report only the changed call-graph "
+                             "region vs git HEAD (plus untracked)")
     parser.add_argument("--baseline", default=None,
                         help="baseline file (default: "
                              "nomad_tpu/analysis/baseline.json)")
@@ -77,27 +160,60 @@ def main(argv=None) -> int:
                              "findings and exit 0")
     parser.add_argument("--rule", action="append", default=None,
                         help="restrict to specific rule(s)")
+    parser.add_argument("--cache", default=DEFAULT_CACHE,
+                        help="findings cache file (default: "
+                             ".ntalint-cache.json at the repo root)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write the cache")
     args = parser.parse_args(argv)
+    if args.json and args.sarif:
+        parser.error("--json and --sarif are mutually exclusive")
 
+    use_cache = not args.no_cache
+    if use_cache:
+        load_disk_cache(args.cache)
+
+    changed = None
     if args.diff:
-        paths = _git_changed_files()
-        if not paths:
+        changed = set(_git_changed_files())
+        if not changed:
             if args.json:
                 # Same schema as the analyzed path (consumers read
                 # total_raw unconditionally), plus the files count.
                 print(json.dumps({"findings": [], "stale_baseline": [],
                                   "total_raw": 0, "files": 0}))
+            elif args.sarif:
+                print(json.dumps(_to_sarif([])))
             else:
                 print("ntalint: no changed python files under "
                       "nomad_tpu/")
             return 0
+        # Whole-program rules need the whole program: analyze the full
+        # tree (the cache absorbs the unchanged files), filter below.
+        # A DELETED module is a graph-shape change whose fallout lands
+        # in unchanged files with witnesses that cannot name it — no
+        # region filter can attribute that, so report everything.
+        if any(not os.path.exists(os.path.join(_ROOT, rel))
+               for rel in changed):
+            print("ntalint: deleted file(s) in diff — reporting the "
+                  "full tree (region attribution impossible)",
+                  file=sys.stderr)
+            changed = None
+        paths = [os.path.join(_ROOT, "nomad_tpu")]
     else:
         paths = args.paths or [os.path.join(_ROOT, "nomad_tpu")]
 
     rules = set(args.rule) if args.rule else None
     findings = analyze_paths(paths, rules=rules)
+    if use_cache:
+        try:
+            save_disk_cache(args.cache)
+        except OSError:
+            pass  # read-only checkout: the cache is an optimization
 
     if args.write_baseline:
+        # Always from the FULL findings: region-filtering a baseline
+        # write would silently truncate entries for unchanged files.
         path = write_baseline(findings, args.baseline)
         print(f"ntalint: wrote {len(findings)} finding(s) to {path}")
         return 0
@@ -105,10 +221,19 @@ def main(argv=None) -> int:
     if args.no_baseline:
         new, stale = findings, []
     else:
+        # Baseline BEFORE the region filter: staleness is a whole-tree
+        # judgment — an entry for an unchanged file still matches its
+        # finding, and must not be reported "fixed" just because the
+        # file is outside today's diff.
         new, stale = apply_baseline(findings,
                                     load_baseline(args.baseline))
 
-    if args.json:
+    if changed is not None:
+        new = [f for f in new if _in_region(f, changed)]
+
+    if args.sarif:
+        print(json.dumps(_to_sarif(new), indent=2))
+    elif args.json:
         print(json.dumps({
             "findings": [f.to_dict() for f in new],
             "stale_baseline": stale,
