@@ -38,10 +38,14 @@ HEADLINE_CONFIG = 4  # the north-star 10k-node/50k-alloc scenario
 
 
 def build_cluster(n_nodes, datacenters=("dc1",), meta_partitions=0,
-                  allocs_per_node=0, seed=0):
+                  allocs_per_node=0, seed=0, alloc_skew=0,
+                  filler_cpu=(50, 100), filler_mem=(64, 128)):
     """A mock cluster: nodes spread over datacenters, optional 'rack'
     meta partitions (stack_test.go's 64-way partition shape), optional
-    pre-existing allocations consuming capacity."""
+    pre-existing allocations consuming capacity. alloc_skew > 0 makes
+    the pre-load HETEROGENEOUS — each node carries rng.randint(0,
+    alloc_skew) filler allocs instead of a uniform count — the
+    fragmentation-prone shape the --kernel-ab arm measures on."""
     from nomad_tpu import mock
     from nomad_tpu.state import StateStore
     from nomad_tpu.structs import consts
@@ -50,7 +54,7 @@ def build_cluster(n_nodes, datacenters=("dc1",), meta_partitions=0,
     store = StateStore()
     index = 0
     filler = None
-    if allocs_per_node:
+    if allocs_per_node or alloc_skew:
         filler = mock.job()
         filler.id = "filler"
         filler.type = "service"
@@ -63,9 +67,12 @@ def build_cluster(n_nodes, datacenters=("dc1",), meta_partitions=0,
         node.compute_class()
         index += 1
         store.upsert_node(index, node)
-        if allocs_per_node:
+        n_fill = allocs_per_node
+        if alloc_skew:
+            n_fill = rng.randint(0, alloc_skew)
+        if n_fill:
             allocs = []
-            for _ in range(allocs_per_node):
+            for _ in range(n_fill):
                 alloc = mock.alloc()
                 alloc.node_id = node.id
                 alloc.job_id = filler.id
@@ -74,8 +81,8 @@ def build_cluster(n_nodes, datacenters=("dc1",), meta_partitions=0,
                 alloc.client_status = consts.ALLOC_CLIENT_RUNNING
                 # modest footprint so nodes stay schedulable
                 for tr in alloc.task_resources.values():
-                    tr.cpu = rng.choice([50, 100])
-                    tr.memory_mb = rng.choice([64, 128])
+                    tr.cpu = rng.choice(list(filler_cpu))
+                    tr.memory_mb = rng.choice(list(filler_mem))
                     tr.networks = []
                 alloc.resources = None
                 allocs.append(alloc)
@@ -216,7 +223,7 @@ def bench_tpu(store, job, k_placements, batch, rounds, tg_cycle=None,
 
 
 def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
-                  workers=None, pre_resolve=True):
+                  workers=None, pre_resolve=True, kernel="greedy"):
     """Honest FULL-PATH dense measurement (VERDICT r4 ask #2): per
     eval — ClusterMatrix build (live shared-base cache), ask
     construction, a coalesced batcher dispatch, exact host-side port
@@ -260,14 +267,16 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     tg_cycle = tg_cycle or [0] * k_placements
     penalty = 5.0 if job.type == "batch" else 10.0
     config = PlacementConfig(anti_affinity_penalty=penalty,
-                             pre_resolve=pre_resolve)
+                             pre_resolve=pre_resolve, kernel=kernel)
     # Mirror the live dense scheduler (scheduler/tpu.py): a uniform
-    # distinct-hosts ask set takes the one-pass top_k program.
+    # distinct-hosts ask set takes the one-pass top_k program
+    # (greedy-only; other kernels run their own joint solve).
     from nomad_tpu.ops.binpack import uniform_dh_flag
 
     _probe_asks = ClusterMatrix(snap, job).build_asks(tg_cycle)
-    config = config._replace(uniform_dh=uniform_dh_flag(
-        tg_cycle, _probe_asks[5], _probe_asks[6]))
+    config = config._replace(uniform_dh=(
+        kernel == "greedy" and uniform_dh_flag(
+            tg_cycle, _probe_asks[5], _probe_asks[6])))
     from nomad_tpu.chaos import chaos
     from nomad_tpu.trace import (
         STAGE_DEVICE_DISPATCH,
@@ -313,7 +322,8 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         for attempt in range(3):
             try:
                 choices, scores = batcher.place(
-                    matrix, asks, host_prng_key(seed), config)
+                    matrix, asks, host_prng_key(seed), config,
+                    span=(eid, ""))
                 break
             except Exception:
                 if not chaos.enabled or attempt == 2:
@@ -370,7 +380,10 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
 
     def verify_round(results):
         """Sequential capacity claims over one round's placements;
-        returns the number of evals that would replan."""
+        returns (evals that would replan, the round's ADMITTED claimed
+        utilization) — the same applier-admission rule feeds both the
+        conflict count and the quality columns, so the two can't
+        drift."""
         claimed_util = np.zeros_like(vmatrix.util)
         claimed_bw = np.zeros_like(vmatrix.bw_used)
         claimed_ports = np.zeros_like(vmatrix.ports_free)
@@ -396,7 +409,7 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
                 claimed_bw[c] += v_bw[j]
                 claimed_ports[c] += v_ports[j]
             conflicted += bad
-        return conflicted
+        return conflicted, claimed_util
 
     # Warm EVERY batch bucket the dispatcher can produce (plus the
     # full size twice): ragged accumulation means a measured round can
@@ -429,8 +442,12 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     elapsed = time.perf_counter() - start
     # Verification outside the timed window: production pays it on the
     # applier thread, overlapped with the next dispatch.
+    first_round_claims = None
     for results in round_results:
-        conflicted_evals += verify_round(results)
+        conflicted, claimed = verify_round(results)
+        conflicted_evals += conflicted
+        if first_round_claims is None:
+            first_round_claims = claimed
     stats1 = batcher.stats()
     pool.shutdown(wait=False)
     assert placed_total > 0, "e2e path placed nothing"
@@ -450,6 +467,27 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     dstats["transfer_bytes_per_batch"] = (
         dstats.get("upload_bytes", 0) / max(dstats.get("dispatches", 0), 1))
     dstats["jit_recompiles"] = dstats.get("jit_cache_size", 0)
+    # Placement-quality columns (nomad_tpu/kernels/quality): score the
+    # committed cluster state one round of this workload produces —
+    # base utilization plus the round's verified sequential claims
+    # (verify_round: exactly what the applier would admit) — against
+    # the job's own ask. queueing_delay_ms here is the harness
+    # measurement of the quality contract's "p99 time placement work
+    # spent queued": this path has no broker, so the queue is the
+    # batcher — place() round-trip p99 minus the jitted solve's p99
+    # (both from the flight recorder; 0 when --no-trace disabled it).
+    # The live configs measure the same contract at THEIR queue, the
+    # broker (broker.wait p99 via the quality board).
+    from nomad_tpu.kernels.quality import quality_from_arrays
+
+    q = quality_from_arrays(vmatrix.util + first_round_claims,
+                            vmatrix.capacity, vmatrix.node_ok, v_res[0])
+    dstats["fragmentation"] = q["fragmentation"]
+    dstats["binpack_score"] = q["binpack_score"]
+    stages = recorder.stage_stats()
+    dd = stages.get("device.dispatch", {}).get("p99_ms", 0.0)
+    sv = stages.get("device.solve", {}).get("p99_ms", 0.0)
+    dstats["queueing_delay_ms"] = max(0.0, dd - sv)
     return (n_evals / elapsed, float(np.percentile(latencies, 99)),
             dstats)
 
@@ -475,6 +513,17 @@ def config_1():
         "e2e": e2e_rate, "e2e_p99_ms": e2e_p99 * 1000,
         "occupancy": ds["occupancy"],
         "retries_per_eval": ds["conflicts_per_eval"],
+        **_quality_cols(ds),
+    }
+
+
+def _quality_cols(ds):
+    """The placement-quality columns every config reports
+    (kernels/quality.py: fragmentation / bin-pack / queueing)."""
+    return {
+        "fragmentation": ds.get("fragmentation", 0.0),
+        "binpack_score": ds.get("binpack_score", 0.0),
+        "queueing_delay_ms": ds.get("queueing_delay_ms", 0.0),
     }
 
 
@@ -493,6 +542,7 @@ def config_2():
         "e2e": e2e_rate, "e2e_p99_ms": e2e_p99 * 1000,
         "occupancy": ds["occupancy"],
         "retries_per_eval": ds["conflicts_per_eval"],
+        **_quality_cols(ds),
     }
 
 
@@ -527,6 +577,12 @@ def config_3():
         "occupancy": (ds_s["occupancy"] + ds_b["occupancy"]) / 2,
         "retries_per_eval": (ds_s["conflicts_per_eval"]
                              + ds_b["conflicts_per_eval"]) / 2,
+        "fragmentation": (ds_s["fragmentation"]
+                          + ds_b["fragmentation"]) / 2,
+        "binpack_score": (ds_s["binpack_score"]
+                          + ds_b["binpack_score"]) / 2,
+        "queueing_delay_ms": max(ds_s["queueing_delay_ms"],
+                                 ds_b["queueing_delay_ms"]),
     }
 
 
@@ -558,6 +614,7 @@ def config_4():
         "device_retries": ds["device_retries"] + ds_off["device_retries"],
         "transfer_bytes_per_batch": ds["transfer_bytes_per_batch"],
         "jit_recompiles": ds["jit_recompiles"],
+        **_quality_cols(ds),
     }
 
 
@@ -625,36 +682,45 @@ def _system_drain_storm(n_nodes, n_jobs, rack_partition):
             harness.process(scheduler_name, ev)
             latencies.append(time.perf_counter() - t0)
         elapsed = time.perf_counter() - start
-        return len(evals) / elapsed, float(np.percentile(latencies, 99))
+        return (len(evals) / elapsed, float(np.percentile(latencies, 99)),
+                harness)
 
-    cpu_rate, cpu_p99 = run("system")
-    dense_rate, dense_p99 = run("system-tpu")
-    return cpu_rate, cpu_p99, dense_rate, dense_p99
+    cpu_rate, cpu_p99, _h = run("system")
+    dense_rate, dense_p99, h_dense = run("system-tpu")
+    # Quality columns from the COMMITTED post-storm store (the harness
+    # applies plans sequentially — exactly the oracle's commit).
+    from nomad_tpu.kernels.quality import quality_from_store
+
+    q = quality_from_store(h_dense.state.snapshot(),
+                           h_dense.state.job_by_id("sys-0"))
+    return cpu_rate, cpu_p99, dense_rate, dense_p99, q
 
 
 def config_5():
     """Blueprint-scale drain storm (BASELINE.json config 5): 10k nodes
     x 200 rack-scoped system jobs, 10% drained."""
-    cpu_rate, cpu_p99, dense_rate, dense_p99 = _system_drain_storm(
+    cpu_rate, cpu_p99, dense_rate, dense_p99, q = _system_drain_storm(
         10_000, 200, rack_partition=True)
     return {
         "name": ("drain storm: 10k nodes x 200 system jobs (rack-scoped),"
                  " 10% drained (host stack vs dense pass)"),
         "cpu": cpu_rate, "cpu_p99_ms": cpu_p99 * 1000,
         "e2e": dense_rate, "e2e_p99_ms": dense_p99 * 1000,
+        **_quality_cols(q),
     }
 
 
 def config_5s():
     """Smoke-scale drain storm (kept for quick runs): 1k x 50,
     unconstrained (every job spans every node)."""
-    cpu_rate, cpu_p99, dense_rate, dense_p99 = _system_drain_storm(
+    cpu_rate, cpu_p99, dense_rate, dense_p99, q = _system_drain_storm(
         1000, 50, rack_partition=False)
     return {
         "name": ("drain storm smoke: 1k nodes x 50 system jobs, 10% "
                  "drained (host stack vs dense pass)"),
         "cpu": cpu_rate, "cpu_p99_ms": cpu_p99 * 1000,
         "e2e": dense_rate, "e2e_p99_ms": dense_p99 * 1000,
+        **_quality_cols(q),
     }
 
 
@@ -712,6 +778,9 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
         return job
 
     def run(factories):
+        from nomad_tpu.kernels.quality import get_board
+
+        get_board().reset()  # per-arm attribution, not cross-run
         server = Server(ServerConfig(
             num_schedulers=4, scheduler_factories=factories,
             eval_nack_timeout=60.0))
@@ -810,6 +879,10 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
             # protecting itself, not the dense path — --check gates
             # dense-path numbers on this column staying zero.
             dstats["broker"] = server.broker.stats()
+            # Placement-quality scoreboard (kernels/quality.py): the
+            # dense run's committed-plan medians + broker-wait p99.
+            dstats["placement_quality"] = server.stats()[
+                "placement_quality"]
             return (n_jobs / storm_elapsed, success,
                     float(np.percentile(lat, 99)), dstats)
         finally:
@@ -930,6 +1003,20 @@ def _live_result(name, cpu_rate, cpu_success, cpu_lone_p99,
             / max(dstats.get("dispatches", 0), 1)),
         "jit_recompiles": dstats.get("jit_cache_size", 0),
         "prefetch_bytes": pipe.get("prefetch_bytes", 0),
+        **_live_quality_cols(dstats.get("placement_quality", {})),
+    }
+
+
+def _live_quality_cols(pq):
+    """Quality columns for the live configs, read off the server's
+    placement_quality snapshot: the ACTIVE kernel's medians (one
+    kernel per run) + the broker-wait queueing p99."""
+    kernels = pq.get("kernels", {})
+    q = next(iter(kernels.values()), {}) if kernels else {}
+    return {
+        "fragmentation": q.get("fragmentation", 0.0),
+        "binpack_score": q.get("binpack_score", 0.0),
+        "queueing_delay_ms": pq.get("queueing_delay_ms", 0.0),
     }
 
 
@@ -1044,6 +1131,11 @@ def _summarize(n, runs, reps):
             "median"]
         out["metric"] += (
             f" (pre-resolve OFF: {out['retries_per_eval_nopre']:.3f})")
+    if "fragmentation" in cols:
+        out["metric"] += (
+            f"; quality: frag={cols['fragmentation']['median']:.3f}, "
+            f"binpack={cols['binpack_score']['median']:.3f}, "
+            f"queue_p99={cols['queueing_delay_ms']['median']:.1f}ms")
     # Per-stage latency attribution from the flight recorder
     # (nomad_tpu/trace): where each eval's time went across the reps —
     # the in-system answer to "what is the p99 made of". Empty when
@@ -1426,12 +1518,174 @@ def run_resident_ab(reps=DEFAULT_REPS):
     }
 
 
+def config_frag_heavy(kernel="greedy"):
+    """Fragmentation-heavy A/B workload (the --kernel-ab second arm):
+    200 nodes with SKEWED light pre-load (0-3 filler allocs per node —
+    heterogeneous headroom) taking 16 LARGE asks per eval (~40% of a
+    node on cpu and mem, so 2 fit and a third strands the remainder).
+    This is the shape where the greedy tie-break noise scatters
+    placements across near-tie nodes and strands headroom; the convex
+    kernel's joint solve sees all 16 asks and the load landscape at
+    once and packs a deliberate node set."""
+    # CHUNKY skewed pre-load (~half an ask per filler): node headrooms
+    # land at 1.2x-2.6x the ask, so which headroom CLASS a kernel
+    # fills decides how much capacity strands — the axis BestFit (and
+    # its tie-break noise) cannot see.
+    store, _ = build_cluster(200, alloc_skew=3, seed=17,
+                             filler_cpu=(600, 800),
+                             filler_mem=(1200, 1600))
+    job = service_job(networks=False)
+    job.task_groups[0].count = 16
+    tg = job.task_groups[0].tasks[0]
+    tg.resources.cpu = 1500
+    tg.resources.memory_mb = 3000
+    # batch=8: one 8-eval pre-resolved batch claims ~25% of the
+    # cluster — contended enough that packing choices matter, not so
+    # full that every node strands and the kernels converge.
+    e2e_rate, e2e_p99, ds = bench_tpu_e2e(
+        store, job, 16, batch=8, rounds=3, kernel=kernel)
+    return {
+        "name": "frag-heavy: 200 nodes skewed pre-load, 16x 40%-asks",
+        "e2e": e2e_rate, "e2e_p99_ms": e2e_p99 * 1000,
+        "occupancy": ds["occupancy"],
+        "jit_recompiles": ds["jit_recompiles"],
+        **_quality_cols(ds),
+    }
+
+
+def config_4_kernel(kernel="greedy"):
+    """Config 4's cluster shape with a pinned kernel (the --kernel-ab
+    first arm): the north-star 10k-node scenario, e2e only."""
+    store, _ = build_cluster(10_000, datacenters=("dc1", "dc2"),
+                             allocs_per_node=5)
+    job = service_job(networks=True, distinct_hosts=True)
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    e2e_rate, e2e_p99, ds = bench_tpu_e2e(
+        store, job, 8, batch=64, rounds=3, kernel=kernel)
+    return {
+        "name": "10k nodes, 50k allocs, ports + distinct_hosts",
+        "e2e": e2e_rate, "e2e_p99_ms": e2e_p99 * 1000,
+        "occupancy": ds["occupancy"],
+        "jit_recompiles": ds["jit_recompiles"],
+        **_quality_cols(ds),
+    }
+
+
+KERNEL_AB_ARMS = {"config4": config_4_kernel, "frag_heavy": config_frag_heavy}
+KERNEL_AB_KERNELS = ("greedy", "convex")
+
+
+def run_kernel_ab(reps=3, check=False):
+    """Throughput + quality A/B of the registered kernels (greedy vs
+    convex) on config 4's shape and the fragmentation-heavy arm ->
+    BENCH_r11.json. Interleaved reps (greedy then convex back to back
+    per rep) so host drift hits both; medians reported. With --check,
+    every kernel must first pass the oracle differential rig
+    (kernels/differential.py) — red rigs refuse to report — and
+    steady-state jit recompiles must stay 0."""
+    from nomad_tpu.trace import get_recorder
+
+    if check:
+        from nomad_tpu.kernels.differential import run_differential
+
+        for kernel in KERNEL_AB_KERNELS:
+            report = run_differential(kernel)
+            if not report["green"]:
+                for v in report["violations"]:
+                    print(f"bench: {v}", file=sys.stderr)
+                print(f"bench: REFUSING to report kernel numbers: "
+                      f"kernel {kernel!r} failed the oracle "
+                      f"differential rig ({len(report['violations'])} "
+                      f"violations across {report['cases']} cases)",
+                      file=sys.stderr)
+                sys.exit(2)
+            print(f"bench: kernel {kernel!r} oracle differential green "
+                  f"({report['cases']} cases)", file=sys.stderr)
+
+    arms = {}
+    for arm_name, builder in KERNEL_AB_ARMS.items():
+        runs = {k: [] for k in KERNEL_AB_KERNELS}
+        for _ in range(reps):
+            for kernel in KERNEL_AB_KERNELS:
+                get_recorder().reset()
+                runs[kernel].append(builder(kernel=kernel))
+        per_kernel = {}
+        for kernel, rr in runs.items():
+            cols = {}
+            for key in rr[0]:
+                if key == "name":
+                    continue
+                med, iqr = _median_iqr([float(r[key]) for r in rr])
+                cols[key] = {"median": round(med, 4),
+                             "iqr": round(iqr, 4)}
+            per_kernel[kernel] = cols
+        g, c = per_kernel["greedy"], per_kernel["convex"]
+        speed_ratio = (c["e2e"]["median"] / g["e2e"]["median"]
+                       if g["e2e"]["median"] else 0.0)
+        arms[arm_name] = {
+            "name": runs["greedy"][0]["name"],
+            "kernels": per_kernel,
+            "convex_vs_greedy": {
+                "speed_ratio": round(speed_ratio, 3),
+                "fragmentation_delta": round(
+                    c["fragmentation"]["median"]
+                    - g["fragmentation"]["median"], 4),
+                "binpack_delta": round(
+                    c["binpack_score"]["median"]
+                    - g["binpack_score"]["median"], 4),
+                # The acceptance bar: quality improves (lower frag or
+                # higher binpack) at >= 0.5x greedy's throughput.
+                "quality_improved": bool(
+                    c["fragmentation"]["median"]
+                    < g["fragmentation"]["median"] - 1e-9
+                    or c["binpack_score"]["median"]
+                    > g["binpack_score"]["median"] + 1e-9),
+                "speed_ok": bool(speed_ratio >= 0.5),
+            },
+        }
+        if check:
+            for kernel in KERNEL_AB_KERNELS:
+                rec = per_kernel[kernel]["jit_recompiles"]["median"]
+                if rec:
+                    print(f"bench: REFUSING kernel-ab numbers: kernel "
+                          f"{kernel!r} recompiled mid-measurement on "
+                          f"arm {arm_name!r} (jit_recompiles={rec})",
+                          file=sys.stderr)
+                    sys.exit(2)
+
+    accepted = any(a["convex_vs_greedy"]["quality_improved"]
+                   and a["convex_vs_greedy"]["speed_ok"]
+                   for a in arms.values())
+    summary = "; ".join(
+        f"{name}: convex {a['convex_vs_greedy']['speed_ratio']:.2f}x "
+        f"speed, frag {a['kernels']['convex']['fragmentation']['median']:.3f}"
+        f" vs {a['kernels']['greedy']['fragmentation']['median']:.3f}, "
+        f"binpack {a['kernels']['convex']['binpack_score']['median']:.3f}"
+        f" vs {a['kernels']['greedy']['binpack_score']['median']:.3f}"
+        for name, a in arms.items())
+    return {
+        "metric": f"[kernel-ab greedy vs convex, median-of-{reps}] "
+                  + summary,
+        "arms": arms,
+        "acceptance_quality_at_half_speed": accepted,
+    }
+
+
+# The dirs the --check gates sweep. Module constants so the ntalint
+# self-checks (tests/test_static_analysis.py) can assert the kernels
+# subsystem is inside both gates rather than trusting a string copy.
+PURITY_GATE_DIRS = ("ops", "scheduler", "kernels")
+CONCURRENCY_GATE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
+                         "nomad_tpu/server/", "nomad_tpu/kernels/")
+
+
 def ntalint_purity_gate():
-    """Trace-purity findings in the kernel path (ops/, scheduler/)
-    invalidate dense-path numbers BY CONSTRUCTION: an impure call or a
-    host sync inside a jitted program means the benchmark measured a
-    host fallback or a trace-time constant, not the device path it
-    claims to. Returns the non-baselined findings."""
+    """Trace-purity findings in the kernel path (ops/, scheduler/,
+    kernels/) invalidate dense-path numbers BY CONSTRUCTION: an impure
+    call or a host sync inside a jitted program means the benchmark
+    measured a host fallback or a trace-time constant, not the device
+    path it claims to. Returns the non-baselined findings."""
     import os
 
     from nomad_tpu.analysis import (
@@ -1450,8 +1704,7 @@ def ntalint_purity_gate():
                     purity.RULE_CLOSURE_MUT, purity.RULE_BRANCH,
                     purity.RULE_STATIC, "parse-error"}
     findings = analyze_paths(
-        [os.path.join(root, "nomad_tpu", "ops"),
-         os.path.join(root, "nomad_tpu", "scheduler")],
+        [os.path.join(root, "nomad_tpu", d) for d in PURITY_GATE_DIRS],
         rules=purity_rules)
     new, _stale = apply_baseline(findings, load_baseline())
     return new
@@ -1482,9 +1735,7 @@ def ntalint_concurrency_gate():
         [os.path.join(root, "nomad_tpu")],
         rules={RULE_DEADLOCK, RULE_FUNNEL, "parse-error"})
     new, _stale = apply_baseline(findings, load_baseline())
-    gated = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
-             "nomad_tpu/server/")
-    return [f for f in new if f.path.startswith(gated)]
+    return [f for f in new if f.path.startswith(CONCURRENCY_GATE_DIRS)]
 
 
 def main():
@@ -1515,6 +1766,15 @@ def main():
                         help="device-resident state ON/OFF A/B on "
                              "config 4 (models/resident.py) — the "
                              "BENCH_r10 arm")
+    parser.add_argument("--kernel-ab", action="store_true",
+                        help="placement-kernel A/B (nomad_tpu/kernels):"
+                             " greedy vs convex on config 4's shape + "
+                             "a fragmentation-heavy arm, throughput "
+                             "and quality columns — the BENCH_r11 arm."
+                             " With --check, kernels must pass the "
+                             "oracle differential rig first")
+    parser.add_argument("--kernel-ab-reps", type=int, default=3,
+                        help="interleaved reps per kernel-ab arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -1561,6 +1821,11 @@ def main():
               f"{HEADLINE_CONFIG}` for the gated traced-vs-untraced "
               "comparison (the purity gate above DID run)",
               file=sys.stderr)
+
+    if args.kernel_ab:
+        print(json.dumps(run_kernel_ab(reps=args.kernel_ab_reps,
+                                       check=args.check)))
+        return
 
     if args.resident_ab:
         out = run_resident_ab(reps=args.reps)

@@ -47,6 +47,10 @@ class ServerBlock:
     # the delta-vs-rebuild row threshold (0 = auto).
     device_resident: Optional[bool] = None
     resident_rebuild_rows: Optional[int] = None
+    # Placement kernel (nomad_tpu/kernels): the dense solve the *-tpu
+    # factories run ("greedy" / "convex" / a plugin's); validated at
+    # server init.
+    placement_kernel: Optional[str] = None
     # Overload protection (nomad_tpu/admission; server/config.py):
     # bounded broker ready queues, eval deadlines, the token-bucket
     # intake gate, and the device-path circuit breaker.
@@ -212,6 +216,7 @@ _SCHEMA: Dict[str, Any] = {
     "server.dispatch_pipeline": bool, "server.dispatch_max_inflight": int,
     "server.dense_pre_resolve": bool,
     "server.device_resident": bool, "server.resident_rebuild_rows": int,
+    "server.placement_kernel": str,
     "server.eval_ready_cap": int, "server.eval_deadline_ttl": float,
     "server.admission_enabled": bool, "server.breaker_enabled": bool,
     "server.breaker_failure_threshold": int,

@@ -673,6 +673,10 @@ def cmd_agent(args) -> int:
         if cfg.server.resident_rebuild_rows is not None:
             server_cfg.resident_rebuild_rows = (
                 cfg.server.resident_rebuild_rows)
+        # Placement kernel (nomad_tpu/kernels); Server init validates,
+        # so a typo'd name aborts agent startup with the known list.
+        if cfg.server.placement_kernel is not None:
+            server_cfg.placement_kernel = cfg.server.placement_kernel
         # Overload protection (nomad_tpu/admission): bounded broker
         # queues, deadlines, intake gate, device-path breaker.
         if cfg.server.eval_ready_cap is not None:

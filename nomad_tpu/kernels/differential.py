@@ -1,0 +1,273 @@
+"""Oracle differential rig: any registered kernel vs the sequential
+CPU oracle.
+
+The validity contract (kernels/__init__.py): a placement kernel may
+trade placement QUALITY but never VALIDITY. This rig is the
+enforcement — for a spread of seeded randomized clusters (mixed
+resource shapes, pre-existing load, datacenter/rack constraints,
+distinct-hosts, drained nodes) it runs one evaluation through the
+kernel-under-test's scheduler factory (``service-<kernel>-tpu`` /
+``batch-<kernel>-tpu`` — the same registry seam production selection
+uses) against the scheduler test Harness, then has the ORACLE judge
+every placement the kernel emitted:
+
+- **plan-apply accepted** — ``server.plan_apply.evaluate_node_plan``
+  (the live applier's per-node verification, plan_apply.go:318) must
+  accept every node the plan touches against the pre-eval snapshot;
+- **capacity never exceeded** — ``allocs_fit`` over each node's
+  proposed set (existing live allocs minus evictions plus the plan's
+  placements);
+- **feasibility** — every chosen node individually passes the HOST
+  iterator stack (``GenericStack.select`` pinned to that node on a
+  fresh context): constraints, drivers, readiness — the oracle's own
+  feasibility chain, not the dense mask's;
+- **distinct-hosts honored** — no two allocs of the job (or of a
+  distinct-hosts task group) share a node, counting pre-existing
+  live allocs;
+- the eval itself completes (no crash-and-nack).
+
+The oracle's own run on an identical cluster is recorded alongside
+(placed counts) so quality drift is visible in the report, but count
+parity is deliberately NOT asserted — that is the quality axis the
+scoreboard measures, not the validity axis this rig enforces.
+
+bench.py --check consumes ``run_differential`` and refuses to report
+kernel numbers whose rig is red; tests/test_kernels.py sweeps it
+property-style.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+DEFAULT_SEEDS = range(7000, 7012)
+
+
+def build_scenario(seed: int):
+    """(seed_state_fn, job) for one rig case. Counts stay >= 4 so the
+    dense bulk path engages (the dense schedulers route <= 3
+    placements to the host iterators — a rig case that never reached
+    the kernel would vacuously pass)."""
+    from .. import mock
+    from ..structs import Constraint, consts
+
+    rng = random.Random(seed)
+    n_nodes = rng.choice([6, 9, 17, 33])
+    dc_count = rng.choice([1, 2])
+    use_networks = rng.random() < 0.4
+    use_racks = rng.random() < 0.5
+    distinct = rng.random() < 0.4
+    preload = rng.random() < 0.5
+    drain_frac = rng.choice([0.0, 0.0, 0.2, 0.4])
+    job_type = rng.choice(["service", "batch"])
+    count = rng.choice([4, 6, 11, 24])
+    cpu = rng.choice([100, 333, 900])
+    mem = rng.choice([64, 300, 700])
+
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.datacenter = f"dc{i % dc_count + 1}"
+        if use_racks:
+            node.meta["rack"] = f"r{i % 4}"
+        if i % 3 == 0:  # heterogeneous capacity: some nodes half-size
+            node.resources.cpu //= 2
+            node.resources.memory_mb //= 2
+        node.compute_class()
+        nodes.append(node)
+    drained = [n.id for n in nodes[: int(n_nodes * drain_frac)]]
+
+    filler_allocs = []
+    if preload:
+        filler = mock.job()
+        filler.id = "filler"
+        for i, node in enumerate(nodes):
+            if i % 2:
+                continue
+            a = mock.alloc()
+            a.node_id, a.job_id, a.job = node.id, filler.id, filler
+            a.desired_status = consts.ALLOC_DESIRED_RUN
+            a.client_status = consts.ALLOC_CLIENT_RUNNING
+            for tr in a.task_resources.values():
+                tr.cpu = rng.choice([200, 700])
+                tr.memory_mb = rng.choice([128, 512])
+                tr.networks = []
+            a.resources = None
+            filler_allocs.append(a)
+
+    def seed_state(h, job):
+        # All store writes route through the oracle's sanctioned
+        # fixture funnel (scheduler/testing.py seed_harness_cluster):
+        # kernels/ never touches the state store directly — the
+        # ntalint raft-funnel self-check asserts exactly that.
+        from ..scheduler.testing import seed_harness_cluster
+
+        seed_harness_cluster(h, nodes=nodes, allocs=filler_allocs,
+                             jobs=[job.copy()], drained=drained)
+
+    job = mock.job()
+    job.type = job_type
+    job.datacenters = [f"dc{d + 1}" for d in range(dc_count)]
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.resources.cpu = cpu
+    task.resources.memory_mb = mem
+    if not use_networks:
+        task.resources.networks = []
+    if use_racks and rng.random() < 0.5:
+        job.constraints.append(Constraint(
+            ltarget="${meta.rack}", operand="regexp", rtarget="^r[01]$"))
+    if distinct:
+        job.constraints.append(
+            Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS))
+    return seed_state, job
+
+
+def _oracle_feasible(snap, job, tg, node) -> bool:
+    """The HOST feasibility chain's verdict on one node for one task
+    group: a fresh single-node iterator stack must yield it."""
+    from ..scheduler.context import EvalContext
+    from ..scheduler.stack import GenericStack
+    from ..structs import Plan
+
+    ctx = EvalContext(snap, Plan(job=job), rng=random.Random(0))
+    stack = GenericStack(job.type == "batch", ctx)
+    stack.set_job(job)
+    stack.set_nodes([node])
+    option, _ = stack.select(tg)
+    return option is not None
+
+
+def _check_case(kernel: str, seed: int) -> List[str]:
+    """Run one rig case; returns the list of violation strings."""
+    from ..scheduler.testing import Harness
+    from ..server.plan_apply import evaluate_node_plan
+    from ..structs import allocs_fit, consts, new_eval, remove_allocs
+
+    seed_state, job = build_scenario(seed)
+    factory = f"{job.type}-{kernel}-tpu"
+
+    h = Harness(seed=seed)
+    seed_state(h, job)
+    snap = h.state.snapshot()
+    h.process(factory, new_eval(
+        h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    bad: List[str] = []
+    if not h.evals or h.evals[-1].status != consts.EVAL_STATUS_COMPLETE:
+        status = h.evals[-1].status if h.evals else "<none>"
+        bad.append(f"seed {seed}: eval did not complete ({status})")
+
+    job_dh = any(c.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+                 for c in job.constraints)
+    tg_by_name = {tg.name: tg for tg in job.task_groups}
+    for plan in h.plans:
+        for node_id, placed in plan.node_allocation.items():
+            node = snap.node_by_id(node_id)
+            if node is None:
+                bad.append(f"seed {seed}: placed on unknown node "
+                           f"{node_id}")
+                continue
+            # Plan-apply acceptance: the live applier's verification.
+            if not evaluate_node_plan(snap, plan, node_id):
+                bad.append(f"seed {seed}: plan-apply rejected node "
+                           f"{node_id}")
+            # Capacity: proposed set must fit (the applier's AllocsFit,
+            # spelled out so the failing dimension is named).
+            existing = snap.allocs_by_node_terminal(node_id, False)
+            updates = plan.node_update.get(node_id, [])
+            proposed = remove_allocs(existing, updates) + placed
+            for a in proposed:
+                if a.job is None:
+                    a.job = plan.job
+            fit, dim, _ = allocs_fit(node, proposed)
+            if not fit:
+                bad.append(f"seed {seed}: capacity exceeded on "
+                           f"{node_id}: {dim}")
+            # Oracle feasibility + distinct-hosts per placement.
+            this_job_live = [
+                a for a in existing
+                if a.job_id == job.id and not a.terminal_status()]
+            for alloc in placed:
+                tg = tg_by_name.get(alloc.task_group)
+                if tg is None:
+                    bad.append(f"seed {seed}: alloc names unknown task "
+                               f"group {alloc.task_group!r}")
+                    continue
+                if not _oracle_feasible(snap, job, tg, node):
+                    bad.append(
+                        f"seed {seed}: oracle rejects node {node_id} "
+                        f"for tg {tg.name} (kernel placed there)")
+                tg_dh = any(
+                    c.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+                    for c in tg.constraints)
+                if job_dh and (len(placed) + len(this_job_live)) > 1:
+                    bad.append(f"seed {seed}: distinct_hosts (job) "
+                               f"violated on {node_id}")
+                    break
+                if tg_dh:
+                    same_tg = ([a for a in placed
+                                if a.task_group == tg.name]
+                               + [a for a in this_job_live
+                                  if a.task_group == tg.name])
+                    if len(same_tg) > 1:
+                        bad.append(f"seed {seed}: distinct_hosts (tg "
+                                   f"{tg.name}) violated on {node_id}")
+                        break
+    return bad
+
+
+def _oracle_placed(seed: int) -> int:
+    """The sequential oracle's placed count on the identical cluster
+    (report context, not an assertion)."""
+    from ..scheduler.testing import Harness
+    from ..structs import consts, new_eval
+
+    seed_state, job = build_scenario(seed)
+    h = Harness(seed=seed)
+    seed_state(h, job)
+    h.process(job.type, new_eval(
+        h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    return len(h.state.allocs_by_job(job.id))
+
+
+def run_differential(kernel: str, seeds=DEFAULT_SEEDS,
+                     with_oracle_counts: bool = False) -> Dict:
+    """Run the rig for one kernel across `seeds`. Returns a report:
+    {"kernel", "cases", "violations": [...], "green": bool,
+     "placed": {seed: (kernel_placed, oracle_placed)}? }."""
+    from ..scheduler.testing import Harness  # noqa: F401 (fail fast on import)
+
+    violations: List[str] = []
+    placed: Dict[int, tuple] = {}
+    for seed in seeds:
+        violations.extend(_check_case(kernel, seed))
+        if with_oracle_counts:
+            from ..structs import consts, new_eval
+
+            seed_state, job = build_scenario(seed)
+            h = Harness(seed=seed)
+            seed_state(h, job)
+            h.process(f"{job.type}-{kernel}-tpu", new_eval(
+                h.state.job_by_id(job.id),
+                consts.EVAL_TRIGGER_JOB_REGISTER))
+            placed[seed] = (len(h.state.allocs_by_job(job.id)),
+                            _oracle_placed(seed))
+    report = {
+        "kernel": kernel,
+        "cases": len(list(seeds)),
+        "violations": violations,
+        "green": not violations,
+    }
+    if with_oracle_counts:
+        report["placed"] = placed
+    return report
+
+
+def assert_differential(kernel: str, seeds=DEFAULT_SEEDS) -> None:
+    report = run_differential(kernel, seeds)
+    assert report["green"], (
+        f"kernel {kernel!r} failed the oracle differential:\n"
+        + "\n".join(report["violations"]))

@@ -1,0 +1,209 @@
+"""Kernel-agnostic placement-quality scoreboard.
+
+Throughput (bench.py's evals/s columns) says how FAST a kernel
+places; nothing measured how WELL. This module scores committed
+placement decisions on the two axes Tesserae (PAPERS.md) evaluates
+placement policies on, plus the queueing axis the admission layer
+cares about:
+
+- **fragmentation** — the fraction of the cluster's free cpu+mem
+  capacity stranded on nodes that can no longer fit a reference ask
+  (free capacity you own but cannot sell). 0 = every free node still
+  fits the ask; 1 = all remaining headroom is unusable fragments.
+- **binpack_score** — mean fill fraction (max of cpu/mem) over the
+  OCCUPIED schedulable nodes: how tightly the used part of the
+  cluster is packed. Higher = tighter (BestFit's goal, measured).
+- **queueing_delay_ms** — p99 time placement work spent QUEUED
+  rather than computed/committed, measured at whichever queue the
+  harness has: on a live server that is the broker (the flight
+  recorder's ``broker.wait`` p99, what ``snapshot()`` reports); the
+  broker-less bench e2e harness measures its queue, the batcher
+  (``device.dispatch`` p99 minus ``device.solve`` p99).
+
+All three are computed from COMMITTED state — the dense schedulers
+feed the board from the post-placement claimed arrays right after
+appending to the plan (the applier re-verifies, so emitted == applied
+modulo the conflict retries the pipeline stats already count), and
+``quality_from_store`` recomputes from a live/oracle state store for
+bench columns and tests. The board never touches the state store and
+never blocks: bounded ring of samples under one leaf lock.
+
+Surfaces: ``server.stats()["placement_quality"]``, ``/v1/metrics``
+gauges (``placement_quality.*``), and bench.py's
+fragmentation/binpack_score/queueing_delay_ms columns + --kernel-ab.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+# Samples kept per kernel (ring, drop-oldest): enough for a stable
+# median over a storm, bounded so a long-lived server never grows.
+SAMPLE_CAP = 512
+# Steady-state sampling rate: scoring costs O(N) host work (a [N,4]
+# copy + a few full-array passes), which at 10k nodes x 64 concurrent
+# evals is real GIL time on the scheduler hot path — and a 512-sample
+# median needs nowhere near every eval. The first WARM_SAMPLES evals
+# per kernel always score (fast feedback on fresh servers / bench
+# arms); after that, 1 in SAMPLE_EVERY.
+WARM_SAMPLES = 64
+SAMPLE_EVERY = 8
+
+
+def quality_from_arrays(util, capacity, node_ok, ask_res) -> Dict[str, float]:
+    """Score one committed cluster state. `util`/`capacity` are the
+    dense [N, R] arrays (reserved included in util, exactly the kernel
+    accounting), `node_ok` the [N] readiness mask, `ask_res` the [R]
+    reference ask fragmentation is measured against (a job's task-group
+    ask). Returns {"fragmentation", "binpack_score"}."""
+    util = np.asarray(util, np.float64)
+    capacity = np.asarray(capacity, np.float64)
+    node_ok = np.asarray(node_ok, bool)
+    ask_res = np.asarray(ask_res, np.float64)
+
+    real = node_ok & (capacity[:, 0] > 0)
+    if not real.any():
+        return {"fragmentation": 0.0, "binpack_score": 0.0}
+    cap = capacity[real]
+    use = np.minimum(util[real], cap)
+    free = cap - use
+
+    # Fragmentation: free cpu+mem stranded on nodes that cannot fit
+    # the reference ask on EVERY dimension it asks for.
+    fits = np.ones(len(cap), bool)
+    for r in range(len(ask_res)):
+        if ask_res[r] > 0:
+            fits &= free[:, r] >= ask_res[r]
+    weight = free[:, 0] / max(cap[:, 0].max(), 1.0) + \
+        free[:, 1] / max(cap[:, 1].max(), 1.0)
+    total_free = float(weight.sum())
+    stranded = float(weight[~fits].sum())
+    fragmentation = stranded / total_free if total_free > 0 else 0.0
+
+    # Bin-pack utilization: mean max(cpu, mem) fill over occupied
+    # nodes (nodes carrying any cpu or mem load beyond zero).
+    frac = use[:, :2] / np.maximum(cap[:, :2], 1.0)
+    occupied = frac.max(axis=1) > 1e-9
+    binpack = float(frac[occupied].max(axis=1).mean()) if occupied.any() \
+        else 0.0
+    return {"fragmentation": fragmentation, "binpack_score": binpack}
+
+
+def quality_from_store(state, job) -> Dict[str, float]:
+    """Recompute the scoreboard metrics from a state store snapshot
+    (bench columns for host-path configs; differential-rig checks).
+    `job`'s first task group is the reference ask."""
+    from ..structs import allocs_fit
+
+    nodes = [n for n in state.nodes()]
+    n = len(nodes)
+    util = np.zeros((n, 4), np.float64)
+    capacity = np.zeros((n, 4), np.float64)
+    node_ok = np.zeros(n, bool)
+    for i, node in enumerate(nodes):
+        r = node.resources
+        capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+        node_ok[i] = node.ready()
+        live = [a for a in state.allocs_by_node(node.id)
+                if not a.terminal_status()]
+        _fit, _dim, used = allocs_fit(node, live)
+        util[i] = (used.cpu, used.memory_mb, used.disk_mb, used.iops)
+    return quality_from_arrays(
+        util, capacity, node_ok, reference_ask(job))
+
+
+def reference_ask(job) -> np.ndarray:
+    """[R] cpu/mem/disk/iops ask of the job's first task group — the
+    fragmentation reference."""
+    ask = np.zeros(4, np.float64)
+    if job is None or not job.task_groups:
+        return ask
+    tg = job.task_groups[0]
+    for task in tg.tasks:
+        r = task.resources
+        ask += (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+    if tg.ephemeral_disk:
+        ask[2] += tg.ephemeral_disk.size_mb
+    return ask
+
+
+class QualityBoard:
+    """Bounded per-kernel sample board. note_plan() is called on the
+    scheduler hot path right after a dense plan's placements are
+    appended: one leaf lock around ring bookkeeping, no allocation
+    proportional to anything unbounded, never blocks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # kernel -> preallocated rings (fragmentation, binpack) +
+        # write cursor; slot = count mod SAMPLE_CAP. guarded-by: _lock
+        self._rings: Dict[str, list] = {}
+        # kernel -> should_sample tick count. guarded-by: _lock
+        self._ticks: Dict[str, int] = {}
+
+    def should_sample(self, kernel: str) -> bool:
+        """Whether this eval should pay the O(N) scoring cost (see
+        WARM_SAMPLES/SAMPLE_EVERY): callers check BEFORE computing the
+        claimed state, so skipped evals cost two dict ops."""
+        with self._lock:
+            tick = self._ticks.get(kernel, 0)
+            self._ticks[kernel] = tick + 1
+        return tick < WARM_SAMPLES or tick % SAMPLE_EVERY == 0
+
+    def note_plan(self, kernel: str, fragmentation: float,
+                  binpack: float) -> None:
+        with self._lock:
+            ent = self._rings.get(kernel)
+            if ent is None:
+                ent = [np.zeros(SAMPLE_CAP), np.zeros(SAMPLE_CAP), 0]
+                self._rings[kernel] = ent
+            slot = ent[2] % SAMPLE_CAP
+            ent[0][slot] = fragmentation
+            ent[1][slot] = binpack
+            ent[2] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._ticks.clear()
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-kernel medians + sample counts, plus the queueing-delay
+        p99 from the flight recorder (one number — queueing happens
+        before a kernel is chosen, so it is cluster-wide)."""
+        from .. import trace
+
+        out: Dict[str, dict] = {}
+        with self._lock:
+            items = [(k, ent[0].copy(), ent[1].copy(), ent[2])
+                     for k, ent in self._rings.items()]
+        for kernel, frag, binp, count in items:
+            n = min(count, SAMPLE_CAP)
+            if not n:
+                continue
+            out[kernel] = {
+                "fragmentation": round(float(np.median(frag[:n])), 4),
+                "binpack_score": round(float(np.median(binp[:n])), 4),
+                "samples": count,
+            }
+        stages = trace.get_recorder().stage_stats()
+        wait = stages.get("broker.wait", {})
+        return {
+            "kernels": out,
+            "queueing_delay_ms": round(float(wait.get("p99_ms", 0.0)), 3),
+        }
+
+
+_global: Optional[QualityBoard] = None
+_global_lock = threading.Lock()
+
+
+def get_board() -> QualityBoard:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = QualityBoard()
+        return _global

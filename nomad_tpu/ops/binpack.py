@@ -83,6 +83,13 @@ class PlacementConfig(NamedTuple):
     # eligibility host-side; the flag is compile-time like the rest of
     # the config, so each case is its own cached program.
     uniform_dh: bool = False
+    # Placement kernel (nomad_tpu/kernels): which per-batch solve
+    # placement_program runs. "greedy" is the native sequential
+    # masked-argmax scan below; any other name resolves through the
+    # kernel registry at trace time. Static (a hashable str), so each
+    # kernel is its own cached XLA program and joins the batcher's
+    # shape key — kernels never share a dispatch.
+    kernel: str = "greedy"
 
 
 class NodeState(NamedTuple):
@@ -354,7 +361,19 @@ def placement_program(
     state: NodeState, asks: Asks, key, config: PlacementConfig
 ):
     """Run K sequential placements over the cluster as one compiled
-    program. Returns (choices [K] int32, scores [K] f32, final_state)."""
+    program. Returns (choices [K] int32, scores [K] f32, final_state).
+
+    config.kernel selects the solve: the default runs the sequential
+    masked-argmax scan below; anything else resolves through the
+    kernel registry (nomad_tpu/kernels) and runs in this program's
+    place — same signature, same validity mask, different solve. The
+    branch is on a STATIC config field, so it happens at trace time
+    and every batcher path (overlay/compact/pre-resolve/fused-delta)
+    carries any kernel unchanged."""
+    if config.kernel != "greedy":
+        from ..kernels import kernel_program
+
+        return kernel_program(config.kernel)(state, asks, key, config)
     if config.uniform_dh:
         return _uniform_topk_program(state, asks, key, config)
 

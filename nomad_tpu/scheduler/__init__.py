@@ -11,6 +11,7 @@ touching the control plane — the north-star design in BASELINE.json.
 
 from __future__ import annotations
 
+import functools
 import random
 from typing import Callable, Dict, Optional, Protocol, Tuple
 
@@ -79,21 +80,31 @@ register_scheduler(
 
 def _register_tpu_factories() -> None:
     """TPU-backed factories are registered lazily so importing the
-    scheduler package doesn't pull in JAX."""
+    scheduler package doesn't pull in JAX. Alongside the plain dense
+    factories (which run the process-global active placement kernel,
+    kernels.configure), every registered kernel K gets pinned
+    ``service-K-tpu`` / ``batch-K-tpu`` variants — the factory-seam
+    way to select a kernel per scheduler type (the differential rig
+    and A/B benches select through exactly this)."""
+    from ..kernels import kernel_names
     from .tpu import BatchedTPUScheduler, DenseSystemScheduler  # noqa
 
-    register_scheduler(
-        "service-tpu",
-        lambda logger, state, planner, rng=None: BatchedTPUScheduler(
-            logger, state, planner, batch=False, rng=rng
-        ),
-    )
-    register_scheduler(
-        "batch-tpu",
-        lambda logger, state, planner, rng=None: BatchedTPUScheduler(
-            logger, state, planner, batch=True, rng=rng
-        ),
-    )
+    def batched(kernel=None):
+        def factory(logger, state, planner, rng=None, *, batch):
+            return BatchedTPUScheduler(
+                logger, state, planner, batch=batch, rng=rng,
+                kernel=kernel)
+        return factory
+
+    for kernel in (None, *kernel_names()):
+        infix = "" if kernel is None else f"{kernel}-"
+        factory = batched(kernel)
+        register_scheduler(
+            f"service-{infix}tpu",
+            functools.partial(factory, batch=False))
+        register_scheduler(
+            f"batch-{infix}tpu",
+            functools.partial(factory, batch=True))
     register_scheduler(
         "system-tpu",
         lambda logger, state, planner, rng=None: DenseSystemScheduler(

@@ -89,10 +89,10 @@ NTA_REBUILD_ENTRYPOINTS = ("PlacementBatcher._build_device_base",)
 
 class _Request:
     __slots__ = ("token", "base", "overlay", "compact", "asks", "key",
-                 "delta", "event", "choices", "scores", "error")
+                 "delta", "event", "choices", "scores", "error", "span")
 
     def __init__(self, token, base, overlay, asks, key, delta=None,
-                 compact=None):
+                 compact=None, span=None):
         self.token = token  # cluster-base identity, None = unshared
         self.base = base  # (capacity, sched_capacity, util, bw_avail,
         #                    bw_used, ports_free, node_ok, class_ids)
@@ -105,6 +105,7 @@ class _Request:
         self.asks = asks
         self.key = key
         self.delta = delta  # (parent_token, changed_rows) or None
+        self.span = span  # (eval_id, trace_id) for the device.solve span
         self.event = threading.Event()
         self.choices = None
         self.scores = None
@@ -229,14 +230,18 @@ class PlacementBatcher:
             self._cohort_gen += 1
             self._full.notify_all()
 
-    def place(self, state, asks, rng_key, config):
+    def place(self, state, asks, rng_key, config, span=None):
         """Submit one eval's placement; blocks until its batch's device
         dispatch returns. Returns (choices, scores) for THIS request.
 
         `state` is anything exposing the NodeState field names
         (ops/binpack.NodeState itself, or models/matrix.ClusterMatrix —
         the latter also carries base_token, enabling the shared-base
-        device cache)."""
+        device cache). `span` is an optional (eval_id, trace_id) pair:
+        when set, the dispatcher records a `device.solve` span on that
+        eval covering the jitted solve itself (issue + device sync,
+        kernel-annotated) — the part of `device.dispatch` that is the
+        kernel, separated from batch-wait and stacking."""
         class_ids = getattr(state, "class_ids", None)
         if class_ids is None:
             # Plain NodeState callers (bench harness): no class index —
@@ -270,7 +275,7 @@ class PlacementBatcher:
         )
         req = _Request(token, base, overlay, asks, rng_key,
                        delta=getattr(state, "base_delta", None),
-                       compact=compact)
+                       compact=compact, span=span)
         run_dispatch = False
         with self._lock:
             if self._cohort > 0:
@@ -582,10 +587,13 @@ class PlacementBatcher:
             # against a stable snapshot — is exactly where re-uploading
             # the full [N,4] base every dispatch hurt most.
             req = batch[0]
+            t_solo = _time.perf_counter()
             choices, scores, _ = placement_program_jit(
                 req.full_state(), req.asks, req.key, config)
             req.choices = np.asarray(choices)
             req.scores = np.asarray(scores)
+            self._record_solve(batch, config,
+                               _time.perf_counter() - t_solo, 1)
             return
 
         # Pad the batch axis up a ladder bucket (see BATCH_BUCKETS):
@@ -707,6 +715,31 @@ class PlacementBatcher:
         for i, req in enumerate(batch):
             req.choices = choices[i]
             req.scores = scores[i]
+        self._record_solve(batch, config, t3 - t1, n_live)
+
+    def _record_solve(self, batch, config, dur: float,
+                      n_live: int) -> None:
+        """device.solve spans for the requests that carry a trace
+        identity: the jitted solve's issue + device sync window,
+        kernel-annotated — the slice of device.dispatch that IS the
+        placement kernel (batch-wait and host stacking excluded). The
+        duration was measured on perf_counter; the span is anchored to
+        the monotonic clock the recorder shares by subtracting it from
+        'now' (both clocks tick at the same rate)."""
+        if not any(r.span for r in batch):
+            return
+        import time as _time
+
+        from .. import trace
+
+        end = _time.monotonic()
+        ann = {"kernel": getattr(config, "kernel", "greedy"),
+               "batch": n_live}
+        for req in batch:
+            if req.span:
+                trace.record_span(
+                    req.span[0], trace.STAGE_DEVICE_SOLVE, end - dur,
+                    end, ann=ann, trace_id=req.span[1])
 
     def _accumulate(self, shape_key, window: float) -> None:
         """Wait up to `window` for requests to pile on — but a FULL

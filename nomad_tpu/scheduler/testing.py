@@ -21,8 +21,28 @@ from . import new_scheduler
 # plays the role DevLog/FSM.apply play in a live cluster (and the
 # dry-run Job.Plan RPC runs it against a shadow store copy that is
 # never the live one). Store mutators inside it are the oracle's
-# commit, not a bypass.
-NTA_RAFT_FUNNELS = ("Harness.submit_plan",)
+# commit, not a bypass. seed_harness_cluster is the rig-fixture twin:
+# registering nodes/jobs/load into a Harness's PRIVATE store is what
+# raft-applied registration does to a live one — and keeping it here
+# keeps the kernels/ differential rig itself store-mutator-free
+# (kernels never touch the state store; they only return plans).
+NTA_RAFT_FUNNELS = ("Harness.submit_plan", "seed_harness_cluster")
+
+
+def seed_harness_cluster(harness: "Harness", nodes=(), allocs=(),
+                         jobs=(), drained=()) -> None:
+    """Seed a Harness's store for a differential/parity case: nodes,
+    pre-existing allocations, jobs, then drain transitions — the
+    oracle-side fixture path (see the funnel note above)."""
+    for node in nodes:
+        harness.state.upsert_node(harness.next_index(), node)
+    if allocs:
+        harness.state.upsert_allocs(harness.next_index(), list(allocs))
+    for job in jobs:
+        harness.state.upsert_job(harness.next_index(), job)
+    for node_id in drained:
+        harness.state.update_node_drain(
+            harness.next_index(), node_id, True)
 
 
 class RejectPlan:

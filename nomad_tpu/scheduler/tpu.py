@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import random
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -93,7 +93,17 @@ def _build_allocation(sched, missing: AllocTuple, node, task_resources,
 
 
 class BatchedTPUScheduler(GenericScheduler):
-    """GenericScheduler whose bulk placement loop runs on the TPU."""
+    """GenericScheduler whose bulk placement loop runs on the TPU.
+
+    `kernel` pins the placement kernel (nomad_tpu/kernels) for this
+    scheduler instance — the `service-<kernel>-tpu` factory variants
+    set it; None defers to the process-global active kernel
+    (kernels.configure, fed by ServerConfig.placement_kernel)."""
+
+    def __init__(self, logger, state, planner, batch=False, rng=None,
+                 kernel: Optional[str] = None):
+        super().__init__(logger, state, planner, batch=batch, rng=rng)
+        self.kernel = kernel
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
@@ -203,6 +213,13 @@ class BatchedTPUScheduler(GenericScheduler):
         # capacity claims on device instead of colliding at the plan
         # applier. Harness/test planners without the attr stay on the
         # independent (vmapped) path.
+        # Placement kernel (nomad_tpu/kernels): instance pin from the
+        # factory variant, else the process-global active kernel. The
+        # name is a static PlacementConfig field — it joins the
+        # batcher's shape key, so kernels never share a dispatch.
+        from ..kernels import active_kernel
+
+        kernel = self.kernel or active_kernel()
         config = PlacementConfig(
             anti_affinity_penalty=penalty,
             pre_resolve=bool(getattr(self.planner, "pre_resolve", False)),
@@ -210,9 +227,12 @@ class BatchedTPUScheduler(GenericScheduler):
             # under distinct-hosts (the storm shape) collapses the
             # K-step scan to one scoring pass + top_k (ops/binpack.py
             # _uniform_topk_program). Static, so mixed batches never
-            # share a program with uniform ones.
-            uniform_dh=uniform_dh_flag(
-                placements, ask_arrays[5], ask_arrays[6]),
+            # share a program with uniform ones. Greedy-only: non-
+            # default kernels run their own joint solve over the full
+            # ask set and handle distinct-hosts in their repair scan.
+            uniform_dh=(kernel == "greedy" and uniform_dh_flag(
+                placements, ask_arrays[5], ask_arrays[6])),
+            kernel=kernel,
         )
         # Host-side key: a device PRNGKey here would cost a tunnel
         # round-trip per eval and force the batcher to pull keys back
@@ -237,7 +257,9 @@ class BatchedTPUScheduler(GenericScheduler):
                 # soak drives trip -> half-open -> reclose through
                 # this site).
                 chaos.fire("device.breaker_trip", eval_id=self.eval.id)
-            choices, scores = get_batcher().place(matrix, asks, key, config)
+            choices, scores = get_batcher().place(
+                matrix, asks, key, config,
+                span=(self.eval.id, self.eval.trace_id))
         except Exception:
             # Device dispatch failed (runtime fault, OOM on device,
             # chaos binpack.device / device.breaker_trip): the host
@@ -266,6 +288,11 @@ class BatchedTPUScheduler(GenericScheduler):
 
         # Host-side exact port assignment per chosen node, incremental.
         net_indexes: Dict[str, NetworkIndex] = {}
+        # Placements actually APPENDED to the plan, as (ask row j,
+        # node row) — the quality board must score committed claims
+        # only (coalesced failures and port-collision host re-places
+        # never commit through this loop).
+        committed: List[Tuple[int, int]] = []
 
         for j, missing in enumerate(bulk):
             # Coalesce once the TG has failed, even if the kernel found a
@@ -299,6 +326,39 @@ class BatchedTPUScheduler(GenericScheduler):
 
             self.plan.append_alloc(_build_allocation(
                 self, missing, node, task_resources, metrics))
+            committed.append((j, int(choices[j])))
+
+        # Quality scoreboard (kernels/quality.py): score the cluster
+        # state this plan commits to — base utilization plus the
+        # claims this loop actually appended — on the fragmentation/
+        # bin-pack axes, labeled by kernel so --kernel-ab and stats()
+        # can compare. Cheap ([N,4] copy + vector ops) next to the
+        # dispatch it follows.
+        self._note_quality(kernel, matrix, ask_arrays[0], committed)
+
+    def _note_quality(self, kernel, matrix, ask_res, committed) -> None:
+        from ..kernels.quality import (
+            get_board,
+            quality_from_arrays,
+            reference_ask,
+        )
+
+        try:
+            if not get_board().should_sample(kernel):
+                return
+            util = np.asarray(matrix.util).copy()
+            if committed:
+                js = np.asarray([j for j, _r in committed])
+                rows = np.asarray([r for _j, r in committed])
+                np.add.at(util, rows, np.asarray(ask_res)[js])
+            q = quality_from_arrays(util, matrix.capacity,
+                                    matrix.node_ok,
+                                    reference_ask(self.job))
+            get_board().note_plan(kernel, q["fragmentation"],
+                                  q["binpack_score"])
+        except Exception:  # noqa: BLE001 - scoring must never fail an eval
+            self.logger.warning("placement-quality scoring failed",
+                                exc_info=True)
 
     def _repay_cohort(self) -> None:
         """Un-announce this eval's place() call: the dispatch pipeline

@@ -79,6 +79,18 @@ class ServerConfig:
     # dispatch round-trip. False = independent (vmapped) evals.
     dense_pre_resolve: bool = True
 
+    # ---- Placement kernel (nomad_tpu/kernels) ----
+    # Which dense placement kernel the *-tpu factories run: "greedy"
+    # (the sequential masked-argmax reference reformulation) or
+    # "convex" (the convex-relaxation bin-packer), plus any kernel a
+    # plugin registered. Validated at server init — a typo fails
+    # before the first eval, not inside it. None = leave the
+    # process-global active kernel alone (it starts as "greedy"); an
+    # EXPLICIT value — including "greedy" — sets it. Per-scheduler-
+    # type pins are also available through scheduler_factories (e.g.
+    # {"service": "service-convex-tpu"}).
+    placement_kernel: Optional[str] = None
+
     # ---- Device-resident node state (models/resident.py) ----
     # The dense path's [N, R] node matrix lives on device; plan commits
     # and node up/down/drain transitions apply as small scatter deltas

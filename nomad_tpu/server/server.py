@@ -32,6 +32,7 @@ from .. import trace
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import FAILED_QUEUE, EvalBroker
+from ..kernels.quality import get_board as _quality_board
 from ..models.resident import device_state_stats as _device_state_stats
 from .config import ServerConfig
 from .core_gc import CoreScheduler
@@ -124,6 +125,17 @@ class Server:
             enabled=self.config.device_resident,
             rebuild_rows=self.config.resident_rebuild_rows,
         )
+        # Placement kernel (nomad_tpu/kernels): validate HERE, not at
+        # first eval — a typo'd placement_kernel must fail server init
+        # loudly with the registered-kernel list, the same contract as
+        # an unknown scheduler factory. The active kernel is process-
+        # global (like the batcher whose dispatches it shapes), so
+        # only an EXPLICIT choice (placement_kernel is not None —
+        # "greedy" included) flips it: a default-configured Server in
+        # this process must not silently reset another's kernel.
+        from ..kernels import configure as configure_kernels
+
+        configure_kernels(self.config.placement_kernel)
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -259,6 +271,22 @@ class Server:
                     metrics.set_gauge(
                         ("device_state", "upload_bytes"),
                         ds["upload_bytes"])
+                    # Placement-quality gauges (kernels/quality.py):
+                    # the active kernel's committed-plan medians plus
+                    # the queueing p99, scrapeable at /v1/metrics so a
+                    # kernel rollout's quality shift shows up on a
+                    # dashboard, not just in bench.
+                    pq = _quality_board().snapshot()
+                    metrics.set_gauge(
+                        ("placement_quality", "queueing_delay_ms"),
+                        pq["queueing_delay_ms"])
+                    for kname, q in pq["kernels"].items():
+                        metrics.set_gauge(
+                            ("placement_quality", kname,
+                             "fragmentation"), q["fragmentation"])
+                        metrics.set_gauge(
+                            ("placement_quality", kname,
+                             "binpack_score"), q["binpack_score"])
                     if not self._leader:
                         # Broker/plan-queue/heartbeats are leader-only
                         # (eval_broker.go:650 runs in the leader loop);
@@ -1290,6 +1318,12 @@ class Server:
             # and stale_rebuilds says how often plan-apply verification
             # had to re-anchor the delta chain.
             "device_state": _device_state_stats(),
+            # Placement-quality scoreboard (nomad_tpu/kernels/quality):
+            # per-kernel fragmentation / bin-pack medians from the
+            # dense paths' committed plans + the broker-wait queueing
+            # p99 — how WELL the active kernel places, next to the
+            # trace table's how-fast.
+            "placement_quality": _quality_board().snapshot(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

@@ -46,8 +46,19 @@ def is_dense_factory(name: str) -> bool:
 
 def host_factory(name: str) -> str:
     """The host (CPU iterator) factory with identical placement
-    semantics — where latency-aware routing sends lone evals."""
-    return name[: -len("-tpu")] if is_dense_factory(name) else name
+    semantics — where latency-aware routing sends lone evals. Kernel-
+    pinned dense variants ("service-convex-tpu", nomad_tpu/kernels)
+    map to the same host factory as their plain siblings: the host
+    path has no kernels, the infix strips with the suffix."""
+    if not is_dense_factory(name):
+        return name
+    base = name[: -len("-tpu")]
+    from ..kernels import kernel_names
+
+    for kernel in kernel_names():
+        if base.endswith("-" + kernel):
+            return base[: -(len(kernel) + 1)]
+    return base
 
 
 class EvalSession:

@@ -21,6 +21,7 @@ from .span import (  # noqa: F401
     STAGE_ALLOC_UPSERT,
     STAGE_BROKER_WAIT,
     STAGE_DEVICE_DISPATCH,
+    STAGE_DEVICE_SOLVE,
     STAGE_DEVICE_TRANSFER,
     STAGE_DISPATCH_ACCUMULATE,
     STAGE_DISPATCH_LAUNCH,

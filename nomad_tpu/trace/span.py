@@ -29,6 +29,9 @@ STAGE_MATRIX_BUILD = "matrix.build"        # ClusterMatrix + ask construction
 STAGE_MATRIX_UPDATE = "matrix.update"      # incremental delta vs full rebuild
 STAGE_DEVICE_TRANSFER = "device.transfer"  # base prefetch host->device
 STAGE_DEVICE_DISPATCH = "device.dispatch"  # batcher.place round-trip
+STAGE_DEVICE_SOLVE = "device.solve"        # the jitted placement-kernel
+#   solve inside the dispatch (issue + device sync, kernel-annotated) —
+#   device.dispatch minus batch-wait and host stacking
 STAGE_PLAN_SUBMIT = "plan.submit"          # plan queue wait + commit (worker view)
 STAGE_PLAN_EVALUATE = "plan.evaluate"      # applier per-node verification
 STAGE_PLAN_COMMIT = "plan.commit"          # raft apply of the accepted plan
@@ -43,6 +46,7 @@ ALL_STAGES = (
     STAGE_MATRIX_UPDATE,
     STAGE_DEVICE_TRANSFER,
     STAGE_DEVICE_DISPATCH,
+    STAGE_DEVICE_SOLVE,
     STAGE_PLAN_SUBMIT,
     STAGE_PLAN_EVALUATE,
     STAGE_PLAN_COMMIT,

@@ -48,6 +48,114 @@ def test_host_factory_mapping():
     assert host_factory("service") == "service"
     assert is_dense_factory("system-tpu")
     assert not is_dense_factory("system")
+    # Kernel-pinned dense variants (nomad_tpu/kernels) fall back to
+    # the SAME host factory: the kernel infix strips with the suffix.
+    assert host_factory("service-convex-tpu") == "service"
+    assert host_factory("batch-greedy-tpu") == "batch"
+    assert is_dense_factory("service-convex-tpu")
+
+
+def test_tpu_suffix_fallback_registers_lazily():
+    """scheduler/__init__.py:52: an unregistered `*-tpu` name triggers
+    lazy TPU-factory registration (including every kernel's pinned
+    variants) instead of failing — and a name that is neither
+    registered nor a -tpu factory fails loudly."""
+    import logging
+
+    import pytest as _pytest
+
+    from nomad_tpu import scheduler as sched_mod
+    from nomad_tpu.scheduler.testing import Harness
+
+    # Force the lazy path even if another test already registered the
+    # dense factories in this process.
+    for name in [n for n in sched_mod.scheduler_names()
+                 if n.endswith("-tpu")]:
+        sched_mod._BUILTIN.pop(name)
+    h = Harness()
+    logger = logging.getLogger("test")
+
+    s = sched_mod.new_scheduler("service-tpu", logger, h.snapshot(), h)
+    assert type(s).__name__ == "BatchedTPUScheduler"
+    assert s.kernel is None  # defers to the process-global kernel
+    # Kernel-pinned variant, also via the fallback.
+    for name in [n for n in sched_mod.scheduler_names()
+                 if n.endswith("-tpu")]:
+        sched_mod._BUILTIN.pop(name)
+    s2 = sched_mod.new_scheduler(
+        "batch-convex-tpu", logger, h.snapshot(), h)
+    assert type(s2).__name__ == "BatchedTPUScheduler"
+    assert s2.kernel == "convex"
+    assert s2.batch is True
+
+    with _pytest.raises(ValueError, match="unknown scheduler"):
+        sched_mod.new_scheduler("service-xyz", logger, h.snapshot(), h)
+    # An unknown KERNEL variant: the -tpu fallback registers the real
+    # kernels, the typo'd name stays unknown and fails loudly.
+    with _pytest.raises(ValueError, match="unknown scheduler"):
+        sched_mod.new_scheduler(
+            "service-convexx-tpu", logger, h.snapshot(), h)
+
+
+def test_unknown_placement_kernel_fails_at_server_init():
+    """A typo'd `placement_kernel` must abort Server construction with
+    the registered-kernel list — not surface at the first eval."""
+    import pytest as _pytest
+
+    from nomad_tpu.kernels import active_kernel, configure
+
+    before = active_kernel()
+    try:
+        with _pytest.raises(ValueError, match="unknown placement kernel"):
+            Server(ServerConfig(num_schedulers=1,
+                                placement_kernel="convexx"))
+        # The valid names configure cleanly (no server needed).
+        configure("convex")
+        assert active_kernel() == "convex"
+        configure("greedy")
+    finally:
+        configure(before)
+
+
+def test_placement_kernel_knob_reaches_stats_surface():
+    """ServerConfig.placement_kernel = "convex" routes dense evals
+    through the convex kernel, and the quality scoreboard surfaces it
+    in server.stats()["placement_quality"]."""
+    from nomad_tpu.kernels import active_kernel, configure
+    from nomad_tpu.kernels.quality import get_board
+
+    before = active_kernel()
+    get_board().reset()
+    server = make_server(placement_kernel="convex")
+    try:
+        seed_nodes(server)
+        for w in server.workers:
+            w.set_pause(True)
+        jobs = []
+        for _ in range(4):
+            job = mock.job()
+            job.task_groups[0].count = 5  # >3 so the dense path engages
+            server.job_register(job)
+            jobs.append(job)
+        assert wait_until(lambda: server.broker.ready_count() >= 4)
+        for w in server.workers:
+            w.set_pause(False)
+        assert wait_until(
+            lambda: all(
+                len(server.fsm.state.allocs_by_job(j.id)) == 5
+                for j in jobs),
+            timeout=60.0,
+        )
+        pq = server.stats()["placement_quality"]
+        assert "convex" in pq["kernels"], pq
+        entry = pq["kernels"]["convex"]
+        assert entry["samples"] > 0
+        assert 0.0 <= entry["fragmentation"] <= 1.0
+        assert 0.0 <= entry["binpack_score"] <= 1.0
+        assert "queueing_delay_ms" in pq
+    finally:
+        server.shutdown()
+        configure(before)
 
 
 def test_lone_eval_routes_to_host_path():

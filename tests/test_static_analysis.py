@@ -563,7 +563,7 @@ def test_real_batcher_passes_its_own_manifest():
 CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
              "nomad_tpu/trace/", "nomad_tpu/admission/",
-             "nomad_tpu/models/")
+             "nomad_tpu/models/", "nomad_tpu/kernels/")
 
 
 def _tree_findings():
@@ -1457,6 +1457,35 @@ def test_new_rules_raw_clean_in_baseline_free_dirs():
     offenders = [f for f in _tree_findings()
                  if f.rule in NEW_RULES and f.path.startswith(core)]
     assert offenders == [], "\n".join(f.render() for f in offenders)
+
+
+def test_kernels_subsystem_raw_clean_and_in_every_scope():
+    """The placement-kernel subsystem's self-check (the PR-8 analog of
+    the dispatch/admission acceptance below): nomad_tpu/kernels/ is
+    inside the baseline-free core set, the residency scope, and both
+    of bench --check's gate sweeps; the tree shows ZERO findings of
+    ANY rule there (not even baselined ones) — in particular no
+    raft-funnel findings: kernels never touch the state store, they
+    only return plans (the differential rig's store seeding routes
+    through scheduler/testing.py's sanctioned fixture funnel)."""
+    import importlib.util
+
+    assert "nomad_tpu/kernels/" in CORE_DIRS
+    from nomad_tpu.analysis.residency import SCOPE_MARKERS
+
+    assert "/kernels/" in SCOPE_MARKERS
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_probe", os.path.join(REPO, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    assert "kernels" in bench_mod.PURITY_GATE_DIRS
+    assert "nomad_tpu/kernels/" in bench_mod.CONCURRENCY_GATE_DIRS
+
+    offenders = [f for f in _tree_findings()
+                 if f.path.startswith("nomad_tpu/kernels/")]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["path"].startswith("nomad_tpu/kernels/")] == []
 
 
 def test_real_server_dispatch_admission_pass_program_rules():
