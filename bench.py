@@ -696,31 +696,166 @@ def _system_drain_storm(n_nodes, n_jobs, rack_partition):
     return cpu_rate, cpu_p99, dense_rate, dense_p99, q
 
 
+def _drain_migration_arm(n_nodes, n_jobs, allocs_per_job, budget=8,
+                         drain_frac=0.1, seed=4242):
+    """Service-job drain migration on the dense path (the churn PR's
+    config-5 extension): place service allocs, drain a slice of the
+    cluster, and drive the displaced set through the migration budget
+    (nomad_tpu/migrate) — follow-up migration evals included — to a
+    fully re-placed cluster. Reports:
+
+    - migrations_per_s: committed displaced-alloc evictions+re-places
+      per second of storm wall clock (allocs_per_job must exceed the
+      budget: a job eval's migrate set is bounded by its own alloc
+      count, and the arm asserts the deferral machinery engaged);
+    - disruption_p99_ms: per displaced alloc, drain-to-replacement-
+      committed latency (the wave that re-placed it), p99.
+
+    The governor's high-water mark is asserted <= budget — numbers
+    from an unbounded thundering herd would not be measuring the
+    dense drain path this config claims to."""
+    from nomad_tpu import mock
+    from nomad_tpu.migrate import configure as migrate_configure
+    from nomad_tpu.migrate import get_governor
+    from nomad_tpu.scheduler.testing import Harness
+    from nomad_tpu.structs import consts
+    from nomad_tpu.structs.eval import new_eval
+
+    h = Harness(seed=seed)
+    nodes = []
+    for _ in range(n_nodes):
+        node = mock.node()
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+        nodes.append(node)
+    jobs = []
+    for j in range(n_jobs):
+        job = mock.job()
+        job.id = f"mig-{j}"
+        job.task_groups[0].count = allocs_per_job
+        task = job.task_groups[0].tasks[0]
+        task.resources.cpu = 20
+        task.resources.memory_mb = 16
+        task.resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        jobs.append(h.state.job_by_id(job.id))
+    for job in jobs:
+        h.process("service-tpu",
+                  new_eval(job, consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    from nomad_tpu.migrate import DEFAULT_MAX_PARALLEL
+
+    migrate_configure(migrate_max_parallel=budget)
+    try:
+        get_governor().reset_stats()
+        # Drain the MOST-OCCUPIED nodes: BestFit concentrates the fleet
+        # onto few nodes, so draining by creation order can displace
+        # nothing (a vacuous measurement). Draining where the allocs live
+        # also guarantees per-eval migrate sets larger than the budget —
+        # the deferral/wave machinery this arm exists to measure.
+        occupancy = {}
+        for a in h.state.allocs():
+            if not a.terminal_status():
+                occupancy[a.node_id] = occupancy.get(a.node_id, 0) + 1
+        by_load = sorted(occupancy, key=occupancy.get, reverse=True)
+        n_drain = max(1, int(n_nodes * drain_frac))
+        drained = set(by_load[:n_drain])
+        drained |= {n.id for n in nodes[: n_drain - len(drained)]}
+        displaced = {a.id for a in h.state.allocs()
+                     if a.node_id in drained and not a.terminal_status()}
+        assert displaced, "drain arm displaced nothing: not measuring"
+        for nid in drained:
+            h.state.update_node_drain(h.next_index(), nid, True)
+
+        affected = [j for j in jobs
+                    if any(a.node_id in drained
+                           for a in h.state.allocs_by_job(j.id))]
+        pending = [new_eval(j, consts.EVAL_TRIGGER_NODE_UPDATE)
+                   for j in affected]
+        seen_created = len(h.create_evals)
+        disruption = {}
+        t_drain = time.perf_counter()
+        while pending:
+            for ev in pending:
+                h.process("service-tpu", ev)
+                t_done = time.perf_counter()
+                for a in h.state.allocs_by_eval(ev.id):
+                    prev = a.previous_allocation
+                    if prev in displaced and prev not in disruption:
+                        disruption[prev] = t_done - t_drain
+            created = h.create_evals[seen_created:]
+            seen_created = len(h.create_evals)
+            pending = [e for e in created
+                       if e.triggered_by == consts.EVAL_TRIGGER_MIGRATION]
+        elapsed = time.perf_counter() - t_drain
+
+        migrated = [a for a in h.state.allocs()
+                    if a.id in displaced
+                    and a.desired_status == consts.ALLOC_DESIRED_STOP]
+        g = get_governor().stats()
+        assert migrated, "drain arm migrated nothing: not measuring"
+        assert g["high_water"] <= max(budget, 1), g
+        # The budget must have actually engaged (per-eval displacement
+        # exceeds it by construction above) — a zero deferral count means
+        # the numbers describe an unpressured path.
+        assert g["deferred_total"] > 0, g
+        live_by_job = {
+            j.id: [a for a in h.state.allocs_by_job(j.id)
+                   if not a.terminal_status()] for j in jobs}
+        assert all(len(v) == allocs_per_job for v in live_by_job.values()), {
+            k: len(v) for k, v in live_by_job.items()}
+        assert all(a.node_id not in drained
+                   for v in live_by_job.values() for a in v)
+        p99 = (float(np.percentile(list(disruption.values()), 99))
+               if disruption else 0.0)
+        return {
+            "migrations": len(migrated),
+            "migrations_per_s": len(migrated) / elapsed if elapsed else 0.0,
+            "disruption_p99_ms": p99 * 1000,
+            "migration_budget": budget,
+            "migration_high_water": g["high_water"],
+            "migration_deferred": g["deferred_total"],
+        }
+    finally:
+        # The governor is process-global: restore the default so a
+        # later config/arm in the same run measures its own budget,
+        # not whichever arm ran last (run_preempt_ab does the same).
+        migrate_configure(migrate_max_parallel=DEFAULT_MAX_PARALLEL)
+
+
 def config_5():
     """Blueprint-scale drain storm (BASELINE.json config 5): 10k nodes
-    x 200 rack-scoped system jobs, 10% drained."""
+    x 200 rack-scoped system jobs, 10% drained — plus the service-side
+    migration arm (1k nodes) driving displaced allocs through the
+    dense path under the migration budget."""
     cpu_rate, cpu_p99, dense_rate, dense_p99, q = _system_drain_storm(
         10_000, 200, rack_partition=True)
+    mig = _drain_migration_arm(1000, 20, 24)
     return {
         "name": ("drain storm: 10k nodes x 200 system jobs (rack-scoped),"
-                 " 10% drained (host stack vs dense pass)"),
+                 " 10% drained (host stack vs dense pass) + service "
+                 "migration arm (1k nodes, budgeted)"),
         "cpu": cpu_rate, "cpu_p99_ms": cpu_p99 * 1000,
         "e2e": dense_rate, "e2e_p99_ms": dense_p99 * 1000,
         **_quality_cols(q),
+        **mig,
     }
 
 
 def config_5s():
     """Smoke-scale drain storm (kept for quick runs): 1k x 50,
-    unconstrained (every job spans every node)."""
+    unconstrained (every job spans every node), with a small service
+    migration arm."""
     cpu_rate, cpu_p99, dense_rate, dense_p99, q = _system_drain_storm(
         1000, 50, rack_partition=False)
+    mig = _drain_migration_arm(400, 12, 20)
     return {
         "name": ("drain storm smoke: 1k nodes x 50 system jobs, 10% "
-                 "drained (host stack vs dense pass)"),
+                 "drained (host stack vs dense pass) + migration arm"),
         "cpu": cpu_rate, "cpu_p99_ms": cpu_p99 * 1000,
         "e2e": dense_rate, "e2e_p99_ms": dense_p99 * 1000,
         **_quality_cols(q),
+        **mig,
     }
 
 
@@ -1672,12 +1807,161 @@ def run_kernel_ab(reps=3, check=False):
     }
 
 
+def _preempt_storm(preemption_on, seed, n_nodes=16, storm_x=3):
+    """One priority-storm arm: a full cluster of prio-20 allocs, then
+    high-priority (60) demand at `storm_x` times what the cluster can
+    hold even WITH preemption. ON places to capacity by evicting
+    lowest-priority allocs through the dense preempt pass; OFF sheds
+    per the PR 5 policy (blocked evals, zero evictions)."""
+    from nomad_tpu import mock
+    from nomad_tpu.migrate import configure as migrate_configure
+    from nomad_tpu.migrate import get_governor
+    from nomad_tpu.ops.binpack import jit_cache_size
+    from nomad_tpu.scheduler.testing import Harness
+    from nomad_tpu.structs import consts
+    from nomad_tpu.structs.eval import new_eval
+
+    migrate_configure(preemption_enabled=preemption_on,
+                      preempt_priority_threshold=50,
+                      pressure_probe=lambda: "red")
+    get_governor().reset_stats()
+    h = Harness(seed=seed)
+    for _ in range(n_nodes):
+        node = mock.node()
+        node.resources.cpu = 1000
+        node.resources.memory_mb = 4096
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+    low = mock.job()
+    low.id = "low-prio"
+    low.priority = 20
+    low.task_groups[0].count = n_nodes
+    t = low.task_groups[0].tasks[0]
+    t.resources.cpu = 600
+    t.resources.memory_mb = 256
+    t.resources.networks = []
+    h.state.upsert_job(h.next_index(), low)
+    h.process("service-tpu", new_eval(h.state.job_by_id(low.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    # capacity with preemption = 1 high alloc per node; storm at 3x
+    per_job = 4
+    n_high = (n_nodes * storm_x) // per_job
+    requested = n_high * per_job
+    t0 = time.perf_counter()
+    for j in range(n_high):
+        job = mock.job()
+        job.id = f"high-{j}"
+        job.priority = 60
+        job.task_groups[0].count = per_job
+        t = job.task_groups[0].tasks[0]
+        t.resources.cpu = 500
+        t.resources.memory_mb = 128
+        t.resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        h.process("service-tpu", new_eval(
+            h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    elapsed = time.perf_counter() - t0
+
+    placed = sum(
+        1 for a in h.state.allocs()
+        if a.job_id.startswith("high-") and not a.terminal_status())
+    evicted = [a for a in h.state.allocs_by_job(low.id)
+               if a.desired_status == consts.ALLOC_DESIRED_EVICT]
+    # every eviction must have committed through the raft funnel
+    # (Harness.submit_plan IS the oracle's funnel): each evicted store
+    # record traces to exactly one plan's preemption leg.
+    staged_ids = []
+    for plan in h.plans:
+        for victims in plan.node_preemptions.values():
+            staged_ids.extend(v.id for v in victims)
+    blocked = sum(1 for e in h.create_evals
+                  if e.status == consts.EVAL_STATUS_BLOCKED)
+    return {
+        "requested": requested,
+        "placed": placed,
+        "placed_frac": placed / requested if requested else 0.0,
+        "evictions": len(evicted),
+        "evictions_staged_in_plans": len(staged_ids),
+        "evictions_funnel_ok": (
+            sorted(staged_ids) == sorted(a.id for a in evicted)),
+        "blocked_evals": blocked,
+        "evals_per_s": n_high / elapsed if elapsed else 0.0,
+        "jit_cache_size": jit_cache_size(),
+    }
+
+
+def run_preempt_ab(reps=3, check=False):
+    """Preemption ON/OFF A/B under a 3x priority storm -> the
+    BENCH_r12 arm. ON must place the cluster's preemption capacity
+    with every eviction committing exactly once through the raft
+    funnel; OFF must shed per the PR 5 policy unchanged (blocked
+    evals, zero evictions). With --check, refuses to report if ANY
+    eviction lacks a raft-funnel terminal (a store evict record with
+    no staging plan, or a staged victim that never committed), or if
+    the preemption leg recompiled after warmup."""
+    from nomad_tpu.migrate import configure as migrate_configure
+
+    arms = {"on": [], "off": []}
+    try:
+        for rep in range(reps):
+            arms["on"].append(_preempt_storm(True, seed=9000 + rep))
+            arms["off"].append(_preempt_storm(False, seed=9500 + rep))
+    finally:
+        migrate_configure(preemption_enabled=False,
+                          pressure_probe=lambda: "green")
+
+    if check:
+        for rep, r in enumerate(arms["on"]):
+            if not r["evictions_funnel_ok"]:
+                print(f"bench: REFUSING preempt-ab numbers: rep {rep} "
+                      f"has evictions without a raft-funnel terminal "
+                      f"(staged {r['evictions_staged_in_plans']} vs "
+                      f"committed {r['evictions']})", file=sys.stderr)
+                sys.exit(2)
+        # warmup = rep 0; later reps must add no compiled programs
+        sizes = [r["jit_cache_size"] for r in arms["on"]]
+        if len(set(sizes[1:])) > 1:
+            print(f"bench: REFUSING preempt-ab numbers: preemption leg "
+                  f"recompiled after warmup (jit cache {sizes})",
+                  file=sys.stderr)
+            sys.exit(2)
+
+    def med(rr, key):
+        m, _ = _median_iqr([float(r[key]) for r in rr])
+        return m
+
+    on, off = arms["on"], arms["off"]
+    out = {
+        "metric": (f"[preempt-ab 3x priority storm, median-of-{reps}] "
+                   f"ON: placed {med(on, 'placed'):.0f}/"
+                   f"{on[0]['requested']} with "
+                   f"{med(on, 'evictions'):.0f} evictions "
+                   f"(funnel_ok={all(r['evictions_funnel_ok'] for r in on)})"
+                   f"; OFF: placed {med(off, 'placed'):.0f}, "
+                   f"{med(off, 'evictions'):.0f} evictions, "
+                   f"{med(off, 'blocked_evals'):.0f} blocked"),
+        "preemption_on": {k: med(on, k) for k in on[0] if k != "metric"},
+        "preemption_off": {k: med(off, k) for k in off[0]},
+        "acceptance": {
+            "on_places_capacity": bool(med(on, "placed") >= 16),
+            "on_funnel_exactly_once": all(
+                r["evictions_funnel_ok"] for r in on),
+            "off_sheds_unchanged": bool(
+                med(off, "placed") == 0 and med(off, "evictions") == 0
+                and med(off, "blocked_evals") > 0),
+        },
+    }
+    return out
+
+
 # The dirs the --check gates sweep. Module constants so the ntalint
 # self-checks (tests/test_static_analysis.py) can assert the kernels
 # subsystem is inside both gates rather than trusting a string copy.
-PURITY_GATE_DIRS = ("ops", "scheduler", "kernels")
+PURITY_GATE_DIRS = ("ops", "scheduler", "kernels", "migrate")
 CONCURRENCY_GATE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
-                         "nomad_tpu/server/", "nomad_tpu/kernels/")
+                         "nomad_tpu/server/", "nomad_tpu/kernels/",
+                         "nomad_tpu/migrate/")
 
 
 def ntalint_purity_gate():
@@ -1775,6 +2059,14 @@ def main():
                              "oracle differential rig first")
     parser.add_argument("--kernel-ab-reps", type=int, default=3,
                         help="interleaved reps per kernel-ab arm")
+    parser.add_argument("--preempt-ab", action="store_true",
+                        help="priority-preemption ON/OFF A/B under a "
+                             "3x priority storm (nomad_tpu/migrate + "
+                             "ops/preempt.py) — the BENCH_r12 arm. "
+                             "With --check, refuses numbers if any "
+                             "eviction lacks a raft-funnel terminal")
+    parser.add_argument("--preempt-ab-reps", type=int, default=3,
+                        help="reps per preempt-ab arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -1825,6 +2117,11 @@ def main():
     if args.kernel_ab:
         print(json.dumps(run_kernel_ab(reps=args.kernel_ab_reps,
                                        check=args.check)))
+        return
+
+    if args.preempt_ab:
+        print(json.dumps(run_preempt_ab(reps=args.preempt_ab_reps,
+                                        check=args.check)))
         return
 
     if args.resident_ab:

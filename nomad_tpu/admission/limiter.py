@@ -148,6 +148,12 @@ class AdmissionController:
         with self._lock:
             self._forced_level = level
 
+    def level(self) -> str:
+        """The effective pressure level (forced override included) —
+        the public probe the preemption policy (nomad_tpu/migrate)
+        and operators read."""
+        return self._level()
+
     def _level(self) -> str:
         with self._lock:
             forced = self._forced_level

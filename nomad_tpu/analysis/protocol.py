@@ -78,8 +78,20 @@ TERMINAL_BY_FIELD = {
     "status": {"EVAL_STATUS_COMPLETE", "EVAL_STATUS_FAILED",
                "EVAL_STATUS_CANCELLED"},
     "client_status": {"ALLOC_CLIENT_LOST"},
+    # Eviction terminal: an alloc stamped evict (preemption) is
+    # terminal to every scheduler pass — stamping it outside the
+    # funnel is exactly a double-evict / phantom-evict. The sanctioned
+    # path passes the constant as a Plan.append_preemption ARGUMENT
+    # (parameter stamps are the reference idiom and invisible here by
+    # design) and commits through plan-apply.
+    "desired_status": {"ALLOC_DESIRED_EVICT"},
     "triggered_by": {"EVAL_TRIGGER_SHED", "EVAL_TRIGGER_EXPIRED",
-                     "EVAL_TRIGGER_DEAD_LETTER"},
+                     "EVAL_TRIGGER_DEAD_LETTER",
+                     # Churn follow-ups (nomad_tpu/migrate): minting a
+                     # migration/preemption eval is a commitment to
+                     # future work — a stamp that never reaches
+                     # eval_update is displaced work silently dropped.
+                     "EVAL_TRIGGER_MIGRATION", "EVAL_TRIGGER_PREEMPTION"},
 }
 
 # The client owns its local status lifecycle (pending->running->

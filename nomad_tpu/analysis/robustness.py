@@ -65,9 +65,9 @@ RULE_SWALLOWED = "swallowed-exception"
 RULE_RECORD_PATH = "record-path-blocking"
 
 WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/",
-                      "/admission/", "/scheduler/")
+                      "/admission/", "/scheduler/", "/migrate/")
 SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
-                         "/admission/")
+                         "/admission/", "/migrate/")
 
 # Attribute calls that block forever when called with no timeout.
 UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}

@@ -58,6 +58,16 @@ site                   wired into
                        plan applier's exact verification must catch the
                        bad placement and force a full rebuild,
                        models/resident.py)
+``drain.mid_migration``  top of a scheduler's migrate leg, before any
+                       budget claim or staged eviction (error = the
+                       eval dies mid-migration and must redeliver with
+                       nothing committed — the drain soak's exactly-
+                       once contract; delay = a slow migration wave)
+``preempt.victim_lost``  per-victim at preemption commit (drop = the
+                       victim is NOT staged in the plan though the
+                       kernel already counted its freed capacity —
+                       the plan applier's exact verification must
+                       reject the under-freed node and force a replan)
 =====================  =======================================================
 """
 
@@ -88,6 +98,8 @@ KNOWN_SITES = frozenset({
     "admission.slow_consumer",
     "device.breaker_trip",
     "matrix.stale_delta",
+    "drain.mid_migration",
+    "preempt.victim_lost",
 })
 
 DROP = "drop"

@@ -51,6 +51,12 @@ class ServerBlock:
     # factories run ("greedy" / "convex" / a plugin's); validated at
     # server init.
     placement_kernel: Optional[str] = None
+    # Churn control (nomad_tpu/migrate; server/config.py): the
+    # in-flight migration budget (drain max_parallel analog) and the
+    # dense priority-preemption switch + threshold.
+    migrate_max_parallel: Optional[int] = None
+    preemption_enabled: Optional[bool] = None
+    preempt_priority_threshold: Optional[int] = None
     # Overload protection (nomad_tpu/admission; server/config.py):
     # bounded broker ready queues, eval deadlines, the token-bucket
     # intake gate, and the device-path circuit breaker.
@@ -217,6 +223,9 @@ _SCHEMA: Dict[str, Any] = {
     "server.dense_pre_resolve": bool,
     "server.device_resident": bool, "server.resident_rebuild_rows": int,
     "server.placement_kernel": str,
+    "server.migrate_max_parallel": int,
+    "server.preemption_enabled": bool,
+    "server.preempt_priority_threshold": int,
     "server.eval_ready_cap": int, "server.eval_deadline_ttl": float,
     "server.admission_enabled": bool, "server.breaker_enabled": bool,
     "server.breaker_failure_threshold": int,

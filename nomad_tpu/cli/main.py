@@ -677,6 +677,19 @@ def cmd_agent(args) -> int:
         # so a typo'd name aborts agent startup with the known list.
         if cfg.server.placement_kernel is not None:
             server_cfg.placement_kernel = cfg.server.placement_kernel
+        # Churn control (nomad_tpu/migrate): migration budget +
+        # preemption policy. CLI flags win over HCL, as everywhere.
+        if args.migrate_max_parallel is not None:
+            server_cfg.migrate_max_parallel = args.migrate_max_parallel
+        elif cfg.server.migrate_max_parallel is not None:
+            server_cfg.migrate_max_parallel = cfg.server.migrate_max_parallel
+        if args.preemption:
+            server_cfg.preemption_enabled = True
+        elif cfg.server.preemption_enabled is not None:
+            server_cfg.preemption_enabled = cfg.server.preemption_enabled
+        if cfg.server.preempt_priority_threshold is not None:
+            server_cfg.preempt_priority_threshold = (
+                cfg.server.preempt_priority_threshold)
         # Overload protection (nomad_tpu/admission): bounded broker
         # queues, deadlines, intake gate, device-path breaker.
         if cfg.server.eval_ready_cap is not None:
@@ -945,6 +958,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated gossip addrs to join at start")
     p.add_argument("-tpu", dest="tpu", action="store_true",
                    help="route service/batch evals to the TPU backend")
+    p.add_argument("-migrate-max-parallel", dest="migrate_max_parallel",
+                   type=int, default=None,
+                   help="in-flight migration budget for drain storms "
+                        "(0 = unbounded)")
+    p.add_argument("-preemption", dest="preemption", action="store_true",
+                   help="allow red-pressure priority preemption")
     p.add_argument("-consul", dest="consul", default="",
                    help="consul agent addr for service sync + discovery")
     p.add_argument("-advertise", dest="advertise", default="",

@@ -171,6 +171,7 @@ class AllocRunner:
     def update(self, alloc: Allocation) -> None:
         """Server pushed a new version of this alloc (desired status or
         in-place task updates)."""
+        old_job = self.alloc.job
         self.alloc.desired_status = alloc.desired_status
         self.alloc.desired_description = alloc.desired_description
         self.alloc.alloc_modify_index = alloc.alloc_modify_index
@@ -182,6 +183,32 @@ class AllocRunner:
             consts.ALLOC_DESIRED_EVICT,
         ):
             self.kill_tasks()
+            return
+        # In-place task update (the scheduler's env/meta-compatible
+        # path, scheduler/util.py tasks_updated): the new job version
+        # carries changed task definitions for the SAME placement —
+        # push them into the live runners, which restart with the new
+        # environment. Only genuinely-changed work restarts; a pure
+        # desired-status ping must not bounce anything. Job- and
+        # task-group-level meta render into every task's NOMAD_META_*
+        # env (client/env.py) without appearing on the Task itself, so
+        # a meta-only tweak restarts the whole group.
+        if alloc.job is None:
+            return
+        tg = alloc.job.lookup_task_group(self.alloc.task_group)
+        if tg is None:
+            return
+        old_tg = (old_job.lookup_task_group(self.alloc.task_group)
+                  if old_job is not None else None)
+        meta_changed = (
+            old_job is None or old_tg is None
+            or old_job.meta != alloc.job.meta
+            or old_tg.meta != tg.meta)
+        for task in tg.tasks:
+            runner = self.task_runners.get(task.name)
+            if runner is not None and (meta_changed
+                                       or runner.task != task):
+                runner.update_inplace(self.alloc, task)
 
     def kill_tasks(self) -> None:
         for runner in self.task_runners.values():

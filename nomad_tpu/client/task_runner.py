@@ -81,6 +81,9 @@ class TaskRunner:
         self._destroy_event: Optional[TaskEvent] = None
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # Set by update_inplace: the next start must re-render the
+        # task environment from the adopted alloc/task definition.
+        self._env_stale = False  # guarded-by: _lock
 
     # ------------------------------------------------------------------
 
@@ -110,6 +113,31 @@ class TaskRunner:
                 handle.kill(kill_timeout)
             except Exception:
                 self.logger.exception("kill failed")
+
+    def update_inplace(self, alloc: Allocation, task) -> None:
+        """Server pushed an in-place alloc update (an env/meta-level
+        job tweak, scheduler/util.py tasks_updated): adopt the new
+        task definition and restart the live task so its next start
+        renders the new environment. Rides the template
+        change_mode=restart machinery — the restart is requested
+        work, never a failure, so it does not count against the
+        restart policy. A task that is not currently running just
+        adopts the definition (its next start reads it anyway)."""
+        with self._lock:
+            self.alloc = alloc
+            self.task = task
+            self._env_stale = True
+            handle = self.handle
+        if handle is None or self.state.state != consts.TASK_STATE_RUNNING:
+            return
+        self._restart_requested.set()
+        ev = new_task_event(consts.TASK_EVENT_RESTART_SIGNAL)
+        ev.message = "In-place update: restarting with the new task environment"
+        self._emit(self.state.state, ev)
+        try:
+            handle.kill(min(self.task.kill_timeout, self.max_kill_timeout))
+        except Exception:
+            self.logger.exception("in-place update restart kill failed")
 
     # ------------------------------------------------------------------
 
@@ -187,6 +215,17 @@ class TaskRunner:
             return
 
         while not self._kill.is_set():
+            # An in-place update (update_inplace) swapped the task
+            # definition underneath us: re-render the environment so
+            # this start picks up the new env/meta. Everything else in
+            # the ctx is in-place-invariant by the scheduler's
+            # compatibility rules (resources/networks never change).
+            with self._lock:
+                env_stale = self._env_stale
+                self._env_stale = False
+            if env_stale:
+                ctx.env = task_env_from_alloc_dir(
+                    self.alloc, self.task, self.alloc_dir)
             # prestart: artifacts + initial template render
             # (task_runner.go:354; re-run on every restart like the
             # reference, so transient download failures retry under the

@@ -39,6 +39,16 @@ plan-apply acceptance. The quality scoreboard (kernels/quality.py)
 measures the trade: fragmentation, bin-pack utilization, queueing
 delay.
 
+Preemption interplay (ops/preempt.py): the dense priority-preemption
+pass is NOT part of the kernel contract — kernels place into free
+capacity only. When a red-pressure, outranking eval's kernel solve
+leaves asks unplaced, the scheduler runs the separate preemption
+program over a fresh matrix (its own compiled entry point, greedy
+scoring) regardless of which kernel failed first; evictions commit
+through the plan's verified node_preemptions leg either way. A kernel
+therefore never needs victim-awareness to stay correct under
+preemption — it just sees the post-eviction capacity on the replan.
+
 This module stays JAX-free at import time (the scheduler package and
 server init import it; only the dense dispatch path may pull in jax):
 kernel programs register as LAZY loaders resolved on first dispatch.

@@ -669,6 +669,11 @@ _JIT_ENTRY_POINTS = ()
 def _jit_entry_points():
     global _JIT_ENTRY_POINTS
     if not _JIT_ENTRY_POINTS:
+        # The preemption leg (ops/preempt.py) is part of the placement
+        # path's compile budget: bench.py's jit_recompiles gate must
+        # see its cache too, or a preemption-shape leak would hide.
+        from .preempt import preempt_placement_program_jit
+
         _JIT_ENTRY_POINTS = (
             placement_program_jit,
             batched_placement_program,
@@ -678,6 +683,7 @@ def _jit_entry_points():
             batched_placement_program_compact_delta,
             apply_base_delta,
             device_resident,
+            preempt_placement_program_jit,
         )
     return _JIT_ENTRY_POINTS
 

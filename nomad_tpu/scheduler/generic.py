@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import random
+import time
 from typing import Dict, List, Optional
 
 from ..structs import (
@@ -75,6 +76,11 @@ class GenericScheduler:
         self.blocked: Optional[Evaluation] = None
         self.failed_tg_allocs: Optional[Dict[str, AllocMetric]] = None
         self.queued_allocs: Optional[Dict[str, int]] = None
+        # Migration-budget bookkeeping (nomad_tpu/migrate): slots this
+        # attempt holds (released when the attempt's submit finishes)
+        # and the follow-up eval minted for deferred displaced allocs.
+        self._migrate_permits = 0
+        self._migration_eval: Optional[Evaluation] = None
 
     # ------------------------------------------------------------------
 
@@ -89,6 +95,8 @@ class GenericScheduler:
             consts.EVAL_TRIGGER_ROLLING_UPDATE,
             consts.EVAL_TRIGGER_PERIODIC_JOB,
             consts.EVAL_TRIGGER_MAX_PLANS,
+            consts.EVAL_TRIGGER_MIGRATION,
+            consts.EVAL_TRIGGER_PREEMPTION,
         ):
             desc = f"scheduler cannot handle '{eval.triggered_by}' evaluation reason"
             set_status(
@@ -145,7 +153,22 @@ class GenericScheduler:
         self.planner.create_eval(self.blocked)
 
     def _process(self) -> bool:
-        """One scheduling attempt; returns True when done."""
+        """One scheduling attempt; returns True when done. Migration-
+        budget slots claimed by the attempt (nomad_tpu/migrate) are
+        held until its plan submit finishes — success or failure, the
+        displaced allocs are no longer in flight HERE once the attempt
+        ends, and a retry re-claims against fresh state."""
+        self._migrate_permits = 0
+        try:
+            return self._process_attempt()
+        finally:
+            if self._migrate_permits:
+                from ..migrate import get_governor
+
+                get_governor().release(self._migrate_permits)
+                self._migrate_permits = 0
+
+    def _process_attempt(self) -> bool:
         self.job = self.state.job_by_id(self.eval.job_id)
         self.queued_allocs = {}
 
@@ -181,6 +204,9 @@ class GenericScheduler:
 
         adjust_queued_allocations(self.logger, result, self.queued_allocs)
 
+        if result is not None and result.node_preemptions:
+            self._create_preemption_followups(result)
+
         if new_state is not None:
             self.state = new_state
             return False
@@ -195,7 +221,55 @@ class GenericScheduler:
 
         return True
 
+    def _create_preemption_followups(self, result: PlanResult) -> None:
+        """Every job whose alloc this plan's preemption leg evicted
+        gets a replacement eval (triggered_by=preemption) — it usually
+        blocks until capacity returns (the cluster was red), but the
+        evicted work is never silently forgotten. One eval per job per
+        process_eval, however many attempts commit victims."""
+        followed = getattr(self, "_preempt_followed", None)
+        if followed is None:
+            followed = self._preempt_followed = set()
+        from ..structs.eval import new_eval
+
+        for victims in result.node_preemptions.values():
+            for victim in victims:
+                if victim.job_id in followed:
+                    continue
+                followed.add(victim.job_id)
+                job = self.state.job_by_id(victim.job_id)
+                if job is None:
+                    continue
+                self.planner.create_eval(
+                    new_eval(job, consts.EVAL_TRIGGER_PREEMPTION))
+
     # ------------------------------------------------------------------
+
+    def _inplace_update(self, updates: List[AllocTuple]):
+        """In-place-vs-destructive routing hook: the host scheduler
+        runs the reference's sequential stage-evict-select-pop pass;
+        the dense subclass swaps in the batched host-side check
+        (scheduler/util.py inplace_update_batched) so only genuinely
+        destructive updates reach the device placement path."""
+        return inplace_update(
+            self.ctx, self.eval, self.job, self.stack, updates)
+
+    def _defer_migrations(self) -> None:
+        """Mint (once per eval) the follow-up migration eval that
+        re-runs this job's reconciliation for the displaced allocs the
+        budget deferred. Deliberately NOT placed in the next_eval slot:
+        that seat belongs to the rolling-update stagger follow-up, and
+        displacing it would collapse the operator's stagger pacing to
+        MIGRATE_RETRY_WAIT whenever a drain coincides with a rolling
+        deploy — the two follow-ups coexist (the broker dedups per-job
+        delivery; a no-op re-reconciliation is cheap)."""
+        if self._migration_eval is not None:
+            return
+        from ..migrate import MIGRATE_RETRY_WAIT
+
+        ev = self.eval.next_migration_eval(MIGRATE_RETRY_WAIT)
+        self._migration_eval = ev
+        self.planner.create_eval(ev)
 
     def _filter_complete_allocs(self, allocs: List[Allocation]):
         """Drop terminal allocs; for batch, keep successfully-completed
@@ -247,9 +321,7 @@ class GenericScheduler:
         for e in diff.stop:
             self.plan.append_update(e.alloc, consts.ALLOC_DESIRED_STOP, ALLOC_NOT_NEEDED)
 
-        destructive, inplace = inplace_update(
-            self.ctx, self.eval, self.job, self.stack, diff.update
-        )
+        destructive, inplace = self._inplace_update(diff.update)
         diff.update = destructive
 
         if self.eval.annotate_plan:
@@ -263,9 +335,34 @@ class GenericScheduler:
         if self.job is not None and self.job.update is not None and self.job.update.rolling():
             limit = [self.job.update.max_parallel]
 
-        self.limit_reached = evict_and_place(
-            self.ctx, diff, diff.migrate, ALLOC_MIGRATING, limit
-        )
+        # Drain-storm migration budget (nomad_tpu/migrate): claim
+        # in-flight slots for the displaced allocs; whatever the
+        # governor defers rides a follow-up migration eval instead of
+        # joining this plan — a 100-node drain storm drains in bounded
+        # waves instead of thundering-herding the plan queue.
+        migrate_now = diff.migrate
+        if migrate_now:
+            from .. import trace
+            from ..migrate import check_migration_chaos, get_governor
+
+            check_migration_chaos(self.eval.id)
+            _t0 = time.monotonic()
+            granted = get_governor().acquire(len(migrate_now))
+            self._migrate_permits += granted
+            deferred = len(migrate_now) - granted
+            if deferred:
+                migrate_now = migrate_now[:granted]
+                self._defer_migrations()
+            self.limit_reached = evict_and_place(
+                self.ctx, diff, migrate_now, ALLOC_MIGRATING, limit
+            )
+            trace.record_span(
+                self.eval.id, trace.STAGE_MIGRATE_PLACE, _t0,
+                ann={"migrations": len(migrate_now),
+                     "deferred": deferred},
+                trace_id=self.eval.trace_id)
+        else:
+            self.limit_reached = False
         self.limit_reached = self.limit_reached or evict_and_place(
             self.ctx, diff, diff.update, ALLOC_UPDATING, limit
         )

@@ -105,20 +105,30 @@ class Harness:
             result = PlanResult(
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
+                node_preemptions=plan.node_preemptions,
                 alloc_index=index,
             )
 
             allocs = []
             for update_list in plan.node_update.values():
                 allocs.extend(update_list)
+            for victim_list in plan.node_preemptions.values():
+                allocs.extend(victim_list)
             for alloc_list in plan.node_allocation.values():
                 allocs.extend(alloc_list)
 
             # Plans strip the job from allocs to avoid re-encoding it;
-            # denormalize before inserting.
+            # denormalize before inserting (other jobs' preemption
+            # victims re-denormalize from their stored record, like
+            # the FSM funnel does).
             for alloc in allocs:
-                if plan.job is not None and alloc.job is None:
-                    alloc.job = plan.job
+                if alloc.job is None:
+                    if plan.job is not None and alloc.job_id == plan.job.id:
+                        alloc.job = plan.job
+                    else:
+                        stored = self.state.alloc_by_id(alloc.id)
+                        if stored is not None:
+                            alloc.job = stored.job
                 # Stamp create/modify indexes on the result's allocs the way
                 # the Go store mutates shared structs (state_store.go:922):
                 # new allocs get this index, existing ones keep theirs —

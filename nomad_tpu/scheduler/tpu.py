@@ -105,6 +105,16 @@ class BatchedTPUScheduler(GenericScheduler):
         super().__init__(logger, state, planner, batch=batch, rng=rng)
         self.kernel = kernel
 
+    def _inplace_update(self, updates):
+        """Batched host-side in-place routing (scheduler/util.py
+        inplace_update_batched): compatible tweaks rewrite allocs with
+        zero evictions and zero device dispatches; only destructive
+        updates flow on to the dense placement path."""
+        from .util import inplace_update_batched
+
+        return inplace_update_batched(
+            self.ctx, self.eval, self.job, self.stack, updates)
+
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
         from ..ops.binpack import (
@@ -142,13 +152,19 @@ class BatchedTPUScheduler(GenericScheduler):
         if not bulk:
             self._repay_cohort()
             return
-        if len(bulk) <= 3:
+        from ..migrate import preemption_eligible
+
+        may_preempt = preemption_eligible(self.eval.priority)
+        if len(bulk) <= 3 and not may_preempt:
             # Too few placements to amortize a dispatch — typical for
             # the retry after a partially-rejected plan (1-3 conflicted
             # allocs replanned on a FRESH snapshot, so the dense path
             # would also pay a new matrix + base token). The host
             # iterators place a handful in low-ms with identical
-            # semantics.
+            # semantics. A preemption-eligible eval stays dense at ANY
+            # size: the host iterators cannot evict, and the retry
+            # after a partially-committed preemption plan is exactly a
+            # 1-3 ask replan that still needs the eviction leg.
             self._repay_cohort()
             super()._compute_placements(bulk)
             return
@@ -293,6 +309,10 @@ class BatchedTPUScheduler(GenericScheduler):
         # only (coalesced failures and port-collision host re-places
         # never commit through this loop).
         committed: List[Tuple[int, int]] = []
+        # Asks the kernel could not place: candidates for the dense
+        # preemption pass (red pressure + outranking eval only) before
+        # they become recorded failures.
+        unplaced: List[AllocTuple] = []
 
         for j, missing in enumerate(bulk):
             # Coalesce once the TG has failed, even if the kernel found a
@@ -309,9 +329,12 @@ class BatchedTPUScheduler(GenericScheduler):
             metrics.nodes_available = matrix.nodes_by_dc
 
             if node is None:
-                self._record_placement_failure(
-                    missing, matrix, metrics, tg_indices
-                )
+                if may_preempt:
+                    unplaced.append(missing)
+                else:
+                    self._record_placement_failure(
+                        missing, matrix, metrics, tg_indices
+                    )
                 continue
 
             metrics.score_node(node, "binpack", float(scores[j]))
@@ -335,6 +358,172 @@ class BatchedTPUScheduler(GenericScheduler):
         # can compare. Cheap ([N,4] copy + vector ops) next to the
         # dispatch it follows.
         self._note_quality(kernel, matrix, ask_arrays[0], committed)
+
+        if unplaced:
+            self._preempt_placements(unplaced, tg_indices)
+
+    def _preempt_placements(self, pending: List[AllocTuple],
+                            tg_indices: Dict[str, int]) -> None:
+        """The dense preemption pass (ops/preempt.py): place the asks
+        the normal kernel could not, by selecting lowest-priority
+        victims and the placement in the same masked program. Runs
+        only when migrate.preemption_eligible said yes (preemption on,
+        cluster red, eval outranks the threshold). Victim evictions
+        are staged on the plan's node_preemptions leg and re-verified
+        per victim by the plan applier before committing with the
+        placements in one raft apply — chaos site preempt.victim_lost
+        drops a staged victim here to prove that verification."""
+        from ..chaos import chaos
+        from ..migrate import note_preemption
+        from ..models.matrix import ClusterMatrix
+        from ..ops.binpack import (
+            PlacementConfig,
+            host_prng_key,
+            make_asks,
+            make_node_state,
+        )
+        from ..ops.preempt import (
+            make_victim_state,
+            preempt_placement_program_jit,
+        )
+        from .stack import (
+            BATCH_JOB_ANTI_AFFINITY_PENALTY,
+            SERVICE_JOB_ANTI_AFFINITY_PENALTY,
+        )
+        from .util import ALLOC_PREEMPTED
+
+        def fail_all(rows: List[AllocTuple], pm) -> None:
+            for missing in rows:
+                name = missing.task_group.name
+                if self.failed_tg_allocs and name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[name].coalesced_failures += 1
+                    continue
+                metrics = AllocMetric()
+                metrics.nodes_evaluated = pm.n_real
+                metrics.nodes_available = pm.nodes_by_dc
+                self._record_placement_failure(missing, pm, metrics,
+                                               tg_indices)
+
+        _t0 = time.monotonic()
+        # A FRESH matrix including this very plan's placements and
+        # staged stops (the plan is non-no-op by now, so this build is
+        # uncacheable by design): the preemption pass must not claim
+        # headroom an earlier ask of this same eval just took, and its
+        # victim lists must exclude allocs the plan already stops.
+        pm = ClusterMatrix(self.state, self.job, self.plan)
+        varrays, victim_lists, n_candidates = pm.build_victims(
+            self.eval.priority)
+        if n_candidates == 0:
+            fail_all(pending, pm)
+            return
+        placements = [tg_indices[m.task_group.name] for m in pending]
+        ask_arrays = pm.build_asks(placements)
+        asks = make_asks(*ask_arrays)
+        state = make_node_state(
+            pm.capacity, pm.sched_capacity, pm.util, pm.bw_avail,
+            pm.bw_used, pm.ports_free, pm.job_count, pm.tg_count,
+            pm.feasible, pm.node_ok)
+        victims = make_victim_state(*varrays)
+        penalty = (BATCH_JOB_ANTI_AFFINITY_PENALTY if self.batch
+                   else SERVICE_JOB_ANTI_AFFINITY_PENALTY)
+        # Plain greedy config: the preemption program is its own
+        # compiled entry point — kernel variants do not apply here.
+        config = PlacementConfig(anti_affinity_penalty=penalty)
+        key = host_prng_key(self.rng.getrandbits(31))
+        # The preemption dispatch shares the device-path breaker: a
+        # persistently failing preempt program (e.g. device OOM from
+        # the extra victim tensors) must become one routing decision,
+        # not a fresh dispatch-failure latency per red-pressure eval.
+        from ..admission import get_breaker
+        from ..utils import metrics as _metrics
+
+        breaker = get_breaker()
+        if not breaker.acquire():
+            _metrics.incr_counter(
+                ("scheduler", "preempt_breaker_rejected"), len(pending))
+            fail_all(pending, pm)
+            return
+        _t_solve = time.monotonic()
+        try:
+            choices, scores, counts = preempt_placement_program_jit(
+                state, victims, asks, key,
+                np.float32(self.eval.priority), config)
+        except Exception:  # noqa: BLE001 - degrade to plain failure
+            # The device path is sick (the cluster may be red for that
+            # very reason): these asks simply stay failed/blocked — the
+            # no-preemption outcome, never a half-staged eviction. The
+            # breaker counts the failure like any dense dispatch.
+            breaker.record_failure()
+            self.logger.warning(
+                "preemption dispatch failed; %d placements stay "
+                "unplaced", len(pending), exc_info=True)
+            _metrics.incr_counter(
+                ("scheduler", "preempt_dispatch_failed"), len(pending))
+            fail_all(pending, pm)
+            return
+        breaker.record_success((time.monotonic() - _t_solve) * 1000.0)
+        choices = np.asarray(choices)
+        scores = np.asarray(scores)
+        counts = np.asarray(counts)
+        trace.record_span(
+            self.eval.id, trace.STAGE_PREEMPT_SELECT, _t0,
+            ann={"asks": len(pending), "candidates": n_candidates},
+            trace_id=self.eval.trace_id)
+
+        net_indexes: Dict[str, NetworkIndex] = {}
+        consumed: Dict[int, int] = {}
+        staged_total = 0
+        placed_total = 0
+        for j, missing in enumerate(pending):
+            name = missing.task_group.name
+            if self.failed_tg_allocs and name in self.failed_tg_allocs:
+                self.failed_tg_allocs[name].coalesced_failures += 1
+                continue
+            choice = int(choices[j])
+            node = pm.nodes[choice] if 0 <= choice < pm.n_real else None
+            metrics = AllocMetric()
+            metrics.nodes_evaluated = pm.n_real
+            metrics.nodes_available = pm.nodes_by_dc
+            if node is None:
+                self._record_placement_failure(missing, pm, metrics,
+                                               tg_indices)
+                continue
+            cnt = int(counts[j])
+            taken = []
+            if cnt > 0:
+                lst = victim_lists.get(choice, [])
+                start = consumed.get(choice, 0)
+                taken = lst[start:start + cnt]
+                consumed[choice] = start + len(taken)
+            staged = 0
+            for victim in taken:
+                if chaos.enabled and chaos.fire(
+                        "preempt.victim_lost", eval_id=self.eval.id,
+                        alloc=victim.id) == "drop":
+                    # The victim vanished between selection and commit:
+                    # its freed capacity was already counted on device,
+                    # so the plan under-frees — the applier's exact
+                    # verification rejects the node and forces a replan.
+                    continue
+                self.plan.append_preemption(
+                    victim, consts.ALLOC_DESIRED_EVICT, ALLOC_PREEMPTED)
+                staged += 1
+            metrics.score_node(node, "preempt", float(scores[j]))
+            task_resources = _offer_networks(
+                self.rng, missing, node, net_indexes, pm)
+            if task_resources is None:
+                # Port collision on the chosen node: back the victims
+                # out — an eviction must never commit without the
+                # placement it was freeing room for.
+                self.plan.pop_preemptions(node.id, staged)
+                self._record_placement_failure(missing, pm, metrics,
+                                               tg_indices)
+                continue
+            self.plan.append_alloc(_build_allocation(
+                self, missing, node, task_resources, metrics))
+            staged_total += staged
+            placed_total += 1
+        note_preemption(staged_total, placed_total)
 
     def _note_quality(self, kernel, matrix, ask_res, committed) -> None:
         from ..kernels.quality import (

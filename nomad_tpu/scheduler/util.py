@@ -32,6 +32,7 @@ ALLOC_UPDATING = "alloc is being updated due to job update"
 ALLOC_LOST = "alloc is lost since its node is down"
 ALLOC_IN_PLACE = "alloc updating in-place"
 ALLOC_NODE_TAINTED = "system alloc not needed as node is tainted"
+ALLOC_PREEMPTED = "alloc preempted by a higher-priority evaluation"
 
 
 @dataclass
@@ -77,7 +78,11 @@ def proposed_allocs_for_node(state, plan: Optional[Plan], node_id: str) -> List[
     existing = state.allocs_by_node_terminal(node_id, False)
     proposed = existing
     if plan is not None:
-        updates = plan.node_update.get(node_id, [])
+        # Preemption victims free their capacity exactly like staged
+        # stops — the plan applier re-verifies each victim separately
+        # before trusting this discount (server/plan_apply.py).
+        updates = (plan.node_update.get(node_id, [])
+                   + plan.node_preemptions.get(node_id, []))
         if updates:
             proposed = remove_allocs(existing, updates)
         by_id = {a.id: a for a in proposed}
@@ -248,7 +253,16 @@ def tainted_nodes(state, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
 
 def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
     """Whether the difference between two task groups requires a
-    destructive update (new alloc) rather than in-place."""
+    destructive update (new alloc) rather than in-place.
+
+    In-place rules: env/meta-level tweaks are COMPATIBLE — the client
+    re-renders the task environment from the updated alloc without the
+    placement moving, so a routine spec tweak is not a churn event
+    (README "Churn & migration"; the reference restarts the task but
+    never re-places it, which is the half that matters to the
+    scheduler). Anything that changes what runs (driver/config/
+    artifacts/vault) or what it consumes (resources/networks/disk)
+    stays destructive and routes to the placement path."""
     if len(a.tasks) != len(b.tasks):
         return True
     if a.ephemeral_disk != b.ephemeral_disk:
@@ -259,7 +273,7 @@ def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
             return True
         if at.driver != bt.driver or at.user != bt.user:
             return True
-        if at.config != bt.config or at.env != bt.env or at.meta != bt.meta:
+        if at.config != bt.config:
             return True
         if at.artifacts != bt.artifacts or at.vault != bt.vault:
             return True
@@ -272,6 +286,8 @@ def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
                 return True
         ar, br = at.resources, bt.resources
         if ar.cpu != br.cpu or ar.memory_mb != br.memory_mb or ar.iops != br.iops:
+            return True
+        if ar.disk_mb != br.disk_mb:
             return True
     return False
 
@@ -342,20 +358,100 @@ def inplace_update(
             destructive.append(update)
             continue
 
-        # Networks cannot change in-place (guarded by tasks_updated), so
-        # restore the existing offers onto the re-selected resources.
-        for task_name, resources in option.task_resources.items():
-            existing_res = update.alloc.task_resources.get(task_name)
-            if existing_res is not None:
-                resources.networks = existing_res.networks
+        _stage_inplace_alloc(ctx, eval, update, option.task_resources)
+        inplace.append(update)
+    return destructive, inplace
 
-        new_alloc = update.alloc.copy()
-        new_alloc.eval_id = eval.id
-        new_alloc.job = None  # plan carries the job
-        new_alloc.resources = None  # computed at plan apply
-        new_alloc.task_resources = option.task_resources
-        new_alloc.metrics = ctx.metrics
-        ctx.plan.append_alloc(new_alloc)
+
+def _stage_inplace_alloc(ctx, eval: Evaluation, update: AllocTuple,
+                         task_resources) -> None:
+    """The one in-place alloc rewrite both paths (sequential +
+    batched) stage: restore the existing network offers (networks
+    cannot change in-place — guarded by tasks_updated), copy the
+    alloc forward under this eval, and append it to the plan. Shared
+    so the field set can never desync between the paths the parity
+    tests compare."""
+    for task_name, resources in task_resources.items():
+        existing_res = update.alloc.task_resources.get(task_name)
+        if existing_res is not None:
+            resources.networks = existing_res.networks
+    new_alloc = update.alloc.copy()
+    new_alloc.eval_id = eval.id
+    new_alloc.job = None  # plan carries the job
+    new_alloc.resources = None  # computed at plan apply
+    new_alloc.task_resources = task_resources
+    new_alloc.metrics = ctx.metrics
+    ctx.plan.append_alloc(new_alloc)
+
+
+def inplace_update_batched(
+    ctx, eval: Evaluation, job: Job, stack, updates: List[AllocTuple]
+) -> Tuple[List[AllocTuple], List[AllocTuple]]:
+    """The dense schedulers' batched equivalent of inplace_update: the
+    compatibility check (tasks_updated) is pure host work against the
+    MVCC snapshot, and a COMPATIBLE update by construction consumes
+    exactly the resources its predecessor held (tasks_updated returns
+    True for any cpu/memory/iops/disk/network change) — so the
+    reference's stage-eviction-re-select-pop dance per alloc
+    (scheduler/util.go:441, K sequential one-node iterator stacks)
+    collapses to a node-liveness check plus a direct alloc rewrite.
+    Only genuinely destructive updates flow on to the device placement
+    path (SURVEY.md section 7: in-place checks host-side, bulk
+    placements on device). Semantics match the sequential path
+    placement-for-placement: parity-tested against it."""
+    from .feasible import ConstraintChecker, DriverChecker
+
+    # One checker pair per task group, built lazily: the NEW job's
+    # constraints may have tightened, and an in-place rewrite must not
+    # keep an alloc on a node the updated spec forbids (the sequential
+    # path catches this inside stack.select's feasibility iterators).
+    checkers: Dict[str, Tuple[ConstraintChecker, DriverChecker]] = {}
+
+    def tg_feasible(tg: TaskGroup, node: Node) -> bool:
+        pair = checkers.get(tg.name)
+        if pair is None:
+            cons = list(job.constraints) + list(tg.constraints)
+            drivers = set()
+            for task in tg.tasks:
+                cons.extend(task.constraints)
+                drivers.add(task.driver)
+            pair = (ConstraintChecker(ctx, cons),
+                    DriverChecker(ctx, drivers))
+            checkers[tg.name] = pair
+        cons_checker, driver_checker = pair
+        return cons_checker.feasible(node) and driver_checker.feasible(node)
+
+    destructive: List[AllocTuple] = []
+    inplace: List[AllocTuple] = []
+    for update in updates:
+        existing_tg = (
+            update.alloc.job.lookup_task_group(update.task_group.name)
+            if update.alloc.job
+            else None
+        )
+        if existing_tg is None or tasks_updated(update.task_group, existing_tg):
+            destructive.append(update)
+            continue
+        node = ctx.state.node_by_id(update.alloc.node_id)
+        if node is None or not node.ready():
+            # The sequential path's pinned re-select fails on a dead or
+            # draining node the same way.
+            destructive.append(update)
+            continue
+        if not tg_feasible(update.task_group, node):
+            destructive.append(update)
+            continue
+
+        # Same resources, same node: rebuild task_resources from the
+        # NEW job's tasks (names/shape may differ even when amounts do
+        # not); _stage_inplace_alloc carries the existing network
+        # offers over, exactly as the sequential path restores them
+        # post-select.
+        task_resources = {
+            task.name: task.resources.copy()
+            for task in update.task_group.tasks
+        }
+        _stage_inplace_alloc(ctx, eval, update, task_resources)
         inplace.append(update)
     return destructive, inplace
 

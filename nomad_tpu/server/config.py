@@ -102,6 +102,24 @@ class ServerConfig:
     # 0 = auto (max(64, N/4)).
     resident_rebuild_rows: int = 0
 
+    # ---- Churn control (nomad_tpu/migrate) ----
+    # In-flight migration budget: how many drain-displaced allocs may
+    # be claimed by scheduling attempts at once, cluster-wide (the
+    # reference's drain max_parallel analog). Displaced allocs past
+    # the budget ride follow-up migration evals — a 100-node drain
+    # storm re-places in bounded waves instead of thundering-herding
+    # the plan queue. 0 = unbounded.
+    migrate_max_parallel: int = 32
+    # Priority preemption (ops/preempt.py): allow a red-pressure,
+    # above-threshold-priority eval whose placements found no room to
+    # evict lowest-priority allocs in the same dense pass. Off by
+    # default: with it off, a red cluster sheds exactly per the PR 5
+    # admission policy.
+    preemption_enabled: bool = False
+    # Evals must STRICTLY outrank this to preempt (50 = the default
+    # job priority, so only above-normal work may evict).
+    preempt_priority_threshold: int = 50
+
     # ---- Overload protection (nomad_tpu/admission) ----
     # Bounded broker ready queues: default per-scheduler-type depth cap
     # (0 = unbounded) plus per-type overrides. A full queue sheds the

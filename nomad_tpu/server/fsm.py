@@ -216,8 +216,18 @@ class FSM:
         allocs: List[Allocation] = payload["allocs"]
         job = payload.get("job")
         for alloc in allocs:
-            if alloc.job is None and job is not None:
-                alloc.job = job
+            if alloc.job is None:
+                if job is not None and alloc.job_id == job.id:
+                    alloc.job = job
+                else:
+                    # A plan may carry OTHER jobs' allocs (preemption
+                    # victims): re-denormalize from the stored record,
+                    # never from the submitting plan's job — a victim
+                    # stamped with the preemptor's job would lie about
+                    # its own priority to every later scheduler pass.
+                    stored = self.state.alloc_by_id(alloc.id)
+                    if stored is not None:
+                        alloc.job = stored.job
         t0 = time.monotonic()
         self.state.upsert_allocs(index, allocs)
         # Trace: the state-store write is the lifecycle's last

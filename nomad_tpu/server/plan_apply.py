@@ -25,9 +25,39 @@ from .fsm import ALLOC_UPDATE
 from .plan_queue import PendingPlan, PlanQueue
 
 
+def evaluate_node_preemptions(snapshot, plan: Plan, node_id: str) -> bool:
+    """Per-victim verification of a preemption leg: every victim must
+    still exist, be non-terminal, and be STRICTLY lower-priority than
+    the plan. A victim that completed, died, or was replaced underneath
+    the scheduler (chaos site preempt.victim_lost models the same
+    shape from the other side: a victim whose freed capacity was
+    counted but whose eviction never got staged) rejects the node —
+    the freed-capacity discount the placement relied on is void, so
+    the whole node replans on fresh state."""
+    victims = plan.node_preemptions.get(node_id)
+    if not victims:
+        return True
+    from ..migrate import victim_priority
+
+    # The node's LIVE allocs through whichever view we were handed —
+    # the optimistic overlay already hides in-flight evictions, so a
+    # victim another pipelined plan is stopping verifies as lost here.
+    live = {a.id: a
+            for a in snapshot.allocs_by_node_terminal(node_id, False)}
+    for victim in victims:
+        stored = live.get(victim.id)
+        if stored is None or stored.terminal_status():
+            return False
+        if victim_priority(stored) >= plan.priority:
+            return False
+    return True
+
+
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> bool:
     """Whether the plan's changes to one node can be applied against the
     given state (plan_apply.go:318 evaluateNodePlan)."""
+    if not evaluate_node_preemptions(snapshot, plan, node_id):
+        return False
     if not plan.node_allocation.get(node_id):
         return True  # evictions only: always safe
 
@@ -62,6 +92,11 @@ class OptimisticSnapshot:
             for alloc in allocs:
                 d[alloc.id] = alloc
         for allocs in result.node_update.values():
+            for alloc in allocs:
+                self._evicted.add(alloc.id)
+        # In-flight preemption evictions hide from the next plan's
+        # verification exactly like staged stops do.
+        for allocs in result.node_preemptions.values():
             for alloc in allocs:
                 self._evicted.add(alloc.id)
         self._dirty = True
@@ -309,9 +344,11 @@ class PlanApplier:
         result = PlanResult(
             node_update=dict(plan.node_update),
             node_allocation=dict(plan.node_allocation),
+            node_preemptions=dict(plan.node_preemptions),
         )
 
-        node_ids = set(plan.node_update) | set(plan.node_allocation)
+        node_ids = (set(plan.node_update) | set(plan.node_allocation)
+                    | set(plan.node_preemptions))
         futures = {
             node_id: self.pool.submit(evaluate_node_plan, snapshot, plan, node_id)
             for node_id in node_ids
@@ -331,6 +368,7 @@ class PlanApplier:
                 # Gang commit: reject everything, force a refresh.
                 result.node_update = {}
                 result.node_allocation = {}
+                result.node_preemptions = {}
                 result.refresh_index = snapshot.latest_index()
                 self.plans_rejected += 1
                 self.nodes_rejected += rejected
@@ -343,6 +381,7 @@ class PlanApplier:
                 return result
             result.node_update.pop(node_id, None)
             result.node_allocation.pop(node_id, None)
+            result.node_preemptions.pop(node_id, None)
             result.refresh_index = snapshot.latest_index()
         if rejected:
             self.plans_rejected += 1
@@ -373,11 +412,22 @@ class PlanApplier:
         allocs: List[Allocation] = []
         for update_list in result.node_update.values():
             allocs.extend(update_list)
+        n_preempted = 0
+        for victim_list in result.node_preemptions.values():
+            # Victims ride the SAME raft apply as the placements they
+            # make room for: one log entry, one terminal stamp — the
+            # exactly-once contract the preemption soak asserts.
+            allocs.extend(victim_list)
+            n_preempted += len(victim_list)
         for alloc_list in result.node_allocation.values():
             allocs.extend(alloc_list)
         index = self.log.apply(
             ALLOC_UPDATE, {"allocs": allocs, "job": plan.job}
         )
+        if n_preempted:
+            from ..migrate import note_preemption_committed
+
+            note_preemption_committed(n_preempted)
         trace.record_span(plan.eval_id, trace.STAGE_PLAN_COMMIT, start,
                           ann={"allocs": len(allocs)}, create=False)
         # Stamp indexes onto the result's alloc objects the way the Go

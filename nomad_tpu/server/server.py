@@ -33,6 +33,7 @@ from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import FAILED_QUEUE, EvalBroker
 from ..kernels.quality import get_board as _quality_board
+from ..migrate import churn_stats as _churn_stats
 from ..models.resident import device_state_stats as _device_state_stats
 from .config import ServerConfig
 from .core_gc import CoreScheduler
@@ -136,6 +137,19 @@ class Server:
         from ..kernels import configure as configure_kernels
 
         configure_kernels(self.config.placement_kernel)
+        # Churn control (nomad_tpu/migrate): the migration budget and
+        # the preemption policy are process-global like the breaker;
+        # the pressure probe points preemption eligibility at THIS
+        # server's admission signal (PR 5) — preemption only ever
+        # fires on a red cluster.
+        from ..migrate import configure as configure_migrate
+
+        configure_migrate(
+            migrate_max_parallel=self.config.migrate_max_parallel,
+            preemption_enabled=self.config.preemption_enabled,
+            preempt_priority_threshold=self.config.preempt_priority_threshold,
+            pressure_probe=self.admission.level,
+        )
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -1324,6 +1338,10 @@ class Server:
             # p99 — how WELL the active kernel places, next to the
             # trace table's how-fast.
             "placement_quality": _quality_board().snapshot(),
+            # Churn control (nomad_tpu/migrate): migration-budget
+            # in-flight/high-water/deferral counters + preemption
+            # staged/committed/placement tallies.
+            "churn": _churn_stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

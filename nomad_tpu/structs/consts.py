@@ -63,6 +63,14 @@ EVAL_TRIGGER_SHED = "shed-overload"
 # ... or its creation-stamped deadline passed before it could be
 # dispatched (broker dequeue skip / dispatch-pipeline launch drop).
 EVAL_TRIGGER_EXPIRED = "deadline-expired"
+# Churn workflows (nomad_tpu/migrate): a drain storm's displaced allocs
+# that exceeded the in-flight migration budget ride a follow-up eval
+# with this trigger (the budget analog of rolling-update follow-ups) ...
+EVAL_TRIGGER_MIGRATION = "migration-budget"
+# ... and a job whose alloc was evicted by a higher-priority eval's
+# preemption pass gets a replacement eval with this trigger (it
+# typically blocks until capacity returns — the cluster was red).
+EVAL_TRIGGER_PREEMPTION = "preemption"
 
 # --- Task states (structs.go:2317) ---
 TASK_STATE_PENDING = "pending"

@@ -105,6 +105,23 @@ class Evaluation:
             trace_id=generate_uuid(),
         )
 
+    def next_migration_eval(self, wait: float) -> "Evaluation":
+        """Follow-up eval for displaced allocs deferred past the
+        in-flight migration budget (nomad_tpu/migrate): the drain
+        storm's next wave, the budget analog of next_rolling_eval."""
+        return Evaluation(
+            id=generate_uuid(),
+            priority=self.priority,
+            type=self.type,
+            triggered_by=consts.EVAL_TRIGGER_MIGRATION,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=consts.EVAL_STATUS_PENDING,
+            wait=wait,
+            previous_eval=self.id,
+            trace_id=generate_uuid(),
+        )
+
     def create_blocked_eval(
         self,
         class_eligibility: Dict[str, bool],

@@ -26,6 +26,14 @@ class Plan:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     # node id -> new allocations for that node
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # node id -> lower-priority allocs this plan evicts to make room
+    # for its placements (the dense preemption pass, ops/preempt.py).
+    # A separate leg from node_update because the applier VERIFIES it
+    # differently: each victim must still exist, be non-terminal, and
+    # be strictly lower-priority than the plan — a victim that died or
+    # changed underneath the scheduler rejects the node and forces a
+    # replan, exactly like a placement that no longer fits.
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
     annotations: Optional["PlanAnnotations"] = None
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
     # Raft watermark of the snapshot the dense node matrix serving this
@@ -61,8 +69,32 @@ class Plan:
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
 
+    def append_preemption(
+        self, alloc: Allocation, desired_status: str, description: str
+    ) -> None:
+        """Stage a preemption eviction of a lower-priority alloc. The
+        scheduler passes consts.ALLOC_DESIRED_EVICT; the stamp commits
+        through the plan applier's raft apply after per-victim
+        verification (server/plan_apply.py), never directly."""
+        new_alloc = alloc.copy()
+        new_alloc.job = None
+        new_alloc.desired_status = desired_status
+        new_alloc.desired_description = description
+        self.node_preemptions.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def pop_preemptions(self, node_id: str, n: int) -> None:
+        """Un-stage the last ``n`` preemptions for a node (the dense
+        commit loop backs out victims when the placement they were
+        freeing room for fails host-side port assignment)."""
+        victims = self.node_preemptions.get(node_id, [])
+        if n > 0:
+            del victims[-n:]
+        if not victims:
+            self.node_preemptions.pop(node_id, None)
+
     def is_no_op(self) -> bool:
-        return not self.node_update and not self.node_allocation
+        return (not self.node_update and not self.node_allocation
+                and not self.node_preemptions)
 
     def copy(self) -> "Plan":
         return copy.deepcopy(self)
@@ -72,11 +104,16 @@ class Plan:
 class PlanResult:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # Preemption evictions that passed per-victim verification and
+    # committed with the plan (the scheduler mints the victims' jobs
+    # replacement evals from this).
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
     refresh_index: int = 0  # worker must refresh its snapshot to this index
     alloc_index: int = 0  # raft index the accepted allocs committed at
 
     def is_no_op(self) -> bool:
-        return not self.node_update and not self.node_allocation
+        return (not self.node_update and not self.node_allocation
+                and not self.node_preemptions)
 
     def full_commit(self, plan: Plan) -> tuple:
         """Compare attempted vs accepted placements: (full, expected, actual)."""

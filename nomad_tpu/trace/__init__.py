@@ -27,9 +27,11 @@ from .span import (  # noqa: F401
     STAGE_DISPATCH_LAUNCH,
     STAGE_MATRIX_BUILD,
     STAGE_MATRIX_UPDATE,
+    STAGE_MIGRATE_PLACE,
     STAGE_PLAN_COMMIT,
     STAGE_PLAN_EVALUATE,
     STAGE_PLAN_SUBMIT,
+    STAGE_PREEMPT_SELECT,
     STAGE_SCHED_PROCESS,
 )
 

@@ -32,6 +32,11 @@ STAGE_DEVICE_DISPATCH = "device.dispatch"  # batcher.place round-trip
 STAGE_DEVICE_SOLVE = "device.solve"        # the jitted placement-kernel
 #   solve inside the dispatch (issue + device sync, kernel-annotated) —
 #   device.dispatch minus batch-wait and host stacking
+STAGE_MIGRATE_PLACE = "migrate.place"      # drain-displaced allocs staged
+#   for re-placement under the migration budget (ann: migrations
+#   claimed this wave, deferred to the follow-up eval)
+STAGE_PREEMPT_SELECT = "preempt.select"    # dense victim-selection +
+#   placement pass (ops/preempt.py; ann: asks, candidate victims)
 STAGE_PLAN_SUBMIT = "plan.submit"          # plan queue wait + commit (worker view)
 STAGE_PLAN_EVALUATE = "plan.evaluate"      # applier per-node verification
 STAGE_PLAN_COMMIT = "plan.commit"          # raft apply of the accepted plan
@@ -47,6 +52,8 @@ ALL_STAGES = (
     STAGE_DEVICE_TRANSFER,
     STAGE_DEVICE_DISPATCH,
     STAGE_DEVICE_SOLVE,
+    STAGE_MIGRATE_PLACE,
+    STAGE_PREEMPT_SELECT,
     STAGE_PLAN_SUBMIT,
     STAGE_PLAN_EVALUATE,
     STAGE_PLAN_COMMIT,

@@ -361,3 +361,55 @@ def test_drain_on_leadership_loss_requeues_pending():
         assert server.broker.unacked_count() == 0
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# churn-PR sites: registered, deterministic, documented
+
+
+def test_churn_sites_registered_and_deterministic():
+    """drain.mid_migration + preempt.victim_lost are first-class sites:
+    arm() accepts them and the same seed reproduces the identical
+    firing log (the registry acceptance bar applied to the new rows)."""
+    from nomad_tpu.chaos.registry import KNOWN_SITES
+
+    assert "drain.mid_migration" in KNOWN_SITES
+    assert "preempt.victim_lost" in KNOWN_SITES
+
+    schedule = [
+        FaultSpec("drain.mid_migration", "error", prob=0.5, count=3),
+        FaultSpec("preempt.victim_lost", "drop", prob=0.4),
+    ]
+
+    def drive():
+        for i in range(25):
+            try:
+                chaos.fire("drain.mid_migration", eval_id=f"e{i}")
+            except ChaosInjectedError:
+                pass
+            chaos.fire("preempt.victim_lost", eval_id=f"e{i}",
+                       alloc=f"a{i}")
+        return chaos.firing_log()
+
+    with chaos.armed(2026, schedule):
+        log1 = drive()
+    with chaos.armed(2026, [
+        FaultSpec("drain.mid_migration", "error", prob=0.5, count=3),
+        FaultSpec("preempt.victim_lost", "drop", prob=0.4),
+    ]):
+        log2 = drive()
+    assert log1 and log1 == log2
+    assert {s for s, _n, _k, _d in log1} == {"drain.mid_migration",
+                                             "preempt.victim_lost"}
+
+
+def test_churn_sites_documented_in_failure_model_table():
+    """The README Failure-model table carries a row for every new
+    churn site (doc drift guard, same shape as the trace stage table
+    check)."""
+    import os
+
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    for site in ("drain.mid_migration", "preempt.victim_lost"):
+        assert f"`{site}`" in readme, site

@@ -153,11 +153,13 @@ def test_local_blocked_alloc_handoff(cluster):
     alloc1 = running_alloc(server, job.id)
     assert wait_until(lambda: read_sticky(agent, alloc1.id) == alloc1.id)
 
-    # Destructive update (env change forces replacement, util.go:332
-    # tasksUpdated).
+    # Destructive update (a task-config change forces replacement;
+    # env tweaks are in-place since the churn PR). The trailing shell
+    # comment changes the config without changing behavior.
     job2 = sticky_job(migrate=False)
     job2.id = job.id
-    job2.task_groups[0].tasks[0].env = {"V": "2"}
+    job2.task_groups[0].tasks[0].config = {
+        "command": "/bin/sh", "args": ["-c", STICKY_CMD + " # v2"]}
     server.job_register(job2)
 
     assert wait_until(

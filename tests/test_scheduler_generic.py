@@ -138,9 +138,10 @@ def test_job_modify_destructive():
     allocs = [alloc_for(sjob, nodes[i], i) for i in range(10)]
     h.state.upsert_allocs(h.next_index(), allocs)
 
-    # new version with changed env -> destructive update
+    # new version with changed task config -> destructive update
+    # (env-level tweaks are in-place compatible since the churn PR)
     job2 = job.copy()
-    job2.task_groups[0].tasks[0].env = {"FOO": "changed"}
+    job2.task_groups[0].tasks[0].config = {"ver": "changed"}
     h.state.upsert_job(h.next_index(), job2)
 
     h.process("service", make_eval(h, h.state.job_by_id(job.id)))
@@ -192,7 +193,7 @@ def test_rolling_update_limit():
     h.state.upsert_allocs(h.next_index(), allocs)
 
     job2 = job.copy()
-    job2.task_groups[0].tasks[0].env = {"FOO": "v2"}
+    job2.task_groups[0].tasks[0].config = {"ver": "v2"}
     h.state.upsert_job(h.next_index(), job2)
 
     h.process("service", make_eval(h, h.state.job_by_id(job.id)))

@@ -73,7 +73,7 @@ def test_job_modify_rolling_creates_follow_up_eval(service):
     job2.name = job.name
     job2.task_groups[0].count = 10
     job2.update = UpdateStrategy(stagger=30.0, max_parallel=3)
-    job2.task_groups[0].tasks[0].env = {"V": "2"}  # destructive change
+    job2.task_groups[0].tasks[0].config = {"v": "2"}  # destructive change
     h.state.upsert_job(h.next_index(), job2)
 
     h.process(service, new_eval(job2, consts.EVAL_TRIGGER_JOB_REGISTER))

@@ -91,13 +91,22 @@ def test_tasks_updated_sensitivity():
     for mutate in (
         lambda tg: tg.tasks[0].config.update({"x": 1}),
         lambda tg: setattr(tg.tasks[0], "driver", "other"),
-        lambda tg: tg.tasks[0].env.update({"K": "V"}),
         lambda tg: setattr(tg.tasks[0].resources, "cpu", 9999),
+        lambda tg: setattr(tg.tasks[0].resources, "disk_mb", 9999),
         lambda tg: tg.tasks.append(a.tasks[0].copy()),
     ):
         changed = mock.job().task_groups[0]
         mutate(changed)
         assert tasks_updated(a, changed), mutate
+    # env/meta-level tweaks are in-place compatible (README "Churn &
+    # migration"): the client re-renders without the placement moving.
+    for mutate in (
+        lambda tg: tg.tasks[0].env.update({"K": "V"}),
+        lambda tg: tg.tasks[0].meta.update({"team": "x"}),
+    ):
+        changed = mock.job().task_groups[0]
+        mutate(changed)
+        assert not tasks_updated(a, changed), mutate
 
 
 def test_tainted_nodes():

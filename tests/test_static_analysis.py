@@ -563,7 +563,8 @@ def test_real_batcher_passes_its_own_manifest():
 CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
              "nomad_tpu/trace/", "nomad_tpu/admission/",
-             "nomad_tpu/models/", "nomad_tpu/kernels/")
+             "nomad_tpu/models/", "nomad_tpu/kernels/",
+             "nomad_tpu/migrate/")
 
 
 def _tree_findings():
@@ -1728,3 +1729,66 @@ def test_raft_funnel_append_before_stamp_is_sanctioned(tmp_path):
     SUBMIT must come after the stamp."""
     assert run_on(tmp_path, FUNNEL_APPEND_THEN_STAMP,
                   subdir="server") == []
+
+
+# ---------------------------------------------------------------------
+# churn-PR acceptance: the migrate module sits in every enforcement
+# scope and the eviction/churn terminal stamps joined the raft-funnel
+# stamp set — with the real tree raw-clean under them.
+
+
+def test_migrate_module_raw_clean_and_in_every_scope():
+    """nomad_tpu/migrate/ (the churn control plane) is in the
+    baseline-free core set and the unbounded-wait / swallowed-
+    exception scopes, and the tree shows ZERO findings of ANY rule
+    there — the governor/policy run inside scheduler attempts, where
+    a silent swallow or unbounded wait wedges the migration budget
+    for every worker at once."""
+    from nomad_tpu.analysis.robustness import (
+        SWALLOW_SCOPE_MARKERS,
+        WAIT_SCOPE_MARKERS,
+    )
+
+    assert "nomad_tpu/migrate/" in CORE_DIRS
+    assert "/migrate/" in WAIT_SCOPE_MARKERS
+    assert "/migrate/" in SWALLOW_SCOPE_MARKERS
+    offenders = [f for f in _tree_findings()
+                 if f.path.startswith("nomad_tpu/migrate/")]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["path"].startswith("nomad_tpu/migrate/")] == []
+
+
+def test_raft_funnel_stamp_set_covers_eviction_terminals():
+    """The raft-funnel checker's terminal stamp set includes the
+    eviction stamp and the churn follow-up triggers: a
+    `.desired_status = ALLOC_DESIRED_EVICT` (or a migration/preemption
+    trigger stamp) outside the funnel that never flows into a submit
+    is the double-evict / dropped-work bug class — and the real tree
+    is raw-clean under the widened set (the sanctioned paths pass the
+    constants as Plan.append_preemption / Evaluation-constructor
+    arguments, the parameter idiom the checker documents)."""
+    from nomad_tpu.analysis.protocol import TERMINAL_BY_FIELD
+
+    assert "ALLOC_DESIRED_EVICT" in TERMINAL_BY_FIELD["desired_status"]
+    assert "EVAL_TRIGGER_MIGRATION" in TERMINAL_BY_FIELD["triggered_by"]
+    assert "EVAL_TRIGGER_PREEMPTION" in TERMINAL_BY_FIELD["triggered_by"]
+    offenders = [f for f in _tree_findings() if f.rule == "raft-funnel"]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["rule"] == "raft-funnel"] == []
+
+
+def test_raft_funnel_flags_unfunneled_evict_stamp(tmp_path):
+    """TP fixture for the widened stamp set: an evict stamped on a
+    shared alloc outside the funnel and never submitted is flagged."""
+    bad = '''
+from nomad_tpu.structs import consts
+
+def drop_quietly(alloc):
+    alloc.desired_status = consts.ALLOC_DESIRED_EVICT
+'''
+    findings = run_on(tmp_path, bad, subdir="server")
+    assert any(f.rule == "raft-funnel"
+               and "ALLOC_DESIRED_EVICT" in f.message for f in findings), (
+        [f.render() for f in findings])

@@ -458,3 +458,26 @@ def test_http_trace_and_metrics_endpoints(fresh_recorder):
     finally:
         http.stop()
         server.shutdown()
+
+
+def test_churn_stages_registered_and_documented():
+    """migrate.place + preempt.select are first-class lifecycle stages
+    (churn PR): present in ALL_STAGES and in both stage tables (README
+    + trace/README.md) — doc drift guard."""
+    import os
+
+    from nomad_tpu.trace import (
+        ALL_STAGES,
+        STAGE_MIGRATE_PLACE,
+        STAGE_PREEMPT_SELECT,
+    )
+
+    assert STAGE_MIGRATE_PLACE in ALL_STAGES
+    assert STAGE_PREEMPT_SELECT in ALL_STAGES
+    root = os.path.join(os.path.dirname(__file__), "..")
+    readme = open(os.path.join(root, "README.md")).read()
+    trace_readme = open(os.path.join(
+        root, "nomad_tpu", "trace", "README.md")).read()
+    for stage in (STAGE_MIGRATE_PLACE, STAGE_PREEMPT_SELECT):
+        assert stage in readme, stage
+        assert stage in trace_readme, stage
