@@ -307,6 +307,8 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         # would pay extra device round-trips production doesn't.
         workers = batch
 
+    from nomad_tpu import profile as _profile
+
     def one_eval(seed):
         # Trace spans mirror the live dense scheduler's stage
         # attribution (scheduler/tpu.py) so the bench's per-stage p99
@@ -319,6 +321,10 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         asks = make_asks(*matrix.build_asks(tg_cycle))
         recorder.record_span(eid, STAGE_MATRIX_BUILD, tm0)
         tm1 = time.monotonic()
+        # Lock-wait attribution onto the dispatch span (the contention
+        # observatory's per-thread contended-wait delta across the
+        # batcher round-trip).
+        wait0 = _profile.thread_wait_ms()
         for attempt in range(3):
             try:
                 choices, scores = batcher.place(
@@ -330,7 +336,10 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
                     raise
                 with retry_lock:
                     device_retries[0] += 1
-        recorder.record_span(eid, STAGE_DEVICE_DISPATCH, tm1)
+        recorder.record_span(
+            eid, STAGE_DEVICE_DISPATCH, tm1,
+            ann={"lock_wait_ms": round(
+                _profile.thread_wait_ms() - wait0, 3)})
         tm2 = time.monotonic()
         choices = np.asarray(choices)
         scores = np.asarray(scores)
@@ -1172,9 +1181,11 @@ def _median_iqr(vals):
 
 
 def run_config(n, reps=DEFAULT_REPS):
+    from nomad_tpu.profile import get_profiler
     from nomad_tpu.trace import get_recorder
 
     get_recorder().reset()  # per-config stage attribution, not cross-config
+    get_profiler().reset()  # per-config contention columns likewise
     runs = [CONFIGS[n]() for _ in range(reps)]
     return _summarize(n, runs, reps)
 
@@ -1218,6 +1229,93 @@ def run_config_trace_ab(n, reps=DEFAULT_REPS):
         f"(traced {out['trace_overhead']['traced_e2e']:.1f} vs untraced "
         f"{out['trace_overhead']['untraced_e2e']:.1f} evals/s)")
     return out, float(ratio)
+
+
+def run_config_profile_ab(n, reps=DEFAULT_REPS):
+    """run_config with an INTERLEAVED observatory-on/fully-dark arm
+    per rep (the --profile-off arm, paired): each rep runs the config
+    with the contention observatory AND the flight recorder on — what
+    production runs — then immediately again with BOTH off, and the
+    overhead is the MEDIAN of per-rep e2e ratios (same pairing
+    discipline as the trace A/B, gating the whole always-on
+    observability stack at once). The dark arm also keeps its spans
+    out of the recorder, so the stage table the gap attribution reads
+    covers exactly the runs the contention histograms cover. Returns
+    (summary-of-profiled-runs, median ratio). The summary additionally
+    carries the contention attribution of the device.dispatch tail:
+    the p99-p50 gap against the top wait sites (per-lock waits + the
+    batch-park run-queue delay) — the measured answer to BENCH_r10's
+    GIL-queuing inference, captured as BENCH_r13."""
+    from nomad_tpu.profile import get_profiler
+    from nomad_tpu.trace import get_recorder
+
+    rec = get_recorder()
+    rec.reset()
+    prof = get_profiler()
+    prof.reset()
+    runs = []
+    ratios = []
+    off_rates = []
+    try:
+        for _ in range(reps):
+            prof.configure(enabled=True)
+            rec.set_enabled(True)
+            r = CONFIGS[n]()
+            runs.append(r)
+            prof.configure(enabled=False)
+            rec.set_enabled(False)
+            u = CONFIGS[n]()
+            ratios.append(r["e2e"] / u["e2e"])
+            off_rates.append(u["e2e"])
+    finally:
+        prof.configure(enabled=True)
+        rec.set_enabled(True)
+    out = _summarize(n, runs, reps)
+    ratio, _ = _median_iqr(ratios)
+    out["profile_overhead"] = {
+        "profiled_e2e": out["columns"]["e2e"]["median"],
+        "unprofiled_e2e": round(float(np.median(off_rates)), 3),
+        "ratio": round(float(ratio), 4),
+        "per_rep_ratios": [round(float(x), 4) for x in ratios],
+    }
+    out["contention_attribution"] = _gap_attribution(out)
+    att = out["contention_attribution"]
+    out["metric"] += (
+        f"; profile overhead: paired-ratio median x{ratio:.3f}; "
+        f"dispatch p99-p50 gap {att['gap_ms']:.1f}ms, top sites cover "
+        f"{att['attributed_frac']:.0%}")
+    return out, float(ratio)
+
+
+def _gap_attribution(out):
+    """Where the device.dispatch tail comes from: the p99-p50 gap of
+    the dispatch stage vs the top contention sites' p99s — per-lock
+    contended waits plus the batch-park run-queue delay (the direct
+    measurement of 'GIL queuing of 64 eval threads around the batch
+    boundary'). attributed_frac >= 0.5 is the acceptance bar: the
+    observatory must EXPLAIN the gap it was built to measure."""
+    stages = out.get("stage_table", {})
+    dd = stages.get("device.dispatch", {})
+    gap = max(0.0, dd.get("p99_ms", 0.0) - dd.get("p50_ms", 0.0))
+    prof = out.get("profile", {})
+    sites = [
+        {"site": site, "p99_ms": s["wait_p99_ms"], "kind": "lock_wait"}
+        for site, s in prof.get("lock_sites", {}).items()
+    ]
+    for site, p99 in prof.get("runq_p99_ms", {}).items():
+        sites.append({"site": f"runq.{site}", "p99_ms": p99,
+                      "kind": "runq_delay"})
+    sites.sort(key=lambda s: -s["p99_ms"])
+    top = sites[:3]
+    attributed = sum(s["p99_ms"] for s in top)
+    return {
+        "device_dispatch_p50_ms": dd.get("p50_ms", 0.0),
+        "device_dispatch_p99_ms": dd.get("p99_ms", 0.0),
+        "gap_ms": round(gap, 3),
+        "top_sites": top,
+        "attributed_ms": round(attributed, 3),
+        "attributed_frac": round(attributed / gap, 4) if gap else 0.0,
+    }
 
 
 def _summarize(n, runs, reps):
@@ -1287,6 +1385,60 @@ def _summarize(n, runs, reps):
             key=lambda kv: -kv[1])[:3]
         out["metric"] += "; stage p99 " + ", ".join(
             f"{k}={v:.1f}ms" for k, v in top)
+    out.update(_profile_cols())
+    if "lock_wait_p99_ms" in out:
+        out["metric"] += (
+            f"; contention: lock_wait_p99={out['lock_wait_p99_ms']:.2f}ms"
+            f", gil_overshoot_p99={out['gil_overshoot_p99_ms']:.2f}ms"
+            f", convoy_width={out['convoy_width']}")
+    return out
+
+
+def _profile_cols():
+    """Contention-observatory columns for every config (the satellite
+    triple: combined contended lock-wait p99, GIL sleep-overshoot p99,
+    and the widest batch-boundary convoy), plus the per-site wait
+    table BENCH_r13's gap attribution reads. Empty when --profile-off
+    disabled the observatory."""
+    from nomad_tpu.profile import get_profiler
+    from nomad_tpu.utils.metrics import HIST_BUCKETS, hist_percentile
+
+    prof = get_profiler()
+    if not prof.enabled:
+        return {}
+    # Combined wait p99 across every profiled site: one number for the
+    # "how contended was this run" column; the per-site table carries
+    # the attribution.
+    merged = [0] * HIST_BUCKETS
+    count = 0
+    sites = {}
+    for site, (c, total, buckets) in prof.lock_site_buckets("wait").items():
+        count += c
+        for i, v in enumerate(buckets):
+            if v:
+                merged[i] += v
+        sites[site] = {
+            "contended": c,
+            "wait_total_ms": round(total, 3),
+            "wait_p99_ms": round(hist_percentile(buckets, c, 0.99), 4),
+        }
+    gil = prof.gil.stats()
+    convoys = prof.convoy_table()
+    out = {
+        "lock_wait_p99_ms": round(
+            hist_percentile(merged, count, 0.99), 4) if count else 0.0,
+        "gil_overshoot_p99_ms": gil.get("p99_ms", 0.0),
+        "convoy_width": convoys["max_width"],
+    }
+    extra = {
+        "lock_sites": dict(sorted(
+            sites.items(),
+            key=lambda kv: -kv[1]["wait_total_ms"])[:8]),
+        "runq_p99_ms": {site: s.get("p99_ms", 0.0)
+                        for site, s in prof.runq_table().items()},
+        "convoys": convoys["convoys"],
+    }
+    out["profile"] = extra
     return out
 
 
@@ -2072,12 +2224,32 @@ def main():
                              "(nomad_tpu/trace) for this run — the A/B "
                              "arm the --check overhead gate compares "
                              "against")
+    parser.add_argument("--profile-off", action="store_true",
+                        help="disable the contention observatory "
+                             "(nomad_tpu/profile) for this run — the "
+                             "paired arm --profile-ab compares against")
+    parser.add_argument("--profile-ab", action="store_true",
+                        help="paired profiler-on/profiler-off A/B on "
+                             "one config: contention columns "
+                             "(lock_wait_p99_ms / gil_overshoot_p99_ms "
+                             "/ convoy_width), the device.dispatch "
+                             "p99-p50 gap attribution, and the paired "
+                             "overhead ratio — the BENCH_r13 arm. With "
+                             "--check, refuses numbers if the median "
+                             "paired e2e ratio < 0.95")
     args = parser.parse_args()
 
+    from nomad_tpu.profile import get_profiler
     from nomad_tpu.trace import get_recorder
 
     if args.no_trace:
         get_recorder().set_enabled(False)
+    if args.profile_off:
+        get_profiler().configure(enabled=False)
+    else:
+        # Always-on means the bench measures what production runs:
+        # recording enabled and the GIL sampler live.
+        get_profiler().ensure_sampler()
 
     if args.check:
         bad = ntalint_purity_gate()
@@ -2113,6 +2285,27 @@ def main():
               f"{HEADLINE_CONFIG}` for the gated traced-vs-untraced "
               "comparison (the purity gate above DID run)",
               file=sys.stderr)
+
+    if args.profile_ab:
+        if args.profile_off:
+            print("bench: --profile-ab and --profile-off are mutually "
+                  "exclusive (the A/B runs both arms itself)",
+                  file=sys.stderr)
+            sys.exit(2)
+        out, ratio = run_config_profile_ab(args.config, reps=args.reps)
+        if args.check:
+            _shed_gate(out, args.config)
+            _recompile_gate(out, args.config)
+            if ratio < 0.95:
+                print(json.dumps(out), file=sys.stderr)
+                print(f"bench: REFUSING to report — the contention "
+                      f"observatory cost {(1 - ratio) * 100:.1f}% of "
+                      f"median paired e2e (> 5% budget; per-rep ratios "
+                      f"{out['profile_overhead']['per_rep_ratios']})",
+                      file=sys.stderr)
+                sys.exit(2)
+        print(json.dumps(out))
+        return
 
     if args.kernel_ab:
         print(json.dumps(run_kernel_ab(reps=args.kernel_ab_reps,

@@ -19,6 +19,11 @@ reads):
   p99 (trace/recorder.py) against the ``p99_yellow_ms`` /
   ``p99_red_ms`` thresholds (0 disables this input — the default,
   since absolute latency is deployment-specific).
+- **hot-lock wait p99** — the contention observatory's worst
+  per-site contended acquire-wait p99 (nomad_tpu/profile) against
+  ``admission_lock_wait_yellow_ms`` / ``_red_ms`` (0 disables, the
+  default). When it fires, the reason NAMES the hottest lock site —
+  "why are we shedding" can now answer "the broker lock convoys".
 
 The level is the MAX of the inputs' contributions; ``reasons`` names
 which input(s) drove it, so ``/v1/agent/self`` answers "why are we
@@ -54,6 +59,10 @@ class PressureMonitor:
         self.depth_red = config.admission_depth_red
         self.p99_yellow_ms = config.admission_p99_yellow_ms
         self.p99_red_ms = config.admission_p99_red_ms
+        self.lock_wait_yellow_ms = getattr(
+            config, "admission_lock_wait_yellow_ms", 0.0)
+        self.lock_wait_red_ms = getattr(
+            config, "admission_lock_wait_red_ms", 0.0)
         self._lock = threading.RLock()
         self._cached: Optional[dict] = None  # guarded-by: _lock
         self._cached_at = 0.0  # guarded-by: _lock
@@ -167,6 +176,21 @@ class PressureMonitor:
             bump(LEVEL_YELLOW,
                  f"e2e p99 {p99_ms:.1f}ms >= {self.p99_yellow_ms:.1f}ms")
 
+        # Hot-lock contention (nomad_tpu/profile): the worst per-site
+        # contended acquire-wait p99. Always reported in inputs; only
+        # drives the level when thresholds are configured — and then
+        # the reason cites the SITE, so yellow/red explains itself.
+        lock_p99, lock_site = self._hottest_lock()
+        if self.lock_wait_red_ms and lock_p99 >= self.lock_wait_red_ms:
+            bump(LEVEL_RED,
+                 f"lock wait p99 {lock_p99:.1f}ms on {lock_site!r} >= "
+                 f"{self.lock_wait_red_ms:.1f}ms")
+        elif (self.lock_wait_yellow_ms
+              and lock_p99 >= self.lock_wait_yellow_ms):
+            bump(LEVEL_YELLOW,
+                 f"lock wait p99 {lock_p99:.1f}ms on {lock_site!r} >= "
+                 f"{self.lock_wait_yellow_ms:.1f}ms")
+
         return {
             "level": level,
             "level_num": LEVEL_NUM[level],
@@ -182,5 +206,22 @@ class PressureMonitor:
                 "dispatch_in_flight": dispatch.get("in_flight", 0),
                 "dispatch_pending": dispatch.get("pending", 0),
                 "e2e_p99_ms": round(p99_ms, 3),
+                "lock_wait_p99_ms": round(lock_p99, 3),
+                "lock_wait_site": lock_site,
             },
         }
+
+    @staticmethod
+    def _hottest_lock() -> tuple:
+        """(worst contended acquire-wait p99 in ms, its site name)
+        across every profiled lock site."""
+        from ..profile import get_profiler
+        from ..utils.metrics import hist_percentile
+
+        worst, site = 0.0, ""
+        buckets = get_profiler().lock_site_buckets("wait")
+        for name, (count, _total, dense) in buckets.items():
+            p99 = hist_percentile(dense, count, 0.99)
+            if p99 > worst:
+                worst, site = p99, name
+        return worst, site

@@ -50,7 +50,7 @@ _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 # Bump whenever any rule's behavior changes: every cache key includes
 # it, so a stale on-disk cache from an older rule set can never mask a
 # new finding (or resurrect a fixed one).
-RULESET_VERSION = "7.0-whole-program"
+RULESET_VERSION = "8.0-profiled-locks"
 
 
 class Finding:

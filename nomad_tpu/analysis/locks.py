@@ -51,8 +51,13 @@ BLOCKING_ATTRS = {"block_until_ready", "result", "urlopen",
 # Bare-name calls that are blocking.
 BLOCKING_NAMES = {"urlopen", "sleep"}
 
-_LOCK_CTORS = {"Lock", "RLock"}
-_COND_CTORS = {"Condition"}
+# The profiled wrappers (nomad_tpu/profile/locks.py) are drop-in
+# threading primitives: ProfiledCondition(self._lock, "site") aliases
+# to its backing lock exactly like Condition(self._lock), so guarded-by
+# contracts, the deadlock detector and the dispatcher rule all hold
+# over instrumented call sites unchanged.
+_LOCK_CTORS = {"Lock", "RLock", "ProfiledLock", "ProfiledRLock"}
+_COND_CTORS = {"Condition", "ProfiledCondition"}
 
 # Canonical lock id: ("self", attr) for instance locks (per class),
 # ("mod", name) for module-level locks.
@@ -327,9 +332,21 @@ class _FunctionWalker:
         bounded = bool(call.args or call.keywords)
         if name == "wait" and receiver is not None:
             lock = self.index.resolve_lock_expr(receiver, self.cls)
-            if (lock is not None and lock in held
+            if (lock is not None
                     and self.index.is_condition(receiver, self.cls)):
-                own_cond_wait = True
+                if lock in held:
+                    own_cond_wait = True
+                elif not held and self.method in ("wait", "wait_for"):
+                    # Condition-wrapper delegation: a method literally
+                    # named wait/wait_for parking on its OWN condition
+                    # attribute IS the scheduling primitive
+                    # (ProfiledCondition.wait) — its caller holds the
+                    # backing lock by Condition contract, exactly like
+                    # a direct cond.wait inside `with lock:`. Only
+                    # with NOTHING else held: a wait method parking
+                    # while holding a DIFFERENT lock is exactly the
+                    # convoy the blocking rule exists to catch.
+                    own_cond_wait = True
 
         if held and not own_cond_wait and self.emit_lock_rules:
             self.findings.append(Finding(

@@ -8,8 +8,9 @@ injected fault into a hung thread instead of a recovered one, and a
 swallowed exception is exactly how injection findings hide:
 
 - ``unbounded-wait`` (``server/``, ``dispatch/``, ``trace/``,
-  ``admission/``, ``scheduler/`` — the dense path parks worker
-  threads in scheduler/ code, so it gets the same discipline): a
+  ``admission/``, ``scheduler/``, ``profile/`` — the dense path parks
+  worker threads in scheduler/ code, so it gets the same discipline;
+  the profiler wraps those very locks, so it gets it too): a
   no-argument ``.wait()`` / ``.get()`` / ``.join()`` call blocks
   forever with no shutdown re-check; every such wait must be bounded
   (pass a timeout and re-check stop/shutdown in a loop). ``dict.get``
@@ -23,7 +24,7 @@ swallowed exception is exactly how injection findings hide:
   parks on its queue by design stays quiet.
 
 - ``swallowed-exception`` (``server/``, ``dispatch/``, ``client/``,
-  ``trace/``, ``admission/``): an ``except Exception:`` /
+  ``trace/``, ``admission/``, ``profile/``): an ``except Exception:`` /
   ``except BaseException:`` /
   bare ``except:`` whose entire body is ``pass`` (or ``...``). Either
   narrow the exception type, log it, or suppress explicitly with
@@ -65,9 +66,10 @@ RULE_SWALLOWED = "swallowed-exception"
 RULE_RECORD_PATH = "record-path-blocking"
 
 WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/",
-                      "/admission/", "/scheduler/", "/migrate/")
+                      "/admission/", "/scheduler/", "/migrate/",
+                      "/profile/")
 SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
-                         "/admission/", "/migrate/")
+                         "/admission/", "/migrate/", "/profile/")
 
 # Attribute calls that block forever when called with no timeout.
 UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}

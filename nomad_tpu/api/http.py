@@ -308,6 +308,7 @@ class HTTPServer:
             (r"^/v1/status/peers$", self._status_peers),
             (r"^/v1/agent/self$", self._agent_self),
             (r"^/v1/agent/trace$", self._agent_trace),
+            (r"^/v1/agent/profile$", self._agent_profile),
             (r"^/v1/metrics$", self._metrics),
             (r"^/v1/system/gc$", self._system_gc),
             (r"^/v1/client/fs/ls/(?P<alloc_id>[^/]+)$", self._fs_ls),
@@ -341,7 +342,7 @@ class HTTPServer:
             self._fs_logs, self._client_stats, self._client_alloc_stats,
             self._client_alloc_snapshot,
             self._agent_self, self._agent_servers,
-            self._agent_trace, self._metrics,
+            self._agent_trace, self._agent_profile, self._metrics,
             self._debug_stacks, self._debug_profile, self._debug_vars,
         }
         for pattern, handler in route_handlers:
@@ -731,7 +732,13 @@ class HTTPServer:
         (nomad_tpu/trace): recent completed span trees, the tail-kept
         slow traces (past the rolling e2e p99), the per-stage latency
         table, and recorder health counters. ?limit=N bounds the recent
-        list; ?eval=<id> fetches one eval's trace."""
+        list; ?eval=<id> fetches one eval's trace.
+
+        ?format=chrome returns a Chrome trace-event (Perfetto-loadable)
+        document instead: tail-kept + recent traces merged with the
+        contention observatory's pipeline timeline and completed
+        convoys (nomad_tpu/profile/export.py; tools/traceconv.py does
+        the same conversion offline)."""
         from ..trace import get_recorder
 
         rec = get_recorder()
@@ -742,6 +749,20 @@ class HTTPServer:
                 raise HTTPError(404, f"no trace for eval {eval_id!r}")
             return {"trace": found}
         limit = int(query.get("limit", ["50"])[0])
+        if query.get("format", [""])[0] == "chrome":
+            from ..profile import get_profiler
+            from ..profile.export import chrome_trace
+
+            prof = get_profiler()
+            # Tail-kept first: the dedup keeps the first occurrence,
+            # so the p99-defining outliers survive over their
+            # recent-ring duplicates.
+            doc = chrome_trace(
+                rec.tail_traces() + rec.traces(limit),
+                timeline=prof.timeline.events(),
+                convoys=prof.convoy_table()["recent"])
+            return RawResponse(
+                json.dumps(doc).encode(), "application/json")
         return {
             "recent": rec.traces(limit),
             "tail": rec.tail_traces(),
@@ -749,14 +770,48 @@ class HTTPServer:
             "recorder": rec.stats(),
         }
 
+    def _agent_profile(self, method, query, body):
+        """Contention observatory (nomad_tpu/profile): per-site lock
+        wait/hold tables, GIL-pressure sampler, run-queue delays, the
+        batch-boundary convoy report and timeline health. Drill-downs:
+        ?lock=<site> returns that site's per-instance stats;
+        ?thread=<name> one thread's contention totals; ?threads=1
+        includes the whole per-thread table."""
+        from ..profile import get_profiler
+
+        prof = get_profiler()
+        lock_site = query.get("lock", [""])[0]
+        if lock_site:
+            table = prof.lock_table()
+            if lock_site not in table:
+                raise HTTPError(
+                    404, f"no profiled lock site {lock_site!r}")
+            return {"site": lock_site, "stats": table[lock_site]}
+        thread = query.get("thread", [""])[0]
+        if thread:
+            threads = prof.threads_table()
+            if thread not in threads:
+                raise HTTPError(
+                    404, f"no contention record for thread {thread!r}")
+            return {"thread": thread, "stats": threads[thread]}
+        want_threads = query.get("threads", [""])[0] in ("1", "true")
+        return prof.snapshot(threads=want_threads)
+
     def _metrics(self, method, query, body):
         """Prometheus text exposition of the shared telemetry registry
         (counters/gauges + log-bucket histograms for every timing
         sample). format=json returns the raw inmem snapshot instead."""
         if query.get("format", [""])[0] == "json":
             return metrics.get_metrics().snapshot()
+        from ..profile import get_profiler
+
+        # One exposition: the telemetry registry plus the contention
+        # observatory's histograms/gauges (lock wait/hold, GIL
+        # overshoot, runq delay, convoy width).
+        body_text = (metrics.format_prometheus()
+                     + get_profiler().format_prometheus())
         return RawResponse(
-            metrics.format_prometheus().encode(),
+            body_text.encode(),
             "text/plain; version=0.0.4; charset=utf-8")
 
     def _system_gc(self, method, query, body):

@@ -66,6 +66,13 @@ class ServerBlock:
     breaker_enabled: Optional[bool] = None
     breaker_failure_threshold: Optional[int] = None
     breaker_cooldown: Optional[float] = None
+    # Contention observatory (nomad_tpu/profile; server/config.py):
+    # recording + GIL sampler switch, sampler cadence, and the
+    # pressure-monitor lock-wait p99 thresholds (ms; 0 disables).
+    profile_enabled: Optional[bool] = None
+    gil_sampler_interval: Optional[float] = None
+    admission_lock_wait_yellow_ms: Optional[float] = None
+    admission_lock_wait_red_ms: Optional[float] = None
 
 
 @dataclass
@@ -230,6 +237,10 @@ _SCHEMA: Dict[str, Any] = {
     "server.admission_enabled": bool, "server.breaker_enabled": bool,
     "server.breaker_failure_threshold": int,
     "server.breaker_cooldown": float,
+    "server.profile_enabled": bool,
+    "server.gil_sampler_interval": float,
+    "server.admission_lock_wait_yellow_ms": float,
+    "server.admission_lock_wait_red_ms": float,
     "client.enabled": bool, "client.state_dir": str,
     "client.alloc_dir": str, "client.node_class": str,
     "client.servers": _str_list, "client.network_speed": int,

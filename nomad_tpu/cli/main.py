@@ -705,6 +705,18 @@ def cmd_agent(args) -> int:
                 cfg.server.breaker_failure_threshold)
         if cfg.server.breaker_cooldown is not None:
             server_cfg.breaker_cooldown = cfg.server.breaker_cooldown
+        # Contention observatory (nomad_tpu/profile).
+        if cfg.server.profile_enabled is not None:
+            server_cfg.profile_enabled = cfg.server.profile_enabled
+        if cfg.server.gil_sampler_interval is not None:
+            server_cfg.gil_sampler_interval = (
+                cfg.server.gil_sampler_interval)
+        if cfg.server.admission_lock_wait_yellow_ms is not None:
+            server_cfg.admission_lock_wait_yellow_ms = (
+                cfg.server.admission_lock_wait_yellow_ms)
+        if cfg.server.admission_lock_wait_red_ms is not None:
+            server_cfg.admission_lock_wait_red_ms = (
+                cfg.server.admission_lock_wait_red_ms)
         if "vault.enabled" in cfg.set_keys:
             server_cfg.vault_enabled = cfg.vault.enabled
         if cfg.vault.address:

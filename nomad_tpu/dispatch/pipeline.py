@@ -41,8 +41,9 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from .. import trace
+from .. import profile, trace
 from ..chaos import chaos
+from ..profile import ProfiledCondition, ProfiledLock
 from ..scheduler import new_scheduler
 from ..server.worker import EvalSession
 from ..structs import Evaluation, Plan, PlanResult, consts
@@ -179,10 +180,19 @@ class DispatchPipeline:
             cfg.dispatch_pipeline and self.types and cfg.eval_batch_size > 1
         )
 
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        # Profiled (nomad_tpu/profile): the accumulator lock every
+        # worker handoff and batch cut crosses.
+        self._lock = ProfiledLock("dispatch.pipeline")
+        self._cond = ProfiledCondition(self._lock, "dispatch.pipeline")
         self._pending: List[_Pending] = []  # guarded-by: _lock
         self._inflight = 0  # guarded-by: _lock
+        # Run-queue delay measurement (work announced -> dispatcher
+        # actually running): _admit stamps _notified_at ONLY while the
+        # dispatcher is parked on the seed wait (_drain_waiting) — a
+        # notify that lands mid-top-up wakes nothing, and timing it
+        # would read the whole accumulation window as scheduling delay.
+        self._notified_at = 0.0  # guarded-by: _lock
+        self._drain_waiting = False  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.drained = 0  # guarded-by: _lock (evals requeued by drain())
@@ -271,6 +281,13 @@ class DispatchPipeline:
         with self._cond:
             self._pending.append(entry)
             self.evals_in += 1
+            if self._drain_waiting and not self._notified_at:
+                # Stamped HERE, lock held, right before the notify —
+                # not entry.enqueued_at: the admitter's own wait for
+                # this lock is already measured by the lock's wait
+                # histogram, and folding it in would double-count
+                # admit-side contention as dispatcher wake latency.
+                self._notified_at = time.monotonic()
             self._cond.notify_all()
 
     def pending_count(self) -> int:
@@ -316,10 +333,27 @@ class DispatchPipeline:
         — and when every slot is busy it simply keeps accumulating
         until one frees."""
         with self._cond:
-            while not self._pending and not self._stop.is_set():
-                self._cond.wait(0.25)
+            self._drain_waiting = True
+            try:
+                while not self._pending and not self._stop.is_set():
+                    self._cond.wait(0.25)
+            finally:
+                self._drain_waiting = False
             if not self._pending:
+                self._notified_at = 0.0
                 return []
+            # Run-queue delay at the broker-drain point: notify-while-
+            # parked -> this thread actually running — the dispatcher's
+            # wake latency under GIL pressure, nothing else (the top-up
+            # window and slot waits are deliberate batching time and
+            # are measured by t_drain, not here).
+            if self._notified_at:
+                profile.record_runq(
+                    "broker_drain",
+                    (time.monotonic() - self._notified_at) * 1000.0)
+                self._notified_at = 0.0
+            profile.event("accumulate_open", "dispatcher",
+                          a=len(self._pending))
         start = time.monotonic()
         while not self._stop.is_set():
             with self._lock:
@@ -366,6 +400,8 @@ class DispatchPipeline:
                 self.t_drain += now - entry.enqueued_at
                 if entry.requeues and len(batch) > 1:
                     self.requeues_batched += 1
+            profile.event("accumulate_close", "dispatcher",
+                          a=len(batch), b=self.batches)
         metrics.add_sample(("dispatch", "batch_size"), len(batch))
         return batch
 
@@ -418,6 +454,7 @@ class DispatchPipeline:
         # partial-fan-out cleanup here would double-finish entries the
         # pool still runs.
         snapshot, route_host = prologue
+        profile.event("launch", "stage", a=len(batch), b=int(route_host))
         remaining = [len(batch)]
         for entry in batch:
             self.server.eval_pool.submit(
@@ -599,6 +636,7 @@ class DispatchPipeline:
                 self.prefetches += 1
                 self.prefetch_bytes += nbytes
             metrics.incr_counter(("dispatch", "prefetch_bytes"), nbytes)
+            profile.event("prefetch", "stage", a=int(nbytes))
             # One span per eval riding this base: stage attribution for
             # the new path (the bytes shipped are the batch's WHOLE
             # host->device traffic when the delta path holds).
@@ -614,6 +652,11 @@ class DispatchPipeline:
                        remaining: List[int]) -> None:
         ev, token = entry.eval, entry.token
         start = time.monotonic()
+        # Lock-wait attribution for this stage: the profiler keeps a
+        # per-thread contended-wait total; the delta across the
+        # scheduler invoke lands on the span so a slow scheduler.process
+        # can be read as "blocked on locks" vs "actually computing".
+        wait0 = profile.thread_wait_ms()
         session = PipelineSession(
             self, entry,
             announced=(not route_host
@@ -668,7 +711,9 @@ class DispatchPipeline:
             self.t_process += time.monotonic() - start
         trace.record_span(
             ev.id, trace.STAGE_SCHED_PROCESS, start,
-            ann={"path": "pipeline", "route_host": route_host},
+            ann={"path": "pipeline", "route_host": route_host,
+                 "lock_wait_ms": round(
+                     profile.thread_wait_ms() - wait0, 3)},
             trace_id=ev.trace_id)
         self._repay_unconsumed(session)
         self._finish(entry, acked=True)
@@ -718,6 +763,7 @@ class DispatchPipeline:
                 self.acked += 1
             else:
                 self.nacked += 1
+        profile.event("ack", a=int(acked))
 
     def _release_slot(self, remaining: List[int]) -> None:
         with self._cond:
@@ -740,6 +786,7 @@ class DispatchPipeline:
         with self._lock:
             self.t_submit += dt
         metrics.measure_since(("dispatch", "submit_plan"), start)
+        profile.event("submit", a=round(dt * 1000.0, 3))
 
     def _note_conflict(self) -> None:
         with self._lock:

@@ -111,8 +111,10 @@ class _ClusterBase:
         self.alloc_groups: List[List[Tuple[str, str]]] = []
         self._init_class_index(nodes)
         # job_id -> {tg: row indices}, built lazily
+        from ..profile import ProfiledLock
+
         self._positions = None  # guarded-by: _positions_lock
-        self._positions_lock = __import__("threading").Lock()
+        self._positions_lock = ProfiledLock("models.matrix.positions")
         self._fill_all(nodes, proposed_fn)
 
     def _init_class_index(self, nodes) -> None:
@@ -360,7 +362,16 @@ class _ClusterBase:
         new.n_real, new.n = self.n_real, self.n
         # Node-level class index is alloc-independent: share it.
         new.class_ids, new.class_reps = self.class_ids, self.class_reps
-        new._positions_lock = __import__("threading").Lock()
+        # Same profiled declaration site as __init__: delta clones ARE
+        # the live pipeline's dominant base-build path, and an
+        # unprofiled lock here would make the observatory's
+        # 'models.matrix.positions' row cover only the rare full
+        # rebuilds. Dead clones' stats retire on GC (profile
+        # _register_lock), so snapshot churn never exhausts the
+        # registry.
+        from ..profile import ProfiledLock
+
+        new._positions_lock = ProfiledLock("models.matrix.positions")
         new._positions = None  # patched below when the parent built one
         new.capacity = self.capacity.copy()
         new.sched_capacity = self.sched_capacity.copy()

@@ -32,10 +32,14 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from .. import profile
+from ..profile import ProfiledCondition, ProfiledLock
 
 MAX_BATCH = 64
 # Node count at which the device-cached base shards across a multi-chip
@@ -89,7 +93,8 @@ NTA_REBUILD_ENTRYPOINTS = ("PlacementBatcher._build_device_base",)
 
 class _Request:
     __slots__ = ("token", "base", "overlay", "compact", "asks", "key",
-                 "delta", "event", "choices", "scores", "error", "span")
+                 "delta", "event", "choices", "scores", "error", "span",
+                 "ready_at")
 
     def __init__(self, token, base, overlay, asks, key, delta=None,
                  compact=None, span=None):
@@ -110,6 +115,11 @@ class _Request:
         self.choices = None
         self.scores = None
         self.error: Optional[BaseException] = None
+        # Stamped by the dispatcher right before event.set(): the
+        # requester's wake latency from this instant is its RUN-QUEUE
+        # delay (profile record_runq "batch_park") — how long a ready
+        # result waited for the GIL to hand the parked worker a slot.
+        self.ready_at = 0.0
 
     def full_state(self):
         from ..ops.binpack import make_node_state
@@ -160,11 +170,14 @@ class PlacementBatcher:
         self.max_batch = max_batch
         self.window = window
         self.logger = logging.getLogger("nomad_tpu.batcher")
-        self._lock = threading.Lock()
+        # Profiled (nomad_tpu/profile): THE hot lock of the dense path
+        # — per-site acquire-wait/hold histograms feed the contention
+        # observatory's attribution of the device.dispatch tail.
+        self._lock = ProfiledLock("scheduler.batcher")
         # Signaled by place() when a shape's queue reaches max_batch so
         # an accumulating dispatcher wakes immediately instead of
         # polling out its window.
-        self._full = threading.Condition(self._lock)
+        self._full = ProfiledCondition(self._lock, "scheduler.batcher")
         self._queues: Dict[Tuple, List[_Request]] = {}  # guarded-by: _lock
         self._dispatchers: Dict[Tuple, int] = {}  # guarded-by: _lock
         self._device_bases: "OrderedDict[object, tuple]" = OrderedDict()  # guarded-by: _lock
@@ -301,33 +314,52 @@ class PlacementBatcher:
         # Ownership has a legal gap (between a dispatcher's queue pop
         # and its finally running), so act only on the SECOND
         # consecutive ownerless observation.
+        #
+        # This wait region is the BATCH BOUNDARY: every worker whose
+        # eval joined an in-flight dispatch parks here. The profiler's
+        # convoy tracker measures the pile-up width/duration (ROADMAP
+        # open item 1's named pathology), and ready_at -> wake latency
+        # is the worker's run-queue delay under GIL pressure.
         suspect = False
-        while not req.event.wait(REQUEST_WAIT_SLICE_S):
-            claim = orphaned = False
-            with self._lock:
-                live = self._dispatchers.get(shape_key, 0)
-                queued = any(r is req
-                             for r in self._queues.get(shape_key, ()))
-                if live > 0:
-                    suspect = False
-                elif suspect and queued:
-                    # Self-rescue: still queued with no dispatcher (a
-                    # respawn's Thread.start failed) — become the
-                    # dispatcher, exactly like the first-in path above.
-                    self._dispatchers[shape_key] = 1
-                    claim = True
-                elif suspect:
-                    orphaned = True
-                else:
-                    suspect = True
-            if claim:
-                self._dispatch(shape_key, config, wait_window=False)
-            elif orphaned and not req.event.is_set():
-                raise RuntimeError(
-                    "placement request orphaned: no live dispatcher "
-                    "for its shape key and the request left the queue "
-                    "without a result (dispatcher thread died between "
-                    "queue pop and completion)")
+        if not req.event.is_set():
+            parked = profile.park("batcher.place")
+            try:
+                while not req.event.wait(REQUEST_WAIT_SLICE_S):
+                    claim = orphaned = False
+                    with self._lock:
+                        live = self._dispatchers.get(shape_key, 0)
+                        queued = any(
+                            r is req
+                            for r in self._queues.get(shape_key, ()))
+                        if live > 0:
+                            suspect = False
+                        elif suspect and queued:
+                            # Self-rescue: still queued with no
+                            # dispatcher (a respawn's Thread.start
+                            # failed) — become the dispatcher, exactly
+                            # like the first-in path above.
+                            self._dispatchers[shape_key] = 1
+                            claim = True
+                        elif suspect:
+                            orphaned = True
+                        else:
+                            suspect = True
+                    if claim:
+                        self._dispatch(shape_key, config,
+                                       wait_window=False)
+                    elif orphaned and not req.event.is_set():
+                        raise RuntimeError(
+                            "placement request orphaned: no live "
+                            "dispatcher for its shape key and the "
+                            "request left the queue without a result "
+                            "(dispatcher thread died between queue pop "
+                            "and completion)")
+            finally:
+                if parked:
+                    profile.unpark("batcher.place")
+        if req.ready_at:
+            profile.record_runq(
+                "batch_park", (time.monotonic() - req.ready_at) * 1000.0)
         if req.error is not None:
             raise req.error
         return req.choices, req.scores
@@ -881,7 +913,9 @@ class PlacementBatcher:
             for req in batch:
                 req.error = e
         finally:
+            ready = time.monotonic()
             for req in batch:
+                req.ready_at = ready
                 req.event.set()
             # Count ourselves out; anything still queued with no live
             # dispatcher gets a fresh one. Zero-count keys are removed —

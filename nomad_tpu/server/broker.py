@@ -19,11 +19,11 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from ..chaos import chaos
+from ..profile import ProfiledCondition, ProfiledRLock
 from ..structs import Evaluation, consts
 from ..utils import metrics
 from ..utils.ids import generate_uuid
@@ -125,8 +125,11 @@ class EvalBroker:
         self._ready_caps = {k: max(0, v)
                             for k, v in (ready_caps or {}).items()}
 
-        self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
+        # Profiled (nomad_tpu/profile): every enqueue, dequeue, ack and
+        # nack serializes here — under a drain storm this lock's
+        # acquire-wait histogram is the broker's contention signature.
+        self._lock = ProfiledRLock("server.broker")
+        self._cond = ProfiledCondition(self._lock, "server.broker")
         self._enabled = False
 
         self._evals: Dict[str, int] = {}  # known eval id -> dequeue count

@@ -159,6 +159,26 @@ class ServerConfig:
     breaker_slow_batches: int = 8
     breaker_cooldown: float = 5.0
 
+    # ---- Contention observatory (nomad_tpu/profile) ----
+    # Always-on lock/GIL/pipeline profiler, like the flight recorder:
+    # ProfiledLock wait/hold histograms on the hot locks, the
+    # GIL-pressure sampler thread, and the batch-boundary convoy
+    # detector. False disables recording (the bench --profile-off arm)
+    # and stops the sampler; the lock wrappers stay in place either
+    # way.
+    profile_enabled: bool = True
+    # GIL sampler sleep-request interval in seconds (~200 wakes/s at
+    # the default; the overshoot distribution is the measurement).
+    # Values <= 0 are ignored (a zero interval would spin); to stop
+    # the sampler, disable the observatory via profile_enabled.
+    gil_sampler_interval: float = 0.005
+    # Pressure-monitor thresholds on the WORST per-site contended
+    # lock-wait p99 in ms (0 disables the input — like the e2e p99
+    # thresholds, absolute bars are deployment-specific). When set,
+    # yellow/red pressure reasons cite the hottest lock site.
+    admission_lock_wait_yellow_ms: float = 0.0
+    admission_lock_wait_red_ms: float = 0.0
+
     # Telemetry gauge emission period (command.go:570 setupTelemetry)
     telemetry_interval: float = 10.0
     statsd_addr: str = ""

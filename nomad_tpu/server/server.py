@@ -35,6 +35,7 @@ from .broker import FAILED_QUEUE, EvalBroker
 from ..kernels.quality import get_board as _quality_board
 from ..migrate import churn_stats as _churn_stats
 from ..models.resident import device_state_stats as _device_state_stats
+from ..profile import get_profiler as _get_profiler
 from .config import ServerConfig
 from .core_gc import CoreScheduler
 from .fsm import FSM, DevLog
@@ -116,6 +117,13 @@ class Server:
             slow_batches=self.config.breaker_slow_batches,
             cooldown=self.config.breaker_cooldown,
             enabled=self.config.breaker_enabled,
+        )
+        # Contention observatory (nomad_tpu/profile): process-global
+        # like the recorder; configure() flips recording and the GIL
+        # sampler without dropping lock registrations.
+        _get_profiler().configure(
+            enabled=self.config.profile_enabled,
+            sampler_interval=self.config.gil_sampler_interval,
         )
         # Device-resident node state (models/resident.py): process-
         # global like the breaker and the batcher's device cache it
@@ -1326,6 +1334,11 @@ class Server:
             # count/mean/max + log-bucket p50/p95/p99 per stage, plus
             # the e2e row — the north-star p99, attributed.
             "trace": trace.get_recorder().stage_stats(),
+            # Contention observatory (nomad_tpu/profile): per-site lock
+            # wait/hold, GIL overshoot, run-queue delay, and the
+            # batch-boundary convoy table. /v1/agent/profile adds the
+            # ?lock=/?thread= drill-downs.
+            "profile": _get_profiler().snapshot(),
             # Device-resident node state (models/resident.py): delta/
             # rebuild counters + the jit compile-cache size — a
             # CLIMBING cache under steady load is a recompile storm,

@@ -34,13 +34,12 @@ p99 survive long after the recent-ring has wrapped past them.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional
 
+from ..profile import ProfiledLock
 from ..utils.metrics import (
-    HIST_BUCKETS,
-    hist_bucket,
+    LatencyHist,
     hist_percentile,
 )
 from .span import make_span, span_to_dict
@@ -67,23 +66,9 @@ NTA_RECORD_PATH = (
 )
 
 
-class _Hist:
-    """Fixed-size log-bucketed latency histogram (milliseconds)."""
-
-    __slots__ = ("count", "total", "max", "buckets")
-
-    def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.buckets = [0] * HIST_BUCKETS
-
-    def observe(self, ms: float) -> None:
-        self.count += 1
-        self.total += ms
-        if ms > self.max:
-            self.max = ms
-        self.buckets[hist_bucket(ms)] += 1
+# The shared fixed-size log-bucket histogram (utils/metrics.py
+# LatencyHist; one implementation for the recorder AND the profiler).
+_Hist = LatencyHist
 
 
 class _Trace:
@@ -112,7 +97,10 @@ class _Stripe:
                  "dropped_spans")
 
     def __init__(self):
-        self.lock = threading.Lock()
+        # Profiled (nomad_tpu/profile): the stripes are taken under the
+        # broker lock and on every stage thread — their wait histogram
+        # is the recorder's own contention self-check.
+        self.lock = ProfiledLock("trace.recorder.stripe")
         self.active: Dict[str, _Trace] = {}  # guarded-by: lock
         self.ring: List[Optional[dict]] = [None] * RING_PER_STRIPE
         self.ring_idx = 0  # guarded-by: lock (monotonic; slot = idx % K)
@@ -127,10 +115,10 @@ class FlightRecorder:
         # not, either is fine.
         self.enabled = True
         self._stripes = [_Stripe() for _ in range(N_STRIPES)]
-        self._hist_lock = threading.Lock()
+        self._hist_lock = ProfiledLock("trace.recorder.hist")
         self._hists: Dict[str, _Hist] = {}  # guarded-by: _hist_lock
         self._e2e = _Hist()  # guarded-by: _hist_lock
-        self._tail_lock = threading.Lock()
+        self._tail_lock = ProfiledLock("trace.recorder.tail")
         self._tail: List[Optional[dict]] = [None] * TAIL_KEEP
         self._tail_idx = 0  # guarded-by: _tail_lock
         self._completed = 0  # guarded-by: _tail_lock (lifetime count)

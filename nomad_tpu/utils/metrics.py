@@ -80,6 +80,35 @@ def hist_percentile(buckets, count: int, q: float) -> float:
     return hist_bucket_upper(last)
 
 
+# ntalint record-path manifest (analysis/robustness.py): observe is
+# the leaf every flight-recorder span and every profiler lock/runq/GIL
+# record lands in — arithmetic + preallocated-subscript writes only.
+NTA_RECORD_PATH = ("LatencyHist.observe",)
+
+
+class LatencyHist:
+    """Fixed-size log-bucketed latency histogram (milliseconds) over
+    the shared ladder above. The ONE histogram implementation the
+    flight recorder (trace/recorder.py) and the contention observatory
+    (nomad_tpu/profile) both store into, so their percentiles can
+    never diverge from the ladder or from each other."""
+
+    __slots__ = ("count", "total", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.buckets = [0] * HIST_BUCKETS
+
+    def observe(self, ms: float) -> None:
+        self.count += 1
+        self.total += ms
+        if ms > self.max:
+            self.max = ms
+        self.buckets[hist_bucket(ms)] += 1
+
+
 class _Interval:
     __slots__ = ("start", "counters", "gauges", "samples")
 
@@ -470,9 +499,58 @@ def _prom_name(name: str) -> str:
 def _prom_num(v: float) -> str:
     # Integral values print as integers (the common case for counts);
     # everything else as repr floats — both are valid exposition.
-    if float(v) == int(v):
-        return str(int(v))
-    return repr(float(v))
+    # Non-finite values must spell the exposition tokens exactly
+    # (Go's ParseFloat accepts "+Inf"/"-Inf"/"NaN", not Python's
+    # repr "inf"/"nan" — and int(nan) raises outright).
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f):
+        return str(int(f))
+    return repr(f)
+
+
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the exposition format."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def emit_histogram_family(lines: List[str], name: str, help_text: str,
+                          series: dict, label: str = "site") -> None:
+    """Append ONE 0.0.4 histogram family to `lines`: HELP/TYPE, then
+    per series cumulative le-ordered buckets ending in +Inf, _sum and
+    _count. `series` maps a label value ("" = unlabelled) to
+    ``(count, total, buckets)`` where buckets is a dense count list or
+    a sparse {bucket_index: count} dict over the shared ladder. The
+    single histogram emitter for the registry AND the contention
+    observatory (nomad_tpu/profile), so a conformance fix can never
+    apply to one half of /v1/metrics only."""
+    if not series:
+        return
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} histogram")
+    for key in sorted(series):
+        count, total, buckets = series[key]
+        lbl = f'{label}="{_prom_escape(key)}",' if key else ""
+        items = (sorted(buckets.items()) if isinstance(buckets, dict)
+                 else enumerate(buckets))
+        cum = 0
+        for b, c in items:
+            if not c:
+                continue
+            cum += c
+            lines.append(
+                f'{name}_bucket{{{lbl}le="{hist_bucket_upper(b):g}"}} '
+                f"{cum}")
+        lines.append(f'{name}_bucket{{{lbl}le="+Inf"}} {count}')
+        tail = f"{{{lbl[:-1]}}}" if lbl else ""
+        lines.append(f"{name}_sum{tail} {_prom_num(total)}")
+        lines.append(f"{name}_count{tail} {count}")
 
 
 def format_prometheus(metrics: Optional[Metrics] = None) -> str:
@@ -485,30 +563,42 @@ def format_prometheus(metrics: Optional[Metrics] = None) -> str:
     m = metrics or _global
     merged = m.inmem.merged()
     lines: List[str] = []
+    # Family names must be unique across the whole exposition: two raw
+    # names can sanitize to one prom name ("a.b" and "a_b"), and a
+    # duplicate TYPE block is a parse error for every scraper. First
+    # (sorted) name wins; later collisions are skipped, not emitted
+    # twice.
+    seen: set = set()
+
+    def _family(p: str) -> bool:
+        if p in seen:
+            return False
+        seen.add(p)
+        return True
+
     for name in sorted(merged["counters"]):
         v = merged["counters"][name]
         p = _prom_name(name)
+        if not _family(f"{p}_total"):
+            continue
         lines.append(f"# HELP {p}_total aggregated counter {name}")
         lines.append(f"# TYPE {p}_total counter")
         lines.append(f"{p}_total {_prom_num(v[1])}")
     for name in sorted(merged["gauges"]):
         p = _prom_name(name)
+        if not _family(p):
+            continue
         lines.append(f"# HELP {p} gauge {name}")
         lines.append(f"# TYPE {p} gauge")
         lines.append(f"{p} {_prom_num(merged['gauges'][name])}")
     for name in sorted(merged["samples"]):
         v = merged["samples"][name]
         p = _prom_name(name)
-        lines.append(f"# HELP {p} timing sample {name} (milliseconds)")
-        lines.append(f"# TYPE {p} histogram")
-        cum = 0
-        for b in sorted(v[4]):
-            cum += v[4][b]
-            le = hist_bucket_upper(b)
-            lines.append(f'{p}_bucket{{le="{le:g}"}} {cum}')
-        lines.append(f'{p}_bucket{{le="+Inf"}} {v[0]}')
-        lines.append(f"{p}_sum {_prom_num(v[1])}")
-        lines.append(f"{p}_count {v[0]}")
+        if not _family(p):
+            continue
+        emit_histogram_family(
+            lines, p, f"timing sample {name} (milliseconds)",
+            {"": (v[0], v[1], v[4])})
     return "\n".join(lines) + "\n"
 
 

@@ -132,6 +132,177 @@ def test_prometheus_exposition_shape():
     assert cums == sorted(cums)
 
 
+def _parse_exposition(text):
+    """Line-level Prometheus 0.0.4 text-format parser (the conformance
+    gate for /v1/metrics): validates comment structure, metric-name and
+    label syntax, value syntax (including +Inf/-Inf/NaN spellings),
+    single TYPE per family declared before its samples, and — for
+    histograms — per-labelset le-ascending CUMULATIVE buckets ending in
+    +Inf whose count equals _count, with _sum/_count present. Returns
+    {family: type}; raises AssertionError on any violation."""
+    import re
+
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label_re = re.compile(
+        r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    sample_re = re.compile(
+        rf"^({name_re})(?:\{{(.*)\}})? (\S+)$")
+    types = {}
+    seen_sample_families = set()
+    # (family, frozenset(non-le labels)) -> [(le, cum)] + flags
+    hist_series = {}
+    hist_sum = set()
+    hist_count = {}
+
+    def family_of(name):
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                return name[: -len(suffix)], suffix
+        return name, ""
+
+    def parse_value(v):
+        if v in ("+Inf", "-Inf", "NaN"):
+            return float(v.replace("Inf", "inf").replace("NaN", "nan"))
+        return float(v)  # raises on malformed
+
+    lines = text.splitlines()
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in lines:
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = re.match(rf"^# (HELP|TYPE) ({name_re})(?: (.*))?$", line)
+            assert m, f"malformed comment line: {line!r}"
+            if m.group(1) == "TYPE":
+                fam = m.group(2)
+                for suffix in ("_total",):
+                    if fam.endswith(suffix):
+                        fam = fam  # _total families are declared whole
+                assert fam not in types, f"duplicate TYPE for {fam}"
+                assert fam not in seen_sample_families, (
+                    f"TYPE for {fam} after its samples")
+                assert m.group(3) in (
+                    "counter", "gauge", "histogram", "summary",
+                    "untyped"), f"bad type: {line!r}"
+                types[fam] = m.group(3)
+            continue
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels_raw, value_raw = m.groups()
+        value = parse_value(value_raw)
+        labels = {}
+        if labels_raw:
+            consumed = label_re.sub("", labels_raw).strip(", ")
+            assert consumed == "", f"malformed labels: {line!r}"
+            labels = dict(label_re.findall(labels_raw))
+        fam, suffix = family_of(name)
+        assert fam in types, f"sample before any TYPE: {line!r}"
+        seen_sample_families.add(fam)
+        if types[fam] == "histogram":
+            key = (fam, frozenset(
+                (k, v) for k, v in labels.items() if k != "le"))
+            if suffix == "_bucket":
+                le = labels.get("le")
+                assert le is not None, f"bucket without le: {line!r}"
+                le_v = parse_value(le)
+                series = hist_series.setdefault(key, [])
+                if series:
+                    assert le_v > series[-1][0], (
+                        f"le not ascending: {line!r}")
+                    assert value >= series[-1][1], (
+                        f"cumulative count decreased: {line!r}")
+                series.append((le_v, value))
+            elif suffix == "_sum":
+                hist_sum.add(key)
+            elif suffix == "_count":
+                hist_count[key] = value
+    for key, series in hist_series.items():
+        fam = key[0]
+        assert series, f"histogram {fam} with no buckets"
+        assert series[-1][0] == float("inf"), (
+            f"histogram {fam} missing +Inf bucket")
+        assert key in hist_sum, f"histogram {fam} missing _sum"
+        assert key in hist_count, f"histogram {fam} missing _count"
+        assert series[-1][1] == hist_count[key], (
+            f"histogram {fam}: +Inf bucket != _count")
+    return types
+
+
+def test_prometheus_exposition_line_level_conformance():
+    """The 0.0.4 parser gate over a fully-populated registry: every
+    line must parse, histograms must be cumulative/le-ordered with
+    +Inf/_sum/_count, TYPE once per family before its samples."""
+    from nomad_tpu.utils.metrics import Metrics, format_prometheus
+
+    m = Metrics(prefix="nt")
+    m.incr_counter(("rpc", "query"), 3)
+    m.incr_counter(("broker", "shed"), 1)
+    m.set_gauge(("broker", "depth"), 5.5)
+    m.set_gauge(("weird", "gauge"), float("nan"))  # must not crash
+    m.set_gauge(("inf", "gauge"), float("inf"))
+    for v in (0.0, 0.5, 1.0, 2.0, 400.0, 9e9):
+        m.add_sample(("plan", "evaluate"), v)
+    for v in (1.0, 3.0):
+        m.add_sample(("http", "request", "GET", "jobs"), v)
+    text = format_prometheus(m)
+    types = _parse_exposition(text)
+    assert types["nt_rpc_query_total"] == "counter"
+    assert types["nt_broker_depth"] == "gauge"
+    assert types["nt_plan_evaluate"] == "histogram"
+    assert "NaN" in text and "+Inf" in text  # exposition spellings
+
+
+def test_prometheus_exposition_name_collision_single_family():
+    """Two raw names sanitizing to one prom name must not emit two
+    TYPE blocks (a parse error for every scraper): first wins."""
+    from nomad_tpu.utils.metrics import Metrics, format_prometheus
+
+    m = Metrics(prefix="nt")
+    m.add_sample(("a.b", "x"), 1.0)
+    m.add_sample(("a_b", "x"), 2.0)
+    text = format_prometheus(m)
+    assert text.count("# TYPE nt_a_b_x histogram") == 1
+    _parse_exposition(text)
+
+
+def test_profile_exposition_passes_conformance_parser():
+    """The observatory's labelled histograms ride the same gate: the
+    combined /v1/metrics body (registry + profiler) must parse line by
+    line."""
+    import threading
+
+    from nomad_tpu import profile
+    from nomad_tpu.profile import ProfiledLock, get_profiler
+    from nomad_tpu.utils.metrics import Metrics, format_prometheus
+
+    prof = get_profiler()
+    prof.reset()
+    lock = ProfiledLock("conf.site")
+
+    def holder():
+        with lock:
+            time.sleep(0.02)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.005)
+    with lock:
+        pass
+    t.join()
+    profile.record_runq("batch_park", 2.0)
+    profile.park("conf.park")
+    profile.unpark("conf.park")
+    m = Metrics(prefix="nt")
+    m.incr_counter(("rpc", "query"), 1)
+    text = format_prometheus(m) + prof.format_prometheus()
+    types = _parse_exposition(text)
+    assert types["nomad_tpu_profile_lock_wait_ms"] == "histogram"
+    assert types["nomad_tpu_profile_runq_delay_ms"] == "histogram"
+    assert types["nomad_tpu_profile_convoys_total"] == "counter"
+    prof.reset()
+
+
 def test_inmem_interval_rotation():
     sink = InmemSink(interval=0.01, retain=3)
     for i in range(6):

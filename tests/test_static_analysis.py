@@ -564,7 +564,7 @@ CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
              "nomad_tpu/trace/", "nomad_tpu/admission/",
              "nomad_tpu/models/", "nomad_tpu/kernels/",
-             "nomad_tpu/migrate/")
+             "nomad_tpu/migrate/", "nomad_tpu/profile/")
 
 
 def _tree_findings():
@@ -953,6 +953,110 @@ def test_real_recorder_record_path_is_clean():
     assert rec_mod.NTA_RECORD_PATH  # the manifest exists and is non-empty
     assert [f for f in findings
             if f.rule == "record-path-blocking"] == []
+
+
+def test_real_profiler_record_path_is_clean():
+    """The contention observatory's own self-check (the recorder's
+    discipline, one subsystem over): the sampler and lock-record paths
+    — Profiler.record_runq/park/unpark/event/_note_thread_wait, the
+    histogram observe leaf, and the timeline/convoy updates — must
+    never park (leaf `with lock:` around constant work only) and never
+    grow a container, asserted against the REAL implementation."""
+    import nomad_tpu.profile as prof_mod
+    from nomad_tpu.profile import timeline as timeline_mod
+    from nomad_tpu.utils import metrics as metrics_mod
+
+    assert prof_mod.NTA_RECORD_PATH
+    assert "Profiler.record_runq" in prof_mod.NTA_RECORD_PATH
+    # The shared histogram leaf (recorder + profiler both store into
+    # it) carries its manifest where it is defined.
+    assert metrics_mod.NTA_RECORD_PATH == ("LatencyHist.observe",)
+    assert "Timeline.push" in timeline_mod.NTA_RECORD_PATH
+    assert "ConvoyTracker.park" in timeline_mod.NTA_RECORD_PATH
+    # Whole-program run (the record path crosses profile/ modules).
+    findings = [f for f in _tree_findings()
+                if f.rule == "record-path-blocking"
+                and f.path.startswith("nomad_tpu/profile/")]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+PROFILED_GUARDED = '''
+from nomad_tpu.profile import ProfiledCondition, ProfiledLock
+
+
+class C:
+    def __init__(self):
+        self._lock = ProfiledLock("t")
+        self._cond = ProfiledCondition(self._lock, "t")
+        self.n = 0  # guarded-by: _lock
+
+    def good_lock(self):
+        with self._lock:
+            self.n += 1
+
+    def good_cond(self):
+        with self._cond:
+            self.n += 1
+
+    def bad(self):
+        self.n += 1
+'''
+
+
+WAIT_DELEGATION_FOREIGN_LOCK = '''
+import threading
+
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def wait(self):
+        with self._other:
+            self._cond.wait(1.0)
+'''
+
+
+def test_wait_delegation_exemption_requires_nothing_held(tmp_path):
+    """The condition-wrapper delegation exemption (a method named
+    `wait` parking on its own condition) only applies with NOTHING
+    else held: waiting while holding a DIFFERENT lock is the convoy
+    the lock-blocking rule exists to catch, wrapper-shaped or not."""
+    findings = run_on(tmp_path, WAIT_DELEGATION_FOREIGN_LOCK)
+    assert "lock-blocking-call" in rules_of(findings)
+
+
+def test_profiled_wrappers_preserve_guarded_by_and_aliasing(tmp_path):
+    """The wrappers are registered lock constructors: guarded-by
+    contracts keep firing on unguarded access, and
+    ProfiledCondition(self._lock) aliases to its backing lock exactly
+    like threading.Condition(self._lock) — holding either satisfies a
+    guard on the other."""
+    findings = run_on(tmp_path, PROFILED_GUARDED)
+    assert rules_of(findings) == ["guarded-by"]
+    assert findings[0].symbol == "C.bad"
+
+
+def test_real_hot_locks_are_profiled():
+    """The tentpole wiring: the hot locks the issue names — batcher,
+    dispatch pipeline, broker, matrix position index, recorder stripes
+    — construct Profiled primitives, not raw threading ones."""
+    expect = {
+        ("scheduler", "batcher.py"): 'ProfiledLock("scheduler.batcher")',
+        ("dispatch", "pipeline.py"): 'ProfiledLock("dispatch.pipeline")',
+        ("server", "broker.py"): 'ProfiledRLock("server.broker")',
+        ("models", "matrix.py"):
+            'ProfiledLock("models.matrix.positions")',
+        ("trace", "recorder.py"):
+            'ProfiledLock("trace.recorder.stripe")',
+    }
+    for (pkg, fname), needle in expect.items():
+        path = os.path.join(REPO, "nomad_tpu", pkg, fname)
+        with open(path) as f:
+            src = f.read()
+        assert needle in src, f"{pkg}/{fname} lost its profiled lock"
 
 
 # =====================================================================

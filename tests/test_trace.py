@@ -411,9 +411,15 @@ def test_chaos_fault_annotation_lands_on_covering_span(fresh_recorder):
 # HTTP surfaces
 
 
+# One exposition line: comment, or name{labels} value — labels are
+# optional, values include the +Inf/-Inf/NaN exposition spellings (the
+# combined body now carries the contention observatory's site-labelled
+# histograms too; tests/test_metrics.py has the full semantic parser).
 PROM_LINE = re.compile(
-    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{le=\"[^\"]+\"\})? "
-    r"[-+0-9.eE]+(inf)?)$")
+    r'^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})? '
+    r'([-+0-9.eE]+|\+Inf|-Inf|NaN))$')
 
 
 def test_http_trace_and_metrics_endpoints(fresh_recorder):
