@@ -223,7 +223,8 @@ def bench_tpu(store, job, k_placements, batch, rounds, tg_cycle=None,
 
 
 def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
-                  workers=None, pre_resolve=True, kernel="greedy"):
+                  workers=None, pre_resolve=True, kernel="greedy",
+                  executive=True, executive_threads=4):
     """Honest FULL-PATH dense measurement (VERDICT r4 ask #2): per
     eval — ClusterMatrix build (live shared-base cache), ask
     construction, a coalesced batcher dispatch, exact host-side port
@@ -316,7 +317,6 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
         eid = f"bench-{seed}"
         t0 = time.perf_counter()
         tm0 = time.monotonic()
-        rng_local = random.Random(seed)
         matrix = ClusterMatrix(snap, job)
         asks = make_asks(*matrix.build_asks(tg_cycle))
         recorder.record_span(eid, STAGE_MATRIX_BUILD, tm0)
@@ -342,7 +342,17 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
                 _profile.thread_wait_ms() - wait0, 3)})
         tm2 = time.monotonic()
         choices = np.asarray(choices)
-        scores = np.asarray(scores)
+        placed = materialize(seed, matrix, choices, np.asarray(scores))
+        recorder.record_span(eid, "host.finalize", tm2)
+        recorder.complete(eid)
+        return placed, time.perf_counter() - t0, choices
+
+    def materialize(seed, matrix, choices, scores):
+        """The per-placement choices -> ports -> Allocation loop both
+        arms of the A/B run (one_eval's tail and the executive's
+        finalize) — factored so the two arms can never silently
+        measure different per-eval work."""
+        rng_local = random.Random(seed)
         plan = Plan(job=job)
         net_indexes = {}
         placed = 0
@@ -366,14 +376,84 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
             plan.append_alloc(_build_allocation(
                 sched_stub, missing, node, task_resources, metrics))
             placed += 1
+        return placed
+
+    def build_eval(seed):
+        """Executive mode, host side: one eval's matrix + asks (the
+        per-row work the live executive does on/near its loop thread,
+        server/executive.py _build_row)."""
+        eid = f"bench-{seed}"
+        tm0 = time.monotonic()
+        matrix = ClusterMatrix(snap, job)
+        asks = make_asks(*matrix.build_asks(tg_cycle))
+        recorder.record_span(eid, STAGE_MATRIX_BUILD, tm0)
+        return (eid, seed, matrix, asks)
+
+    def finalize_eval(row, choices, scores, t_round):
+        """Executive mode: exact ports + Allocation materialization for
+        one cohort row (the shared `materialize` loop). Per-eval
+        latency is round-open -> this row's plan materialized (at
+        round open the whole cohort is 'ready', exactly like a
+        drained batch)."""
+        eid, seed, matrix, _asks = row
+        tm2 = time.monotonic()
+        choices = np.asarray(choices)
+        placed = materialize(seed, matrix, choices, np.asarray(scores))
         recorder.record_span(eid, "host.finalize", tm2)
         recorder.complete(eid)
-        return placed, time.perf_counter() - t0, choices
+        return placed, time.perf_counter() - t_round, choices
 
-    pool = ThreadPoolExecutor(max_workers=workers)
+    pool = ThreadPoolExecutor(
+        max_workers=(executive_threads if executive else workers))
+    # Separate finalize pool in executive mode: round k+1's builds must
+    # not queue behind round k's finalize tail on one FIFO pool — the
+    # whole point of the lookahead is the device dispatch (GIL-released
+    # XLA) running UNDER the GIL-bound finalize work.
+    finalize_pool = (ThreadPoolExecutor(max_workers=executive_threads)
+                     if executive else pool)
+
+    def run_round_executive_async(base_seed, n):
+        """The scheduler-executive shape (ROADMAP open item 1): eval
+        identity is a batch row, not a thread. Rows build on a SMALL
+        pool (`executive_threads`; numpy releases the GIL — 4 helps, 64
+        was the measured convoy), the whole cohort ships as ONE no-park
+        device dispatch (PlacementBatcher.place_cohort), and results
+        materialize on the same small pool — returned as futures so the
+        NEXT round's build+dispatch overlaps this round's finalize tail
+        (the live executive's overlap: `_process_cohort` hands its
+        finalize futures back and goes straight to the next drain).
+        Nothing ever parks on a batcher event, so the batch-boundary
+        convoy (BENCH_r13: width 63/64, runq.batch_park p99 55.1ms)
+        cannot form."""
+        t_round = time.perf_counter()
+        rows = [f.result() for f in [
+            pool.submit(build_eval, base_seed + i) for i in range(n)]]
+        tm1 = time.monotonic()
+        wait0 = _profile.thread_wait_ms()
+        for attempt in range(3):
+            try:
+                results = batcher.place_cohort([
+                    (row[2], row[3], host_prng_key(row[1]), config,
+                     (row[0], "")) for row in rows])
+                break
+            except Exception:
+                if not chaos.enabled or attempt == 2:
+                    raise
+                with retry_lock:
+                    device_retries[0] += 1
+        ann = {"lock_wait_ms": round(
+            _profile.thread_wait_ms() - wait0, 3), "cohort": n}
+        for row in rows:
+            recorder.record_span(row[0], STAGE_DEVICE_DISPATCH, tm1,
+                                 ann=ann)
+        return [finalize_pool.submit(finalize_eval, row, c, s, t_round)
+                for row, (c, s) in zip(rows, results)]
 
     def run_round(base_seed, n=None):
         count = n if n is not None else batch
+        if executive:
+            return [f.result()
+                    for f in run_round_executive_async(base_seed, count)]
         # Mirror the live dispatch pipeline's fan-out announcement so
         # the batcher holds the dispatch for the whole round's
         # staggered matrix builds.
@@ -442,12 +522,30 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
     conflicted_evals = 0
     start = time.perf_counter()
     round_results = []
-    for r in range(rounds):
-        results = run_round(20_000 + r * batch)
-        round_results.append(results)
-        for placed, t, _choices in results:
-            latencies.append(t)
-            placed_total += placed
+    if executive:
+        # One-round lookahead: round k+1's builds + device dispatch
+        # (XLA releases the GIL) run under round k's GIL-bound finalize
+        # tail — the executive's cohort pipelining, measured the same
+        # way the live loop overlaps finalize futures with the next
+        # drain.
+        pending = None
+        for r in range(rounds):
+            futs = run_round_executive_async(20_000 + r * batch, batch)
+            if pending is not None:
+                round_results.append([f.result() for f in pending])
+            pending = futs
+        round_results.append([f.result() for f in pending])
+        for results in round_results:
+            for placed, t, _choices in results:
+                latencies.append(t)
+                placed_total += placed
+    else:
+        for r in range(rounds):
+            results = run_round(20_000 + r * batch)
+            round_results.append(results)
+            for placed, t, _choices in results:
+                latencies.append(t)
+                placed_total += placed
     elapsed = time.perf_counter() - start
     # Verification outside the timed window: production pays it on the
     # applier thread, overlapped with the next dispatch.
@@ -459,6 +557,8 @@ def bench_tpu_e2e(store, job, k_placements, batch, rounds, tg_cycle=None,
             first_round_claims = claimed
     stats1 = batcher.stats()
     pool.shutdown(wait=False)
+    if finalize_pool is not pool:
+        finalize_pool.shutdown(wait=False)
     assert placed_total > 0, "e2e path placed nothing"
     dstats = {k: stats1[k] - stats0[k] for k in stats1}
     n_evals = batch * rounds
@@ -595,11 +695,14 @@ def config_3():
     }
 
 
-def config_4():
+def config_4(executive=True):
     """North star: 10k nodes, 50k existing allocs, dynamic ports +
     distinct_hosts. The e2e column runs full 64-lane batches with
     in-batch conflict pre-resolution, plus a pre-resolve-OFF A/B so the
-    retries column shows what the device-side serialization buys."""
+    retries column shows what the device-side serialization buys.
+    Since PR 12 the e2e arms run the scheduler-executive shape (cohort
+    rows + one no-park dispatch) by default; `--executive-ab` pairs it
+    against the legacy 64-thread worker shape."""
     store, _ = build_cluster(10_000, datacenters=("dc1", "dc2"),
                              allocs_per_node=5)
     job = service_job(networks=True, distinct_hosts=True)
@@ -609,9 +712,11 @@ def config_4():
     # load swung the headline ratio ±40% run to run.
     cpu_rate, cpu_p99 = bench_cpu(store, job, 8, evals=20)
     tpu_rate, tpu_p99 = bench_tpu(store, job, 8, batch=512, rounds=4)
-    e2e_rate, e2e_p99, ds = bench_tpu_e2e(store, job, 8, batch=64, rounds=4)
+    e2e_rate, e2e_p99, ds = bench_tpu_e2e(store, job, 8, batch=64, rounds=4,
+                                          executive=executive)
     _ab_rate, _ab_p99, ds_off = bench_tpu_e2e(
-        store, job, 8, batch=64, rounds=2, pre_resolve=False)
+        store, job, 8, batch=64, rounds=2, pre_resolve=False,
+        executive=executive)
     return {
         "name": "10k nodes, 50k allocs, ports + distinct_hosts",
         "cpu": cpu_rate, "cpu_p99_ms": cpu_p99 * 1000,
@@ -927,6 +1032,11 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
         get_board().reset()  # per-arm attribution, not cross-run
         server = Server(ServerConfig(
             num_schedulers=4, scheduler_factories=factories,
+            # PR 12: the live dense path runs the scheduler executive
+            # (cohort drain + no-park dispatch); inert for the CPU arm
+            # (no dense factories). --executive-ab pairs it against the
+            # legacy worker/pipeline shape.
+            scheduler_executive=True,
             eval_nack_timeout=60.0))
         server.start()
         batcher = get_batcher()
@@ -974,9 +1084,11 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
                         for j in range(max(warm_jobs, n_jobs))]
                 for w in server.workers:
                     w.set_pause(True)
+                server.executive.set_pause(True)
                 wevals = [server.job_register(job)[0] for job in warm]
                 for w in server.workers:
                     w.set_pause(False)
+                server.executive.set_pause(False)
                 wait_evals(server, wevals, 600)
                 for job in warm:
                     server.job_deregister(job.id)
@@ -990,14 +1102,17 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
 
             jobs = [make_job(f"e2e-{j}") for j in range(n_jobs)]
             stats0 = batcher.stats()
-            # STORM: fill the broker while workers are parked, then
-            # release — the regime drain-to-batch exists for.
+            # STORM: fill the broker while workers (and the executive
+            # drain) are parked, then release — the regime the cohort
+            # drain exists for.
             for w in server.workers:
                 w.set_pause(True)
+            server.executive.set_pause(True)
             evals = [server.job_register(job)[0] for job in jobs]
             start = time.perf_counter()
             for w in server.workers:
                 w.set_pause(False)
+            server.executive.set_pause(False)
             wait_evals(server, evals, 300)
             storm_elapsed = time.perf_counter() - start
             placed = sum(len(server.fsm.state.allocs_by_job(j.id))
@@ -1017,6 +1132,7 @@ def _live_pipeline(n_nodes, n_jobs, allocs_per_job, lone_jobs=12,
             # The dispatch pipeline + applier live per-server: their
             # stats ARE this run's deltas.
             dstats["pipeline"] = server.dispatch.stats()
+            dstats["executive"] = server.stats()["scheduler_executive"]
             dstats["applier"] = server.plan_applier.stats()
             # Overload counters (nomad_tpu/admission): a non-overload
             # config that shed or expired evals measured a server
@@ -1118,6 +1234,22 @@ def _live_result(name, cpu_rate, cpu_success, cpu_lone_p99,
     occupancy = (dstats["batched_requests"] / dstats["dispatches"]
                  if dstats.get("dispatches") else 0.0)
     pipe = dstats.get("pipeline", {})
+    exe = dstats.get("executive", {})
+    if exe.get("enabled"):
+        # The scheduler executive superseded the pipeline for this run:
+        # its cohort columns fill the same slots (occupancy = evals per
+        # cohort; conflicts = refresh-index'd plans; the requeue
+        # machinery does not exist on the no-park path).
+        done = max(exe.get("acked", 0) + exe.get("nacked", 0), 1)
+        pipe = {
+            "occupancy": exe.get("occupancy", 0.0),
+            "largest_batch": exe.get("largest_cohort", 0),
+            "plan_conflicts": exe.get("plan_conflicts", 0),
+            "requeues": 0,
+            "inline_retries": exe.get("plan_conflicts", 0),
+            "retries_per_eval": exe.get("plan_conflicts", 0) / done,
+            "prefetch_bytes": 0,
+        }
     applier = dstats.get("applier", {})
     print(f"# {name} [rep detail] batcher: "
           f"{dstats.get('dispatches', 0)} dispatches x {occupancy:.1f} "
@@ -1147,6 +1279,9 @@ def _live_result(name, cpu_rate, cpu_success, cpu_lone_p99,
             / max(dstats.get("dispatches", 0), 1)),
         "jit_recompiles": dstats.get("jit_cache_size", 0),
         "prefetch_bytes": pipe.get("prefetch_bytes", 0),
+        "executive_fast_evals": exe.get("fast_evals", 0),
+        "executive_legacy_evals": exe.get("legacy_evals", 0),
+        "cohort_dispatches": dstats.get("cohort_dispatches", 0),
         **_live_quality_cols(dstats.get("placement_quality", {})),
     }
 
@@ -1770,38 +1905,56 @@ def _recompile_gate(out, n):
         sys.exit(2)
 
 
-def run_resident_ab(reps=DEFAULT_REPS):
-    """Device-resident state ON/OFF A/B of config 4 (the north-star
-    cluster shape) -> BENCH_r10.json: ON is the shipping default
-    (universe matrix + node-axis deltas + prefetch), OFF reverts to
-    the ready-subset rebuild-per-snapshot path. Reports both arms'
-    full summaries (stage p99 tables included) plus the headline
-    deltas; the parity gate is the ON arm's e2e_x — the A/B proves
-    the residency machinery costs nothing when the snapshot is static
-    and the live configs (6/8) show what the deltas save."""
+def run_resident_ab(reps=DEFAULT_REPS, configs=(None,)):
+    """Device-resident state ON/OFF A/B -> BENCH_r10/r14: ON is the
+    shipping default (universe matrix + node-axis deltas + prefetch),
+    OFF reverts to the ready-subset rebuild-per-snapshot path. Reports
+    both arms' full summaries (stage p99 tables included) plus the
+    headline deltas per config. Since PR 12 the A/B carries an
+    ON >= OFF acceptance flag per config: BENCH_r10 measured the
+    inversion (ON 579 < OFF 636 on a static cluster — the delta
+    machinery ran under 64-thread contention); on the executive's
+    no-park shape the bookkeeping is cheaper than OFF's re-uploads and
+    the inversion must stay flipped (--check refuses otherwise)."""
     from nomad_tpu.models import resident
 
-    resident.configure(enabled=True)
-    on = run_config(HEADLINE_CONFIG, reps=reps)
-    try:
-        resident.configure(enabled=False)
-        off = run_config(HEADLINE_CONFIG, reps=reps)
-    finally:
+    configs = tuple(HEADLINE_CONFIG if c is None else c for c in configs)
+    per_config = {}
+    for n in configs:
         resident.configure(enabled=True)
+        on = run_config(n, reps=reps)
+        try:
+            resident.configure(enabled=False)
+            off = run_config(n, reps=reps)
+        finally:
+            resident.configure(enabled=True)
+        per_config[n] = {
+            "resident_on": on, "resident_off": off,
+            "on_ge_off": bool(on["value"] >= off["value"]),
+        }
+    headline = per_config[configs[0]]
+    on, off = headline["resident_on"], headline["resident_off"]
     on_dd = on.get("stage_p99_ms", {}).get("device.dispatch", 0.0)
     off_dd = off.get("stage_p99_ms", {}).get("device.dispatch", 0.0)
     return {
         "metric": (
-            f"[config {HEADLINE_CONFIG} resident A/B] ON: "
+            f"[config {configs[0]} resident A/B] ON: "
             f"e2e={on['value']:.1f} evals/s (e2e_x {on['e2e_x']:.2f}), "
             f"device.dispatch p99 {on_dd:.1f}ms, "
             f"transfer/batch {on['columns']['transfer_bytes_per_batch']['median']:.0f}B, "
             f"recompiles {on['columns']['jit_recompiles']['median']:.0f}; "
             f"OFF: e2e={off['value']:.1f} (e2e_x {off['e2e_x']:.2f}), "
             f"device.dispatch p99 {off_dd:.1f}ms"
+            + "".join(
+                f"; config {n}: ON {'>=' if pc['on_ge_off'] else '<'} OFF"
+                for n, pc in per_config.items())
         ),
         "resident_on": on,
         "resident_off": off,
+        "configs": {str(n): {"on_ge_off": pc["on_ge_off"]}
+                    for n, pc in per_config.items()},
+        "on_ge_off_every_config": all(
+            pc["on_ge_off"] for pc in per_config.values()),
     }
 
 
@@ -2107,6 +2260,315 @@ def run_preempt_ab(reps=3, check=False):
     return out
 
 
+def _exec_profile_snapshot():
+    """Per-arm convoy/runq/dispatch-gap columns — the exact axes
+    BENCH_r13 measured on the pre-executive shape (convoy width 63/64,
+    runq.batch_park p99 55.1ms, dispatch p99−p50 gap 44.7ms). Each
+    executive-ab arm reads these off a freshly-reset profiler/recorder
+    so the paired arms never share histograms."""
+    from nomad_tpu.trace import get_recorder
+
+    cols = _profile_cols()
+    stages = get_recorder().stage_stats()
+    dd = stages.get("device.dispatch", {})
+    p50 = dd.get("p50_ms", 0.0)
+    p99 = dd.get("p99_ms", 0.0)
+    return {
+        "convoy_width": cols.get("convoy_width", 0),
+        "runq_batch_park_p99_ms": cols.get("profile", {}).get(
+            "runq_p99_ms", {}).get("batch_park", 0.0),
+        "lock_wait_p99_ms": cols.get("lock_wait_p99_ms", 0.0),
+        "dispatch_p50_ms": p50,
+        "dispatch_p99_ms": p99,
+        "dispatch_gap_ms": round(max(0.0, p99 - p50), 3),
+        "device_sync_p99_ms": stages.get("device.solve",
+                                         {}).get("p99_ms", 0.0),
+    }
+
+
+def _exec_arm_config4(executive):
+    """Config 4's e2e shape, one arm: the measured path BENCH_r13
+    profiled. `executive=False` is the legacy 64-thread worker shape
+    (the before picture); True is the cohort-row shape."""
+    from nomad_tpu.profile import get_profiler
+    from nomad_tpu.trace import get_recorder
+
+    get_recorder().reset()
+    get_profiler().reset()
+    store, _ = build_cluster(10_000, datacenters=("dc1", "dc2"),
+                             allocs_per_node=5)
+    job = service_job(networks=True, distinct_hosts=True)
+    job.datacenters = ["dc1", "dc2"]
+    job.task_groups[0].count = 8
+    e2e_rate, e2e_p99, ds = bench_tpu_e2e(
+        store, job, 8, batch=64, rounds=3, executive=executive)
+    return {
+        "e2e": e2e_rate, "e2e_p99_ms": e2e_p99 * 1000,
+        "occupancy": ds["occupancy"],
+        "jit_recompiles": ds["jit_recompiles"],
+        "funnel_terminals_ok": 1.0,  # harness shape: no live evals
+        **_exec_profile_snapshot(),
+    }
+
+
+def _exec_live_arm(n_nodes, n_jobs, allocs_per_job, executive,
+                   drain_frac=0.1, warm_jobs=None):
+    """One LIVE executive-vs-workers arm (the configs-5/7 churn shape,
+    scaled live-feasible): real server, storm against a parked drain,
+    then a drain wave so displaced allocs flow through the executive's
+    legacy lane + migration machinery. Returns throughput plus the
+    BENCH_r13 contention axes and the two --check gate inputs:
+    steady-state recompiles and the raft-funnel terminal sweep (every
+    eval in FSM state terminal after settle)."""
+    from nomad_tpu import mock
+    from nomad_tpu.profile import get_profiler
+    from nomad_tpu.scheduler.batcher import get_batcher
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs import consts
+    from nomad_tpu.trace import get_recorder
+
+    get_recorder().reset()
+    get_profiler().reset()
+    server = Server(ServerConfig(
+        num_schedulers=4,
+        scheduler_factories={"service": "service-tpu"},
+        scheduler_executive=executive,
+        eval_nack_timeout=60.0))
+    server.start()
+
+    def pause(flag):
+        for w in server.workers:
+            w.set_pause(flag)
+        server.executive.set_pause(flag)
+
+    def make_job(jid):
+        job = mock.job()
+        job.id = jid
+        job.type = "service"
+        job.task_groups[0].count = allocs_per_job
+        t = job.task_groups[0].tasks[0]
+        t.resources.networks = []
+        t.resources.cpu = 20
+        t.resources.memory_mb = 16
+        return job
+
+    def wait_evals(evs, deadline_s):
+        deadline = time.perf_counter() + deadline_s
+        while time.perf_counter() < deadline:
+            st = [server.fsm.state.eval_by_id(e) for e in evs]
+            if all(s is not None and s.terminal_status() for s in st):
+                return True
+            time.sleep(0.02)
+        return False
+
+    try:
+        nodes = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node.compute_class()
+            server.log.apply("node_register", {"node": node})
+            nodes.append(node)
+        # Warm wave (unmeasured), sized LIKE the measured storm so its
+        # cohort lands in the same batch bucket — a smaller warm wave
+        # leaves the storm's padded program uncompiled and the
+        # recompile gate would (rightly) refuse.
+        warm = [make_job(f"xwarm-{j}")
+                for j in range(warm_jobs or n_jobs)]
+        pause(True)
+        wevals = [server.job_register(j)[0] for j in warm]
+        pause(False)
+        assert wait_evals(wevals, 300), "warm wave never settled"
+        for j in warm:
+            server.job_deregister(j.id)
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:
+            s = server.broker.stats()
+            if not s["total_ready"] and not s["total_unacked"]:
+                break
+            time.sleep(0.05)
+        jit0 = get_batcher().stats()["jit_cache_size"]
+
+        # Measured storm.
+        jobs = [make_job(f"xstorm-{j}") for j in range(n_jobs)]
+        pause(True)
+        evals = [server.job_register(j)[0] for j in jobs]
+        t0 = time.perf_counter()
+        pause(False)
+        assert wait_evals(evals, 300), "storm never settled"
+        storm_elapsed = time.perf_counter() - t0
+        # The recompile gate reads the STORM window (the steady-state
+        # claim); the drain wave below adds churn-shaped programs the
+        # warm wave deliberately does not cover.
+        jit_storm = get_batcher().stats()["jit_cache_size"]
+        placed = sum(
+            1 for j in jobs for a in server.fsm.state.allocs_by_job(j.id)
+            if not a.terminal_status())
+
+        # Drain wave: displaced allocs re-place (the churn shape the
+        # executive's legacy lane + migration budget own).
+        occupancy = {}
+        for a in server.fsm.state.allocs():
+            if not a.terminal_status():
+                occupancy[a.node_id] = occupancy.get(a.node_id, 0) + 1
+        by_load = sorted(occupancy, key=occupancy.get, reverse=True)
+        drained = set(by_load[: max(1, int(n_nodes * drain_frac))])
+        for nid in drained:
+            server.node_update_drain(nid, True)
+        deadline = time.perf_counter() + 180
+        replaced = False
+        while time.perf_counter() < deadline:
+            live = {j.id: [a for a in server.fsm.state.allocs_by_job(j.id)
+                           if not a.terminal_status()] for j in jobs}
+            s = server.broker.stats()
+            if (all(len(v) == allocs_per_job for v in live.values())
+                    and all(a.node_id not in drained
+                            for v in live.values() for a in v)
+                    and not s["total_ready"] and not s["total_unacked"]
+                    and not s["total_waiting"]):
+                replaced = True
+                break
+            time.sleep(0.05)
+        jit1 = get_batcher().stats()["jit_cache_size"]
+        # Raft-funnel terminal sweep: every eval this arm minted must
+        # hold exactly one terminal status in FSM state (the --check
+        # refusal input — a pending/unacked eval after settle means a
+        # lost terminal). Brief re-check loop: the last no-op
+        # follow-up's status write can land milliseconds after the
+        # broker reads quiet.
+        terminal_ok = False
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline and not terminal_ok:
+            terminal_ok = all(
+                e.terminal_status()
+                or e.status == consts.EVAL_STATUS_BLOCKED
+                for e in server.fsm.state.evals())
+            if not terminal_ok:
+                time.sleep(0.05)
+        ex = server.stats()["scheduler_executive"]
+        return {
+            "e2e": n_jobs / storm_elapsed,
+            "placed_frac": placed / (n_jobs * allocs_per_job),
+            "drain_replaced": float(replaced),
+            "jit_recompiles": jit_storm - jit0,
+            "jit_drain_wave_programs": jit1 - jit_storm,
+            "funnel_terminals_ok": float(terminal_ok),
+            "executive_fast_evals": ex["fast_evals"],
+            "executive_legacy_evals": ex["legacy_evals"],
+            "executive_occupancy": ex["occupancy"],
+            **_exec_profile_snapshot(),
+        }
+    finally:
+        server.shutdown()
+
+
+EXECUTIVE_AB_LIVE_ARMS = {
+    # configs 5/7's churn shapes, scaled to live-feasible sizes.
+    "config5": (600, 36, 4),
+    "config7": (300, 24, 4),
+}
+
+
+def run_executive_ab(reps=2, check=False):
+    """Paired executive-vs-workers A/B (the PR 12 tentpole's headline
+    rig) -> BENCH_r14.json: config 4's measured e2e shape plus live
+    churn arms at configs 5/7's shapes, each rep running both arms back
+    to back so host drift cancels. Emits the BENCH_r13 before-picture
+    axes per arm — convoy_width, runq.batch_park p99, dispatch p99−p50
+    — and with --check refuses executive numbers if steady-state
+    recompiles > 0 or any live eval lacks a raft-funnel terminal."""
+    arms = {}
+    plan = {"config4": None}
+    plan.update(EXECUTIVE_AB_LIVE_ARMS)
+    for arm_name, shape in plan.items():
+        runs = {"executive": [], "workers": []}
+        for _ in range(reps):
+            for mode, flag in (("executive", True), ("workers", False)):
+                if shape is None:
+                    runs[mode].append(_exec_arm_config4(flag))
+                else:
+                    runs[mode].append(_exec_live_arm(*shape, flag))
+        per_mode = {}
+        for mode, rr in runs.items():
+            per_mode[mode] = {
+                k: round(_median_iqr([float(r[k]) for r in rr])[0], 4)
+                for k in rr[0]}
+        ex, wk = per_mode["executive"], per_mode["workers"]
+        arms[arm_name] = {
+            "modes": per_mode,
+            "speed_ratio": round(ex["e2e"] / wk["e2e"], 3)
+            if wk["e2e"] else 0.0,
+            "convoy_width_before_after": [wk["convoy_width"],
+                                          ex["convoy_width"]],
+            "runq_batch_park_p99_before_after_ms": [
+                wk["runq_batch_park_p99_ms"],
+                ex["runq_batch_park_p99_ms"]],
+            "dispatch_gap_before_after_ms": [wk["dispatch_gap_ms"],
+                                             ex["dispatch_gap_ms"]],
+        }
+        if check:
+            if ex["jit_recompiles"] > 0:
+                print(f"bench: REFUSING executive-ab numbers: arm "
+                      f"{arm_name!r} recompiled in steady state "
+                      f"(jit_recompiles={ex['jit_recompiles']})",
+                      file=sys.stderr)
+                sys.exit(2)
+            if ex["funnel_terminals_ok"] < 1.0:
+                print(f"bench: REFUSING executive-ab numbers: arm "
+                      f"{arm_name!r} left evals without a raft-funnel "
+                      f"terminal after settle", file=sys.stderr)
+                sys.exit(2)
+    from nomad_tpu.server.config import ServerConfig as _SC
+
+    bound = 2 * _SC().dispatch_max_inflight
+    summary = "; ".join(
+        f"{name}: x{a['speed_ratio']:.2f} speed, convoy "
+        f"{a['convoy_width_before_after'][0]:.0f}->"
+        f"{a['convoy_width_before_after'][1]:.0f}, batch_park p99 "
+        f"{a['runq_batch_park_p99_before_after_ms'][0]:.1f}->"
+        f"{a['runq_batch_park_p99_before_after_ms'][1]:.1f}ms"
+        for name, a in arms.items())
+    return {
+        "metric": f"[executive-ab vs workers, median-of-{reps}] "
+                  + summary,
+        "arms": arms,
+        "convoy_bound": bound,
+        "acceptance": {
+            # The tentpole's measured claims: the convoy is gone on
+            # every arm, and the headline (config 4) shape is faster.
+            # Live churn-arm ratios are reported as-is: on a CPU-only
+            # host with a sub-ms inline "device", thread-per-eval's
+            # fine-grained overlap can still edge out single-cohort
+            # storms — the remote-device regime (~100ms RTT/dispatch,
+            # the r05/r06 transport analysis) is where fewer, fuller,
+            # no-park cohorts win outright.
+            "convoy_within_bound": all(
+                a["convoy_width_before_after"][1] <= bound
+                for a in arms.values()),
+            "config4_faster": bool(
+                arms["config4"]["speed_ratio"] >= 1.0),
+        },
+    }
+
+
+def _convoy_gate(out, n):
+    """--check (PR 12): dense-path numbers measured through a wide
+    batch-boundary convoy describe the thread-parked legacy shape, not
+    the executive pipeline — a convoy wider than 2x the dispatch
+    in-flight bound means eval threads piled up on batcher events
+    (BENCH_r13's measured pathology). Refuse."""
+    from nomad_tpu.server.config import ServerConfig as _SC
+
+    bound = 2 * _SC().dispatch_max_inflight
+    cw = out.get("columns", {}).get("convoy_width", {}).get("median", 0)
+    if cw and cw > bound:
+        print(f"bench: REFUSING to report config {n}: convoy_width "
+              f"{cw:.0f} > {bound} (2x dispatch_max_inflight) — eval "
+              f"threads convoyed at the batch boundary; run the "
+              f"scheduler-executive shape or fix the park regression",
+              file=sys.stderr)
+        sys.exit(2)
+
+
 # The dirs the --check gates sweep. Module constants so the ntalint
 # self-checks (tests/test_static_analysis.py) can assert the kernels
 # subsystem is inside both gates rather than trusting a string copy.
@@ -2201,7 +2663,28 @@ def main():
     parser.add_argument("--resident-ab", action="store_true",
                         help="device-resident state ON/OFF A/B on "
                              "config 4 (models/resident.py) — the "
-                             "BENCH_r10 arm")
+                             "BENCH_r10 arm. With --check, refuses "
+                             "numbers unless ON >= OFF on every config "
+                             "(the PR 12 inversion-flip gate)")
+    parser.add_argument("--executive-ab", action="store_true",
+                        help="paired scheduler-executive vs "
+                             "thread-per-eval-workers A/B "
+                             "(server/executive.py) on config 4's e2e "
+                             "shape + live churn arms at configs 5/7's "
+                             "shapes, emitting convoy_width / "
+                             "runq.batch_park p99 / dispatch p99-p50 "
+                             "against the BENCH_r13 before-picture — "
+                             "the BENCH_r14 arm. With --check, refuses "
+                             "executive numbers on steady-state "
+                             "recompiles or missing raft-funnel "
+                             "terminals")
+    parser.add_argument("--executive-ab-reps", type=int, default=2,
+                        help="paired reps per executive-ab arm")
+    parser.add_argument("--resident-ab-configs", type=str, default="",
+                        help="comma-separated config numbers for the "
+                             "resident A/B (default: the headline "
+                             "config); the --check ON >= OFF gate "
+                             "applies to every listed config")
     parser.add_argument("--kernel-ab", action="store_true",
                         help="placement-kernel A/B (nomad_tpu/kernels):"
                              " greedy vs convex on config 4's shape + "
@@ -2296,6 +2779,7 @@ def main():
         if args.check:
             _shed_gate(out, args.config)
             _recompile_gate(out, args.config)
+            _convoy_gate(out, args.config)
             if ratio < 0.95:
                 print(json.dumps(out), file=sys.stderr)
                 print(f"bench: REFUSING to report — the contention "
@@ -2305,6 +2789,11 @@ def main():
                       file=sys.stderr)
                 sys.exit(2)
         print(json.dumps(out))
+        return
+
+    if args.executive_ab:
+        print(json.dumps(run_executive_ab(reps=args.executive_ab_reps,
+                                          check=args.check)))
         return
 
     if args.kernel_ab:
@@ -2318,10 +2807,21 @@ def main():
         return
 
     if args.resident_ab:
-        out = run_resident_ab(reps=args.reps)
+        configs = (tuple(int(c) for c in
+                         args.resident_ab_configs.split(",") if c)
+                   or (None,))
+        out = run_resident_ab(reps=args.reps, configs=configs)
         if args.check:
             _shed_gate(out["resident_on"], HEADLINE_CONFIG)
             _recompile_gate(out["resident_on"], HEADLINE_CONFIG)
+            _convoy_gate(out["resident_on"], HEADLINE_CONFIG)
+            if not out["on_ge_off_every_config"]:
+                print("bench: REFUSING resident-ab numbers: resident "
+                      "ON < OFF — the delta machinery is paying "
+                      "contention again (the BENCH_r10 inversion the "
+                      "executive removed); fix the regression",
+                      file=sys.stderr)
+                sys.exit(2)
         print(json.dumps(out))
         return
 
@@ -2339,6 +2839,7 @@ def main():
             if args.check:
                 _shed_gate(out, n)
                 _recompile_gate(out, n)
+                _convoy_gate(out, n)
             print(json.dumps(out))
         return
 
@@ -2361,6 +2862,7 @@ def main():
     if args.check:
         _shed_gate(out, args.config)
         _recompile_gate(out, args.config)
+        _convoy_gate(out, args.config)
     print(json.dumps(out))
 
 

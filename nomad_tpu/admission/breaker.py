@@ -255,6 +255,16 @@ class CircuitBreaker:
         if enabled is not None:
             self.enabled = enabled
 
+    def configure_defaults(self) -> None:
+        """Restore the constructor-default thresholds (test-isolation
+        helper for the process-global singleton: fixtures restoring
+        the breaker must not hand-copy the defaults — a drifted copy
+        silently reconfigures every later test)."""
+        d = CircuitBreaker()
+        self.configure(failure_threshold=d.failure_threshold,
+                       slow_ms=d.slow_ms, slow_batches=d.slow_batches,
+                       cooldown=d.cooldown, enabled=d.enabled)
+
     def reset(self) -> None:
         """Back to closed with zeroed counters (tests; operator
         override after a confirmed repair)."""

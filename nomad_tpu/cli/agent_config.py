@@ -43,6 +43,13 @@ class ServerBlock:
     dispatch_pipeline: Optional[bool] = None
     dispatch_max_inflight: Optional[int] = None
     dense_pre_resolve: Optional[bool] = None
+    # Scheduler executive (server/executive.py): the batched
+    # event-loop dense scheduler. When on, `executive_threads` (not
+    # num_schedulers) is the dense path's parallelism knob —
+    # num_schedulers then only sizes the host/system worker pool (see
+    # README "Scheduler executive" migration note).
+    scheduler_executive: Optional[bool] = None
+    executive_threads: Optional[int] = None
     # Device-resident node state (models/resident.py): enable knob +
     # the delta-vs-rebuild row threshold (0 = auto).
     device_resident: Optional[bool] = None
@@ -228,6 +235,7 @@ _SCHEMA: Dict[str, Any] = {
     "server.eval_batch_size": int, "server.dense_min_batch": int,
     "server.dispatch_pipeline": bool, "server.dispatch_max_inflight": int,
     "server.dense_pre_resolve": bool,
+    "server.scheduler_executive": bool, "server.executive_threads": int,
     "server.device_resident": bool, "server.resident_rebuild_rows": int,
     "server.placement_kernel": str,
     "server.migrate_max_parallel": int,

@@ -667,6 +667,14 @@ def cmd_agent(args) -> int:
                 cfg.server.dispatch_max_inflight)
         if cfg.server.dense_pre_resolve is not None:
             server_cfg.dense_pre_resolve = cfg.server.dense_pre_resolve
+        # Scheduler executive (server/executive.py): batched cohort
+        # scheduling instead of thread-per-eval workers. See the README
+        # migration note — num_schedulers keeps sizing the host/system
+        # worker pool; executive_threads is the dense knob here.
+        if cfg.server.scheduler_executive is not None:
+            server_cfg.scheduler_executive = cfg.server.scheduler_executive
+        if cfg.server.executive_threads is not None:
+            server_cfg.executive_threads = cfg.server.executive_threads
         # Device-resident node state (models/resident.py).
         if cfg.server.device_resident is not None:
             server_cfg.device_resident = cfg.server.device_resident

@@ -176,8 +176,13 @@ class DispatchPipeline:
             t for t in cfg.enabled_schedulers
             if is_dense_factory(cfg.factory_for(t))
         ]
+        # The scheduler executive (server/executive.py) supersedes the
+        # pipeline when enabled: both own the central dense drain, and
+        # two drains racing the broker would split every storm into
+        # half-filled cohorts.
         self.enabled = bool(
-            cfg.dispatch_pipeline and self.types and cfg.eval_batch_size > 1
+            cfg.dispatch_pipeline and self.types
+            and cfg.eval_batch_size > 1 and not cfg.scheduler_executive
         )
 
         # Profiled (nomad_tpu/profile): the accumulator lock every

@@ -68,6 +68,39 @@ def _offer_networks(rng, missing: AllocTuple, node, net_indexes, matrix):
     return task_resources
 
 
+def build_placement_config(batch: bool, pre_resolve: bool, kernel,
+                           placements, ask_arrays):
+    """The PlacementConfig both dense drivers — BatchedTPUScheduler's
+    per-eval place() and the scheduler executive's cohort dispatch
+    (server/executive.py) — hand the batcher. Factored so the STATIC
+    fields that key compiled device programs (penalty, pre_resolve,
+    uniform_dh, kernel) can never drift between the two paths: a drift
+    would mint a second program per shape bucket (a recompile storm)
+    and break executive-vs-worker placement parity."""
+    from ..kernels import active_kernel
+    from ..ops.binpack import PlacementConfig, uniform_dh_flag
+    from .stack import (
+        BATCH_JOB_ANTI_AFFINITY_PENALTY,
+        SERVICE_JOB_ANTI_AFFINITY_PENALTY,
+    )
+
+    kernel = kernel or active_kernel()
+    return PlacementConfig(
+        anti_affinity_penalty=(
+            BATCH_JOB_ANTI_AFFINITY_PENALTY if batch
+            else SERVICE_JOB_ANTI_AFFINITY_PENALTY),
+        pre_resolve=pre_resolve,
+        # Uniform distinct-hosts fast path: one TG scaled to count=K
+        # under distinct-hosts (the storm shape) collapses the K-step
+        # scan to one scoring pass + top_k (ops/binpack.py). Static, so
+        # mixed batches never share a program with uniform ones.
+        # Greedy-only: non-default kernels run their own joint solve.
+        uniform_dh=(kernel == "greedy" and uniform_dh_flag(
+            placements, ask_arrays[5], ask_arrays[6])),
+        kernel=kernel,
+    )
+
+
 def _build_allocation(sched, missing: AllocTuple, node, task_resources,
                       metrics) -> Allocation:
     """The Allocation literal both dense schedulers append to the plan
@@ -117,17 +150,8 @@ class BatchedTPUScheduler(GenericScheduler):
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         from ..models.matrix import ClusterMatrix
-        from ..ops.binpack import (
-            PlacementConfig,
-            host_prng_key,
-            make_asks,
-            uniform_dh_flag,
-        )
+        from ..ops.binpack import host_prng_key, make_asks
         from .batcher import get_batcher
-        from .stack import (
-            BATCH_JOB_ANTI_AFFINITY_PENALTY,
-            SERVICE_JOB_ANTI_AFFINITY_PENALTY,
-        )
 
         # Sticky-disk placements keep the host path (they pin to one node).
         sticky: List[AllocTuple] = []
@@ -218,11 +242,6 @@ class BatchedTPUScheduler(GenericScheduler):
                 self.eval.id, trace.STAGE_MATRIX_UPDATE, _t0, _t_base,
                 ann={"kind": kind, "rows": matrix.delta_rows},
                 trace_id=self.eval.trace_id)
-        penalty = (
-            BATCH_JOB_ANTI_AFFINITY_PENALTY
-            if self.batch
-            else SERVICE_JOB_ANTI_AFFINITY_PENALTY
-        )
         # In-batch conflict pre-resolution rides the Planner (worker /
         # dispatch-pipeline sessions set it from server config): batch
         # members of one shared-snapshot dispatch then see each other's
@@ -230,26 +249,17 @@ class BatchedTPUScheduler(GenericScheduler):
         # applier. Harness/test planners without the attr stay on the
         # independent (vmapped) path.
         # Placement kernel (nomad_tpu/kernels): instance pin from the
-        # factory variant, else the process-global active kernel. The
-        # name is a static PlacementConfig field — it joins the
-        # batcher's shape key, so kernels never share a dispatch.
-        from ..kernels import active_kernel
-
-        kernel = self.kernel or active_kernel()
-        config = PlacementConfig(
-            anti_affinity_penalty=penalty,
-            pre_resolve=bool(getattr(self.planner, "pre_resolve", False)),
-            # Uniform distinct-hosts fast path: one TG scaled to count=K
-            # under distinct-hosts (the storm shape) collapses the
-            # K-step scan to one scoring pass + top_k (ops/binpack.py
-            # _uniform_topk_program). Static, so mixed batches never
-            # share a program with uniform ones. Greedy-only: non-
-            # default kernels run their own joint solve over the full
-            # ask set and handle distinct-hosts in their repair scan.
-            uniform_dh=(kernel == "greedy" and uniform_dh_flag(
-                placements, ask_arrays[5], ask_arrays[6])),
-            kernel=kernel,
-        )
+        # factory variant, else the process-global active kernel inside
+        # build_placement_config. The name is a static PlacementConfig
+        # field — it joins the batcher's shape key, so kernels never
+        # share a dispatch. The config literal is shared with the
+        # scheduler executive (build_placement_config) so the two dense
+        # drivers can never compile divergent programs.
+        config = build_placement_config(
+            self.batch,
+            bool(getattr(self.planner, "pre_resolve", False)),
+            self.kernel, placements, ask_arrays)
+        kernel = config.kernel
         # Host-side key: a device PRNGKey here would cost a tunnel
         # round-trip per eval and force the batcher to pull keys back
         # for stacking.
@@ -526,28 +536,8 @@ class BatchedTPUScheduler(GenericScheduler):
         note_preemption(staged_total, placed_total)
 
     def _note_quality(self, kernel, matrix, ask_res, committed) -> None:
-        from ..kernels.quality import (
-            get_board,
-            quality_from_arrays,
-            reference_ask,
-        )
-
-        try:
-            if not get_board().should_sample(kernel):
-                return
-            util = np.asarray(matrix.util).copy()
-            if committed:
-                js = np.asarray([j for j, _r in committed])
-                rows = np.asarray([r for _j, r in committed])
-                np.add.at(util, rows, np.asarray(ask_res)[js])
-            q = quality_from_arrays(util, matrix.capacity,
-                                    matrix.node_ok,
-                                    reference_ask(self.job))
-            get_board().note_plan(kernel, q["fragmentation"],
-                                  q["binpack_score"])
-        except Exception:  # noqa: BLE001 - scoring must never fail an eval
-            self.logger.warning("placement-quality scoring failed",
-                                exc_info=True)
+        note_quality(self.logger, self.job, kernel, matrix, ask_res,
+                     committed)
 
     def _repay_cohort(self) -> None:
         """Un-announce this eval's place() call: the dispatch pipeline
@@ -581,6 +571,35 @@ class BatchedTPUScheduler(GenericScheduler):
                 elig.set_task_group_eligibility(
                     bool(matrix.feasible[i, gi]), name, node.computed_class
                 )
+
+def note_quality(logger, job, kernel, matrix, ask_res, committed) -> None:
+    """Quality scoreboard entry (kernels/quality.py) for one dense
+    plan's committed claims — shared by the per-eval scheduler and the
+    scheduler executive so --kernel-ab and stats() score both drivers
+    on the same axes. Scoring must never fail an eval."""
+    from ..kernels.quality import (
+        get_board,
+        quality_from_arrays,
+        reference_ask,
+    )
+
+    try:
+        if not get_board().should_sample(kernel):
+            return
+        util = np.asarray(matrix.util).copy()
+        if committed:
+            js = np.asarray([j for j, _r in committed])
+            rows = np.asarray([r for _j, r in committed])
+            np.add.at(util, rows, np.asarray(ask_res)[js])
+        q = quality_from_arrays(util, matrix.capacity,
+                                matrix.node_ok,
+                                reference_ask(job))
+        get_board().note_plan(kernel, q["fragmentation"],
+                              q["binpack_score"])
+    except Exception:  # noqa: BLE001 - scoring must never fail an eval
+        logger.warning("placement-quality scoring failed",
+                       exc_info=True)
+
 
 def dense_diff_system_allocs(state, job, nodes, tainted, allocs,
                              terminal_allocs):

@@ -72,6 +72,28 @@ class ServerConfig:
     # inline retry loop (bounded like MAX_SERVICE_SCHEDULE_ATTEMPTS).
     dispatch_max_requeues: int = 3
 
+    # ---- Scheduler executive (nomad_tpu/server/executive.py) ----
+    # Replace the thread-per-eval dense worker model with a batched
+    # event-loop executive: one drain-owner thread pulls whole cohorts
+    # from the broker, reconciles them as arrays host-side
+    # (scheduler/util.py cohort_reconcile), hands complete batches
+    # straight to the device via the batcher's no-park cohort dispatch
+    # (place_cohort), and fans results back out through per-eval
+    # plan-submit + ack — an evaluation's identity is a batch row, not
+    # a parked thread (the BENCH_r13 convoy). False (the default, for
+    # A/B and until the rollout flips) keeps the dispatch-pipeline +
+    # worker fan-out path; the Worker pool always remains the host/
+    # system/fallback scheduler either way.
+    scheduler_executive: bool = False
+    # Host-side helper threads the executive uses for per-eval matrix
+    # builds and plan-submit/ack fan-out WITHIN a cohort (numpy releases
+    # the GIL, so a few help; 64 was the convoy). The drain itself is
+    # always one thread. Replaces num_schedulers as the dense path's
+    # parallelism knob when the executive is on (num_schedulers then
+    # only sizes the host/system worker pool — see README migration
+    # note).
+    executive_threads: int = 4
+
     # In-batch conflict pre-resolution: serialize the eval axis of a
     # shared-base device dispatch so batch members see each other's
     # capacity claims (ops/binpack.py PlacementConfig.pre_resolve) —

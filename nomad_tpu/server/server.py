@@ -103,6 +103,14 @@ class Server:
         from ..dispatch import DispatchPipeline
 
         self.dispatch = DispatchPipeline(self)
+        # Scheduler executive (server/executive.py): the batched
+        # event-loop replacement for thread-per-eval dense scheduling —
+        # behind `scheduler_executive` (the pipeline+worker fan-out
+        # stays the default for A/B). Constructed unconditionally so
+        # stats()/endpoints always have the surface.
+        from .executive import SchedulerExecutive
+
+        self.executive = SchedulerExecutive(self)
         # Overload protection (nomad_tpu/admission): pressure monitor +
         # token-bucket intake control; the HTTP layer and the TCP
         # transport consult it per request. The device-path breaker is
@@ -237,6 +245,7 @@ class Server:
             self.workers.append(worker)
             worker.start()
         self.dispatch.start()
+        self.executive.start()
         self.establish_leadership()
         self._start_telemetry()
 
@@ -377,6 +386,7 @@ class Server:
             self.workers.append(worker)
             worker.start()
         self.dispatch.start()
+        self.executive.start()
         self.raft.start()
         threading.Thread(target=self._membership_reconcile_loop,
                          name="raft-membership-sweep", daemon=True).start()
@@ -493,6 +503,7 @@ class Server:
         if self.raft is not None:
             self.raft.stop()
         self.dispatch.stop()
+        self.executive.stop()
         for w in self.workers:
             w.stop()
         if self.vault is not None and hasattr(self.vault, "stop"):
@@ -648,11 +659,12 @@ class Server:
     def revoke_leadership(self) -> None:
         self._leader = False
         # Drain FIRST, while the broker still accepts nacks: the
-        # pipeline's accumulated evals go back to the ready queue (or,
-        # on a real flap where the broker flushes anyway, fail cleanly
-        # and re-seed from raft state via the new leader's
+        # pipeline's/executive's accumulated evals go back to the ready
+        # queue (or, on a real flap where the broker flushes anyway,
+        # fail cleanly and re-seed from raft state via the new leader's
         # _restore_evals) — either way no eval is lost with the batch.
         self.dispatch.drain()
+        self.executive.drain()
         self._stop_eval_hygiene()
         for timer in self._gc_threads:
             timer.cancel()
@@ -1325,6 +1337,10 @@ class Server:
             "heartbeat_timers": self.heartbeats.count(),
             "num_workers": len(self.workers),
             "dispatch_pipeline": self.dispatch.stats(),
+            # Scheduler executive (server/executive.py): cohort sizes,
+            # fast-vs-legacy lane split (with routing reasons), and
+            # the drain/build/dispatch/finalize time breakdown.
+            "scheduler_executive": self.executive.stats(),
             "plan_applier": self.plan_applier.stats(),
             # Overload-protection surface (nomad_tpu/admission):
             # pressure level + reasons, intake-bucket stats, and the

@@ -44,6 +44,25 @@ def is_dense_factory(name: str) -> bool:
     return name.endswith("-tpu")
 
 
+def factory_kernel(name: str) -> Optional[str]:
+    """The kernel a dense factory variant pins ("service-convex-tpu"
+    -> "convex"; nomad_tpu/kernels lazy registry), None for plain
+    dense factories and host factories. The scheduler executive's
+    fast path reads the pin from here so its cohort dispatches run
+    the SAME kernel the per-eval scheduler (and the conflict re-run)
+    would — a drift would compile a second program per shape bucket
+    and break executive-vs-worker parity."""
+    if not is_dense_factory(name):
+        return None
+    base = name[: -len("-tpu")]
+    from ..kernels import kernel_names
+
+    for kernel in kernel_names():
+        if base.endswith("-" + kernel):
+            return kernel
+    return None
+
+
 def host_factory(name: str) -> str:
     """The host (CPU iterator) factory with identical placement
     semantics — where latency-aware routing sends lone evals. Kernel-
@@ -52,12 +71,10 @@ def host_factory(name: str) -> str:
     path has no kernels, the infix strips with the suffix."""
     if not is_dense_factory(name):
         return name
+    kernel = factory_kernel(name)
     base = name[: -len("-tpu")]
-    from ..kernels import kernel_names
-
-    for kernel in kernel_names():
-        if base.endswith("-" + kernel):
-            return base[: -(len(kernel) + 1)]
+    if kernel is not None:
+        return base[: -(len(kernel) + 1)]
     return base
 
 
@@ -175,8 +192,12 @@ class Worker:
     def run(self) -> None:
         while not self._stop.is_set():
             self._check_paused()
+            executive = getattr(self.server, "executive", None)
+            if executive is not None and not executive.enabled:
+                executive = None
             pipeline = getattr(self.server, "dispatch", None)
-            if (pipeline is not None and pipeline.enabled
+            if (executive is not None and executive.saturated()) or (
+                    pipeline is not None and pipeline.enabled
                     and pipeline.saturated()):
                 # Intake backpressure (nomad_tpu/admission): the
                 # central accumulator already holds two full batches.
@@ -198,6 +219,15 @@ class Worker:
             group = [(ev, token)]
             factory = self.server.config.factory_for(ev.type)
             batch_max = self.server.config.eval_batch_size
+            if executive is not None and is_dense_factory(factory):
+                # Scheduler executive (server/executive.py): the worker
+                # is only the broker's long-poll seed — the executive
+                # owns the drain from here (bulk top-ups, array-side
+                # reconcile, one no-park cohort dispatch). The worker
+                # immediately returns to the broker for host-path work.
+                executive.submit(ev, token)
+                metrics.incr_counter(("worker", "executive_handoff"))
+                continue
             pipeline = getattr(self.server, "dispatch", None)
             if (pipeline is not None and pipeline.enabled
                     and is_dense_factory(factory)):

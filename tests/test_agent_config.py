@@ -268,6 +268,28 @@ server {
         "service": "service-tpu", "batch": "batch-tpu"}
 
 
+def test_scheduler_executive_knobs(tmp_path):
+    """The scheduler-executive knobs parse from HCL and carry the
+    num_schedulers -> executive_threads split: with the executive on,
+    num_schedulers only sizes the host/system worker pool (README
+    'Scheduler executive' migration note)."""
+    from nomad_tpu.cli.agent_config import load_config
+
+    p = tmp_path / "a.hcl"
+    p.write_text('''
+server {
+  enabled = true
+  num_schedulers = 2
+  scheduler_executive = true
+  executive_threads = 6
+}
+''')
+    cfg = load_config(str(p))
+    assert cfg.server.scheduler_executive is True
+    assert cfg.server.executive_threads == 6
+    assert cfg.server.num_schedulers == 2
+
+
 def test_overload_protection_knobs(tmp_path):
     """Operators tune the overload-protection surfaces from HCL
     (nomad_tpu/admission; server/config.py): bounded broker queues,

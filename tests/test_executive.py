@@ -1,0 +1,400 @@
+"""Scheduler executive (nomad_tpu/server/executive.py): the batched
+event-loop replacement for thread-per-eval dense scheduling.
+
+The contract under test:
+
+- a storm against a parked drain processes as a FEW cohorts (eval
+  identity = batch row), every eval reaches exactly one terminal
+  status, and every alloc places exactly once;
+- executive-vs-worker placement parity: the same seeded cluster
+  commits the same (job, slot) -> node mapping under both drivers
+  (same snapshot, same device programs — the tie-break-free cluster
+  makes the argmax unique);
+- evals whose diff carries non-placement semantics (job updates,
+  drains, deregisters) route to the per-eval scheduler's legacy lane
+  and still commit correctly;
+- capacity exhaustion creates blocked evals that unblock and place
+  when nodes arrive (the blocked-eval machinery rides the fast path);
+- a device fault falls the cohort back to the host iterators (breaker
+  counted), an expired eval terminalizes with the structured reason,
+  leadership loss drains accumulated leases back to the broker, and
+  the saturation signal backpressures the worker handoff.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.chaos import FaultSpec, chaos
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.worker import DEQUEUE_TIMEOUT
+from nomad_tpu.structs import consts
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    chaos.disarm()
+    from nomad_tpu.admission import get_breaker
+
+    b = get_breaker()
+    b.reset()
+    b.configure_defaults()
+
+
+def wait_until(fn, timeout=90.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_server(**over):
+    defaults = dict(
+        num_schedulers=2,
+        scheduler_factories={"service": "service-tpu"},
+        eval_batch_size=16,
+        scheduler_executive=True,
+        executive_threads=4,
+        eval_nack_timeout=5.0,
+        eval_delivery_limit=8,
+    )
+    defaults.update(over)
+    server = Server(ServerConfig(**defaults))
+    server.start()
+    return server
+
+
+def seed_nodes(server, n=30, cpu=None, mem=None):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        if cpu is not None:
+            # Distinct capacities -> unique BestFit scores -> the
+            # placement argmax is tie-break free (parity tests).
+            node.resources.cpu = cpu + i * 10
+        if mem is not None:
+            node.resources.memory_mb = mem
+        node.compute_class()
+        server.node_register(node)
+        nodes.append(node)
+    return nodes
+
+
+def quiesce(server):
+    for w in server.workers:
+        w.set_pause(True)
+    server.executive.set_pause(True)
+    assert wait_until(
+        lambda: all(w.parked() for w in server.workers)
+        and server.executive.parked(),
+        timeout=4 * DEQUEUE_TIMEOUT + 30.0)
+
+
+def release(server):
+    for w in server.workers:
+        w.set_pause(False)
+    server.executive.set_pause(False)
+
+
+def make_job(jid, count=5, cpu=20, mem=16, priority=None):
+    job = mock.job()
+    job.id = jid
+    job.task_groups[0].count = count
+    if priority is not None:
+        job.priority = priority
+    t = job.task_groups[0].tasks[0]
+    t.resources.cpu = cpu
+    t.resources.memory_mb = mem
+    t.resources.networks = []
+    return job
+
+
+def run_storm(server, n_jobs, prefix, count=5):
+    quiesce(server)
+    jobs, evals = [], []
+    for i in range(n_jobs):
+        job = make_job(f"{prefix}-{i}", count=count)
+        ev, _ = server.job_register(job)
+        jobs.append(job)
+        evals.append(ev)
+    assert wait_until(lambda: server.broker.ready_count() >= n_jobs, 15.0)
+    release(server)
+    return jobs, evals
+
+
+def settle(server, evals, timeout=120.0):
+    state = server.fsm.state
+
+    def done():
+        evs = [state.eval_by_id(e) for e in evals]
+        return all(e is not None and e.terminal_status() for e in evs)
+
+    assert wait_until(done, timeout), {
+        e: getattr(state.eval_by_id(e), "status", None) for e in evals}
+
+
+def test_executive_storm_forms_cohorts_and_places_exactly_once():
+    server = make_server()
+    try:
+        seed_nodes(server)
+        jobs, evals = run_storm(server, 12, "storm")
+        settle(server, evals)
+        for job in jobs:
+            live = [a for a in server.fsm.state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 5, (job.id, len(live))
+            assert len({a.name for a in live}) == 5  # exactly once
+        ex = server.executive.stats()
+        assert ex["enabled"]
+        assert ex["fast_evals"] >= 10, ex
+        # Cohorts, not threads: the storm rode a few cohort cuts.
+        assert 1 <= ex["cohorts"] <= 4, ex
+        assert ex["occupancy"] >= 3, ex
+        # The device work went through the no-park cohort dispatch.
+        from nomad_tpu.scheduler.batcher import get_batcher
+
+        assert get_batcher().stats()["cohort_dispatches"] >= 1
+        # The superseded pipeline never engaged.
+        assert not server.dispatch.enabled
+    finally:
+        server.shutdown()
+
+
+def _committed_map(server, jobs):
+    out = {}
+    for job in jobs:
+        for a in server.fsm.state.allocs_by_job(job.id):
+            if not a.terminal_status():
+                out[(a.job_id, a.name)] = a.node_id
+    return out
+
+
+def test_executive_vs_worker_placement_parity():
+    """Same seeded cluster + jobs under both drivers -> identical
+    committed (job, slot) -> node maps. Placement is FORCED (each job
+    rack-pinned to exactly its `count` nodes + distinct_hosts), so the
+    map is order/tie-break/conflict-independent — what the test then
+    proves is that both drivers commit the same allocs end to end
+    (feasibility masks, plan legs, exactly-once terminals), not that
+    retry interleavings happen to agree."""
+    from nomad_tpu.structs import Constraint
+
+    n_jobs, count = 4, 3
+
+    def run(executive):
+        server = make_server(scheduler_executive=executive)
+        try:
+            rank = {}
+            for i in range(n_jobs * count):
+                node = mock.node()
+                node.meta["rack"] = f"r{i % n_jobs}"
+                node.compute_class()
+                server.node_register(node)
+                rank[node.id] = i
+            quiesce(server)
+            jobs, evals = [], []
+            for j in range(n_jobs):
+                job = make_job(f"par-{j}", count=count)
+                job.constraints.append(Constraint(
+                    ltarget="${meta.rack}", operand="=",
+                    rtarget=f"r{j}"))
+                job.task_groups[0].constraints.append(
+                    Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS))
+                ev, _ = server.job_register(job)
+                jobs.append(job)
+                evals.append(ev)
+            release(server)
+            settle(server, evals)
+            committed = _committed_map(server, jobs)
+            assert len(committed) == n_jobs * count, committed
+            # Slot-name -> node pairing WITHIN a job is PRNG
+            # tie-broken among its equivalent rack nodes (independent
+            # per-eval streams by design); the driver-level invariant
+            # is the committed node SET per job.
+            by_job = {}
+            for (job_id, _name), node_id in committed.items():
+                by_job.setdefault(job_id, set()).add(rank[node_id])
+            return {j: frozenset(v) for j, v in by_job.items()}
+        finally:
+            server.shutdown()
+
+    with_exec = run(True)
+    with_workers = run(False)
+    assert with_exec == with_workers
+
+
+def test_job_update_routes_legacy_and_commits():
+    server = make_server()
+    try:
+        seed_nodes(server)
+        jobs, evals = run_storm(server, 4, "upd", count=3)
+        settle(server, evals)
+        base_legacy = server.executive.stats()["legacy_evals"]
+        # Destructive update: bump resources -> diff has update bucket.
+        quiesce(server)
+        ev2 = []
+        for job in jobs:
+            job2 = make_job(job.id, count=3, cpu=30)
+            ev, _ = server.job_register(job2)
+            ev2.append(ev)
+        release(server)
+        settle(server, ev2)
+        ex = server.executive.stats()
+        assert ex["legacy_evals"] > base_legacy, ex
+        assert any("stop/update" in r or "buckets" in r
+                   for r in ex["legacy_reasons"]), ex["legacy_reasons"]
+        for job in jobs:
+            live = [a for a in server.fsm.state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 3
+    finally:
+        server.shutdown()
+
+
+def test_exhaustion_creates_blocked_evals_that_unblock():
+    server = make_server()
+    try:
+        seed_nodes(server, n=2, cpu=100, mem=256)
+        # 8 allocs x 30cpu will not fit 2 tiny nodes.
+        jobs, evals = run_storm(server, 1, "blocked", count=8)
+        settle(server, evals)
+        blocked = [e for e in server.fsm.state.evals()
+                   if e.status == consts.EVAL_STATUS_BLOCKED]
+        assert blocked, [
+            (e.status, e.triggered_by) for e in server.fsm.state.evals()]
+        # Capacity arrives -> the blocked eval unblocks and places.
+        for _ in range(6):
+            node = mock.node()
+            node.compute_class()
+            server.node_register(node)
+        assert wait_until(lambda: len(
+            [a for a in server.fsm.state.allocs_by_job(jobs[0].id)
+             if not a.terminal_status()]) == 8, 90.0)
+    finally:
+        server.shutdown()
+
+
+def test_device_fault_falls_back_to_host_path():
+    server = make_server()
+    try:
+        seed_nodes(server)
+        warm_jobs, warm_evals = run_storm(server, 4, "warm")
+        settle(server, warm_evals)
+        chaos.arm(7, [FaultSpec("binpack.device", "error", count=1)])
+        jobs, evals = run_storm(server, 6, "faulted")
+        settle(server, evals)
+        fired = chaos.firing_log()
+        chaos.disarm()
+        assert any(s == "binpack.device" for s, _n, _k, _d in fired)
+        ex = server.executive.stats()
+        assert ex["host_fallbacks"] >= 1, ex
+        for job in jobs:
+            live = [a for a in server.fsm.state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 5
+    finally:
+        server.shutdown()
+
+
+def test_expired_eval_terminalizes_structured():
+    server = make_server()
+    try:
+        seed_nodes(server, n=4)
+        quiesce(server)
+        job = make_job("late", count=4)
+        idx = server.log.apply("job_register", {"job": job})
+        stored = server.fsm.state.job_by_id(job.id)
+        from nomad_tpu.structs.eval import new_eval
+
+        ev = new_eval(stored, consts.EVAL_TRIGGER_JOB_REGISTER)
+        # Expires AFTER dequeue, while pending in the parked executive
+        # — the accumulation-window leg of deadline enforcement (the
+        # broker's dequeue-side check covers already-expired evals).
+        ev.deadline = time.time() + 1.0
+        ev.modify_index = idx
+        server.eval_update([ev])
+        got, token = server.broker.dequeue(["service"], timeout=5.0)
+        assert got is not None and got.id == ev.id
+        server.executive.submit(got, token)
+        time.sleep(1.2)
+        release(server)
+        assert wait_until(lambda: (
+            server.fsm.state.eval_by_id(ev.id) is not None
+            and server.fsm.state.eval_by_id(ev.id).status
+            == consts.EVAL_STATUS_FAILED), 30.0)
+        desc = server.fsm.state.eval_by_id(ev.id).status_description
+        assert "deadline expired" in desc
+        assert server.executive.stats()["expired_dropped"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_leadership_loss_drains_accumulated_leases():
+    server = make_server()
+    try:
+        seed_nodes(server, n=6)
+        quiesce(server)
+        jobs = [make_job(f"dr-{i}", count=4) for i in range(4)]
+        evals = [server.job_register(j)[0] for j in jobs]
+        # Seed the executive while its drain is parked: entries sit in
+        # _pending holding broker leases.
+        pairs = []
+        while len(pairs) < 4:
+            ev, token = server.broker.dequeue(["service"], timeout=5.0)
+            assert ev is not None
+            pairs.append((ev, token))
+        for ev, token in pairs:
+            server.executive.submit(ev, token)
+        assert server.executive.pending_count() == 4
+        drained = server.executive.drain()
+        assert drained == 4
+        assert server.executive.stats()["nacked"] >= 4
+        # The nacks re-readied the evals; release and settle.
+        release(server)
+        settle(server, evals)
+    finally:
+        server.shutdown()
+
+
+def test_saturation_backpressures_worker_handoff():
+    server = make_server(eval_batch_size=4)
+    try:
+        assert not server.executive.saturated()
+        quiesce(server)
+        seed_nodes(server, n=4)
+        jobs = [make_job(f"sat-{i}", count=4) for i in range(9)]
+        evals = [server.job_register(j)[0] for j in jobs]
+        pairs = []
+        while len(pairs) < 8:
+            ev, token = server.broker.dequeue(["service"], timeout=5.0)
+            assert ev is not None
+            pairs.append((ev, token))
+        for ev, token in pairs:
+            server.executive.submit(ev, token)
+        # 2 * max_batch entries held -> the worker drain must nap
+        # instead of moving more backlog out of the bounded queues.
+        assert server.executive.saturated()
+        release(server)
+        settle(server, evals)
+        assert not server.executive.saturated()
+    finally:
+        server.shutdown()
+
+
+def test_executive_stats_surface_and_knobs():
+    server = make_server(executive_threads=2)
+    try:
+        st = server.stats()["scheduler_executive"]
+        assert st["enabled"] and st["executive_threads"] == 2
+        # knob surface: HCL/CLI map onto ServerConfig fields
+        from nomad_tpu.server.config import ServerConfig as SC
+
+        cfg = SC()
+        assert cfg.scheduler_executive is False  # legacy default (A/B)
+        assert cfg.executive_threads == 4
+    finally:
+        server.shutdown()

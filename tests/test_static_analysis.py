@@ -1863,6 +1863,36 @@ def test_migrate_module_raw_clean_and_in_every_scope():
             if e["path"].startswith("nomad_tpu/migrate/")] == []
 
 
+def test_executive_module_manifests_and_raw_clean():
+    """The scheduler executive's self-check (PR 12): the module
+    declares the extended NTA_DISPATCHER_ENTRYPOINTS (the cohort drain
+    is the never-blocking clock) and NTA_RECORD_PATH (the drain-cut
+    stats stamp) manifests, lives inside the unbounded-wait and
+    swallowed-exception scopes (server/), and the real tree shows ZERO
+    findings of ANY rule in it — no baseline entries, no inline
+    suppressions: the hottest new path in the repo carries no recorded
+    debt."""
+    from nomad_tpu.analysis.robustness import (
+        SWALLOW_SCOPE_MARKERS,
+        WAIT_SCOPE_MARKERS,
+    )
+    from nomad_tpu.server import executive as exec_mod
+
+    assert exec_mod.NTA_DISPATCHER_ENTRYPOINTS == (
+        "SchedulerExecutive._drain",)
+    assert exec_mod.NTA_RECORD_PATH == ("SchedulerExecutive._note_drain",)
+    assert "/server/" in WAIT_SCOPE_MARKERS
+    assert "/server/" in SWALLOW_SCOPE_MARKERS
+    offenders = [f for f in _tree_findings()
+                 if f.path.endswith("server/executive.py")]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["path"].endswith("server/executive.py")] == []
+    src = open(os.path.join(
+        REPO, "nomad_tpu", "server", "executive.py")).read()
+    assert "nta: disable" not in src
+
+
 def test_raft_funnel_stamp_set_covers_eviction_terminals():
     """The raft-funnel checker's terminal stamp set includes the
     eviction stamp and the churn follow-up triggers: a
