@@ -2260,6 +2260,257 @@ def run_preempt_ab(reps=3, check=False):
     return out
 
 
+def _defrag_churn_arm(defrag_on, seed, n_nodes=200, churn_steps=12,
+                      budget=16, max_moves=16, rounds_per_step=3):
+    """One defrag-ab arm: a config-5-shaped churning SERVICE workload
+    (mixed 600/300 asks on 1000-cap nodes; each step client-completes
+    a random slice of small allocs and the reconciler refills the
+    holes through the dense path — the scatter that fragments), with
+    the defrag loop ON or OFF between steps. Deterministic: the
+    Harness drives the scheduler, the REAL DefragLoop drives the
+    waves (governor claims, budget cap, stale gate and all), and the
+    arm's stub server processes each wave eval synchronously through
+    the dense factory then commits its terminal to the store so the
+    loop's watch releases the slots.
+
+    Returns the fragmentation trajectory (cluster_fragmentation — the
+    solver's own objective, measured identically in both arms), the
+    governor high-water vs budget, the displaced-alloc funnel sweep
+    (every moved alloc staged in EXACTLY one plan's eviction leg and
+    carrying a desired-stop terminal, with exactly one replacement),
+    warm/cold solve cost, and the jit program count after warmup."""
+    import random as _random
+
+    import types as _types
+
+    from nomad_tpu.defrag import DefragLoop, cluster_fragmentation
+    from nomad_tpu.migrate import configure as migrate_configure
+    from nomad_tpu.migrate import DEFAULT_MAX_PARALLEL, get_governor
+    from nomad_tpu.ops.binpack import jit_cache_size
+    from nomad_tpu.scheduler.testing import (
+        Harness,
+        churn_stop_small_allocs,
+        seed_consolidation_cluster,
+    )
+    from nomad_tpu.server.config import ServerConfig
+    from nomad_tpu.structs import consts
+    from nomad_tpu.structs.eval import new_eval as _new_eval
+
+    rng = _random.Random(seed)
+    h = Harness(seed=seed)
+    # The SHARED fragmentation fixture (scheduler/testing.py) — the
+    # defrag differential rig builds the identical workload shape, so
+    # the rig and this trajectory never judge different clusters.
+    seed_consolidation_cluster(h, n_nodes, factory="service-tpu")
+
+    migrate_configure(migrate_max_parallel=budget)
+    harness = h
+
+    class _ArmServer:
+        """The Server slice the loop touches; wave evals process
+        synchronously through the dense factory and commit their
+        terminal to the store (the dev-server applier analog)."""
+
+        def __init__(self):
+            self.config = ServerConfig(
+                defrag_enabled=defrag_on, defrag_interval=10_000.0,
+                defrag_min_gain=0.001, defrag_max_moves_per_wave=max_moves)
+            self.fsm = _types.SimpleNamespace(state=harness.state)
+            self.admission = _types.SimpleNamespace(level=lambda: "green")
+
+        def is_leader(self):
+            return True
+
+        def eval_update(self, evals):
+            for ev in evals:
+                harness.state.upsert_evals(
+                    harness.next_index(), [ev.copy()])
+                harness.process("service-tpu", ev)
+                done = ev.copy()
+                done.status = consts.EVAL_STATUS_COMPLETE
+                harness.state.upsert_evals(harness.next_index(), [done])
+
+    loop = DefragLoop(_ArmServer())
+    trajectory = []
+    jit_warm = None
+    try:
+        get_governor().reset_stats()
+        clock = [0.0]
+        trajectory.append(cluster_fragmentation(
+            h.state.snapshot(), ["dc1"]))
+        for step in range(churn_steps):
+            # churn: client-complete a slice of small allocs ...
+            stops = churn_stop_small_allocs(h, rng, 0.10)
+            # ... and refill the holes (the reconciler's job)
+            refill_jobs = sorted({a.job_id for a in stops})
+            for jid in refill_jobs:
+                job = h.state.job_by_id(jid)
+                h.process("service-tpu", _new_eval(
+                    job, consts.EVAL_TRIGGER_NODE_UPDATE))
+            if defrag_on:
+                # each tick: one watch (releases the previous wave —
+                # the stub's eval_update processed + terminalized it
+                # synchronously) + one round
+                for _ in range(rounds_per_step):
+                    clock[0] += 20_000.0
+                    loop.tick(now=clock[0])
+                if step == 1:
+                    # warmup = the cold + first-warm programs; any
+                    # later growth is a steady-state recompile
+                    jit_warm = jit_cache_size()
+            trajectory.append(cluster_fragmentation(
+                h.state.snapshot(), ["dc1"]))
+        # final settle tick: release the last wave's slots
+        clock[0] += 20_000.0
+        loop.configure(enabled=False)
+        loop.tick(now=clock[0])
+        st = loop.stats()
+        g = get_governor().stats()
+
+        # Funnel sweep over every defrag eviction the arm staged: each
+        # moved alloc appears in exactly ONE plan's eviction leg,
+        # carries a desired-stop terminal in the store, and has exactly
+        # one replacement alloc chained to it.
+        staged_count = {}
+        for plan in h.plans:
+            for updates in plan.node_update.values():
+                for victim in updates:
+                    if victim.desired_description == "alloc is being migrated":
+                        staged_count[victim.id] = (
+                            staged_count.get(victim.id, 0) + 1)
+        funnel_ok = True
+        for alloc_id, count in staged_count.items():
+            stored = h.state.alloc_by_id(alloc_id)
+            replacements = [
+                a for a in h.state.allocs()
+                if a.previous_allocation == alloc_id]
+            if (count != 1 or stored is None
+                    or stored.desired_status != consts.ALLOC_DESIRED_STOP
+                    or len(replacements) != 1):
+                funnel_ok = False
+        # every wave eval reached a terminal in the store
+        for ev in h.state.evals():
+            if ev.triggered_by == consts.EVAL_TRIGGER_DEFRAG \
+                    and not ev.terminal_status():
+                funnel_ok = False
+
+        jit_end = jit_cache_size()
+        return {
+            "defrag": bool(defrag_on),
+            "frag_start": round(trajectory[0], 4),
+            "frag_final": round(trajectory[-1], 4),
+            "frag_mean": round(float(np.mean(trajectory)), 4),
+            "trajectory": [round(f, 4) for f in trajectory],
+            "rounds": st["rounds"],
+            "waves": st["waves"],
+            "moves": st["moves_proposed"],
+            "moves_completed": st["moves_completed"],
+            "no_gain_rounds": st["no_gain_rounds"],
+            "stale_discards": st["stale_discards"],
+            "migration_budget": budget,
+            "migration_high_water": g["high_water"],
+            "governor_in_flight_end": g["in_flight"],
+            "displaced_funnel_ok": bool(funnel_ok),
+            "displaced_evictions": len(staged_count),
+            "first_cold_solve_ms": st["first_cold_solve_ms"],
+            "min_warm_solve_ms": st["min_warm_solve_ms"],
+            "cold_solves": st["cold_solves"],
+            "warm_solves": st["warm_solves"],
+            "jit_after_warmup": jit_warm if jit_warm is not None else jit_end,
+            "jit_end": jit_end,
+            "jit_recompiles": (jit_end - jit_warm)
+            if (defrag_on and jit_warm is not None) else 0,
+        }
+    finally:
+        migrate_configure(migrate_max_parallel=DEFAULT_MAX_PARALLEL)
+
+
+def run_defrag_ab(reps=2, check=False):
+    """Continuous-defragmentation ON/OFF A/B -> BENCH_r15: identical
+    seeded churn in both arms, the ON arm running the real DefragLoop
+    between churn steps. Acceptance: the ON arm ends with measurably
+    lower fragmentation than OFF, migration high-water <= the budget,
+    every displaced alloc carries an exactly-once raft-funnel
+    terminal, steady-state recompiles 0, and warm-started steady-state
+    solves are measurably cheaper than the cold first solve. With
+    --check, refuses to report numbers violating the funnel/recompile/
+    budget contracts."""
+    arms = {"on": [], "off": []}
+    for rep in range(reps):
+        arms["on"].append(_defrag_churn_arm(True, seed=15_000 + rep))
+        arms["off"].append(_defrag_churn_arm(False, seed=15_000 + rep))
+
+    if check:
+        for rep, r in enumerate(arms["on"]):
+            if not r["displaced_funnel_ok"]:
+                print(f"bench: REFUSING defrag-ab numbers: rep {rep} "
+                      "has a displaced alloc without an exactly-once "
+                      "raft-funnel terminal", file=sys.stderr)
+                sys.exit(2)
+            if r["jit_recompiles"] > 0:
+                print(f"bench: REFUSING defrag-ab numbers: rep {rep} "
+                      f"recompiled after warmup "
+                      f"({r['jit_after_warmup']} -> {r['jit_end']})",
+                      file=sys.stderr)
+                sys.exit(2)
+            if r["migration_high_water"] > r["migration_budget"]:
+                print(f"bench: REFUSING defrag-ab numbers: rep {rep} "
+                      f"exceeded the migration budget "
+                      f"(high-water {r['migration_high_water']} > "
+                      f"{r['migration_budget']})", file=sys.stderr)
+                sys.exit(2)
+            if r["governor_in_flight_end"] != 0:
+                print(f"bench: REFUSING defrag-ab numbers: rep {rep} "
+                      f"leaked {r['governor_in_flight_end']} governor "
+                      "slots", file=sys.stderr)
+                sys.exit(2)
+
+    def med(rr, key):
+        m, _ = _median_iqr([float(r[key]) for r in rr])
+        return m
+
+    on, off = arms["on"], arms["off"]
+    on_final = med(on, "frag_final")
+    off_final = med(off, "frag_final")
+    out = {
+        "metric": (f"[defrag-ab churning service workload, "
+                   f"median-of-{reps}] ON: final frag {on_final:.4f} "
+                   f"(mean {med(on, 'frag_mean'):.4f}, "
+                   f"{med(on, 'waves'):.0f} waves, "
+                   f"{med(on, 'moves'):.0f} moves, high-water "
+                   f"{med(on, 'migration_high_water'):.0f}/"
+                   f"{on[0]['migration_budget']}); OFF: final frag "
+                   f"{off_final:.4f} (mean {med(off, 'frag_mean'):.4f})"
+                   f"; warm solve {med(on, 'min_warm_solve_ms'):.1f}ms"
+                   f" vs cold {med(on, 'first_cold_solve_ms'):.0f}ms"),
+        "defrag_on": {k: (on[0][k] if k == "trajectory"
+                          else med(on, k) if isinstance(on[0][k],
+                                                        (int, float))
+                          else on[0][k])
+                      for k in on[0]},
+        "defrag_off": {k: (off[0][k] if k == "trajectory"
+                           else med(off, k) if isinstance(off[0][k],
+                                                          (int, float))
+                           else off[0][k])
+                       for k in off[0]},
+        "acceptance": {
+            "on_final_frag_below_off": bool(on_final < off_final),
+            "frag_final_on_vs_off": [on_final, off_final],
+            "migration_high_water_within_budget": all(
+                r["migration_high_water"] <= r["migration_budget"]
+                for r in on),
+            "displaced_funnel_exactly_once": all(
+                r["displaced_funnel_ok"] for r in on),
+            "steady_state_recompiles_zero": all(
+                r["jit_recompiles"] == 0 for r in on),
+            "warm_solve_cheaper_than_cold": all(
+                0 < r["min_warm_solve_ms"] < r["first_cold_solve_ms"]
+                for r in on if r["warm_solves"] > 0),
+        },
+    }
+    return out
+
+
 def _exec_profile_snapshot():
     """Per-arm convoy/runq/dispatch-gap columns — the exact axes
     BENCH_r13 measured on the pre-executive shape (convoy width 63/64,
@@ -2572,10 +2823,11 @@ def _convoy_gate(out, n):
 # The dirs the --check gates sweep. Module constants so the ntalint
 # self-checks (tests/test_static_analysis.py) can assert the kernels
 # subsystem is inside both gates rather than trusting a string copy.
-PURITY_GATE_DIRS = ("ops", "scheduler", "kernels", "migrate")
+PURITY_GATE_DIRS = ("ops", "scheduler", "kernels", "migrate",
+                    "defrag")
 CONCURRENCY_GATE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
                          "nomad_tpu/server/", "nomad_tpu/kernels/",
-                         "nomad_tpu/migrate/")
+                         "nomad_tpu/migrate/", "nomad_tpu/defrag/")
 
 
 def ntalint_purity_gate():
@@ -2702,6 +2954,14 @@ def main():
                              "eviction lacks a raft-funnel terminal")
     parser.add_argument("--preempt-ab-reps", type=int, default=3,
                         help="reps per preempt-ab arm")
+    parser.add_argument("--defrag-ab", action="store_true",
+                        help="continuous-defragmentation ON/OFF A/B: "
+                             "fragmentation trajectory under identical "
+                             "seeded churn, waves through the real "
+                             "DefragLoop under the migration budget "
+                             "(BENCH_r15)")
+    parser.add_argument("--defrag-ab-reps", type=int, default=2,
+                        help="seeded churn reps per defrag-ab arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -2804,6 +3064,11 @@ def main():
     if args.preempt_ab:
         print(json.dumps(run_preempt_ab(reps=args.preempt_ab_reps,
                                         check=args.check)))
+        return
+
+    if args.defrag_ab:
+        print(json.dumps(run_defrag_ab(reps=args.defrag_ab_reps,
+                                       check=args.check)))
         return
 
     if args.resident_ab:

@@ -68,6 +68,16 @@ site                   wired into
                        kernel already counted its freed capacity —
                        the plan applier's exact verification must
                        reject the under-freed node and force a replan)
+``defrag.solve_stale``  defrag-loop round, after the solve completes
+                       (drop = the solve raced a resident-base
+                       rejection purge: the wave is discarded and the
+                       warm carry dropped — NOTHING commits from a
+                       chain the applier convicted, nomad_tpu/defrag)
+``defrag.wave_lost``   defrag-loop wave watch (drop = the in-flight
+                       wave is declared dead: every remaining
+                       MigrationGovernor slot the loop claimed is
+                       released; the wave's evals keep their own
+                       exactly-once terminal path)
 =====================  =======================================================
 """
 
@@ -100,6 +110,8 @@ KNOWN_SITES = frozenset({
     "matrix.stale_delta",
     "drain.mid_migration",
     "preempt.victim_lost",
+    "defrag.solve_stale",
+    "defrag.wave_lost",
 })
 
 DROP = "drop"

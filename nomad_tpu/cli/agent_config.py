@@ -64,6 +64,13 @@ class ServerBlock:
     migrate_max_parallel: Optional[int] = None
     preemption_enabled: Optional[bool] = None
     preempt_priority_threshold: Optional[int] = None
+    # Continuous defragmentation (nomad_tpu/defrag; server/config.py):
+    # the leader-side background optimizer loop — enable switch, round
+    # interval, minimum net fragmentation gain, per-wave move cap.
+    defrag_enabled: Optional[bool] = None
+    defrag_interval: Optional[float] = None
+    defrag_min_gain: Optional[float] = None
+    defrag_max_moves_per_wave: Optional[int] = None
     # Overload protection (nomad_tpu/admission; server/config.py):
     # bounded broker ready queues, eval deadlines, the token-bucket
     # intake gate, and the device-path circuit breaker.
@@ -241,6 +248,10 @@ _SCHEMA: Dict[str, Any] = {
     "server.migrate_max_parallel": int,
     "server.preemption_enabled": bool,
     "server.preempt_priority_threshold": int,
+    "server.defrag_enabled": bool,
+    "server.defrag_interval": float,
+    "server.defrag_min_gain": float,
+    "server.defrag_max_moves_per_wave": int,
     "server.eval_ready_cap": int, "server.eval_deadline_ttl": float,
     "server.admission_enabled": bool, "server.breaker_enabled": bool,
     "server.breaker_failure_threshold": int,

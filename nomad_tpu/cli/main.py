@@ -698,6 +698,19 @@ def cmd_agent(args) -> int:
         if cfg.server.preempt_priority_threshold is not None:
             server_cfg.preempt_priority_threshold = (
                 cfg.server.preempt_priority_threshold)
+        # Continuous defragmentation (nomad_tpu/defrag): the CLI flag
+        # only turns it ON (HCL can do either); tuning knobs are HCL.
+        if args.defrag:
+            server_cfg.defrag_enabled = True
+        elif cfg.server.defrag_enabled is not None:
+            server_cfg.defrag_enabled = cfg.server.defrag_enabled
+        if cfg.server.defrag_interval is not None:
+            server_cfg.defrag_interval = cfg.server.defrag_interval
+        if cfg.server.defrag_min_gain is not None:
+            server_cfg.defrag_min_gain = cfg.server.defrag_min_gain
+        if cfg.server.defrag_max_moves_per_wave is not None:
+            server_cfg.defrag_max_moves_per_wave = (
+                cfg.server.defrag_max_moves_per_wave)
         # Overload protection (nomad_tpu/admission): bounded broker
         # queues, deadlines, intake gate, device-path breaker.
         if cfg.server.eval_ready_cap is not None:
@@ -984,6 +997,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = unbounded)")
     p.add_argument("-preemption", dest="preemption", action="store_true",
                    help="allow red-pressure priority preemption")
+    p.add_argument("-defrag", dest="defrag", action="store_true",
+                   help="enable the leader-side continuous "
+                        "defragmentation loop (nomad_tpu/defrag)")
     p.add_argument("-consul", dest="consul", default="",
                    help="consul agent addr for service sync + discovery")
     p.add_argument("-advertise", dest="advertise", default="",

@@ -99,6 +99,40 @@ ROUND_BIAS = 8.0
 STRAND_BIAS = 12.0
 
 
+def mirror_descent(logits, lin, mask, res_active, bw_active, ports_active,
+                   base_frac, base_bw_frac, denom_nr, bw_denom, ports_denom,
+                   active, iters: int):
+    """The entropic mirror-descent loop on the relaxed joint-assignment
+    objective, factored out so the hot-path kernel (`_relaxed_assignment`)
+    and the off-path defrag solver (nomad_tpu/defrag/solver.py) run the
+    SAME program — the defrag loop warm-starts it from the previous
+    round's logits, which is where the CvxCluster-style re-solve
+    speedup comes from. `iters` must be a compile-time constant (the
+    loop is UNROLLED: at these shapes a lax.scan's per-iteration
+    dispatch overhead on CPU backends outweighs the whole body, and
+    the flat graph fuses). Returns the final logits (the iterate the
+    warm start carries)."""
+    for _ in range(iters):
+        x = jax.nn.softmax(logits + mask, axis=1) * active
+        exp_load = base_frac + jnp.einsum("kn,kr->nr", x,
+                                          res_active) / denom_nr
+        over = jnp.maximum(exp_load - 1.0, 0.0)
+        over_bw = jnp.maximum(
+            base_bw_frac + (x.T @ bw_active) / bw_denom - 1.0, 0.0)
+        over_ports = jnp.maximum(
+            (x.T @ ports_active) / ports_denom - 1.0, 0.0)
+        tot = jnp.sum(exp_load, axis=1) / NUM_RESOURCES
+        node_term = (PACK_REWARD / NUM_RESOURCES) * tot[:, None] \
+            - 2.0 * OVER_PENALTY * over  # [N, R]: d obj / d exp_load
+        g = (lin
+             + jnp.einsum("nr,kr->kn", node_term / denom_nr, res_active)
+             - 2.0 * OVER_PENALTY
+             * (jnp.outer(bw_active, over_bw / bw_denom)
+                + jnp.outer(ports_active, over_ports / ports_denom)))
+        logits = logits + SOLVE_STEP * g
+    return logits
+
+
 def _relaxed_assignment(state: NodeState, asks: Asks,
                         config: PlacementConfig):
     """Solve the simplex-relaxed joint assignment; returns x [K, N]
@@ -178,28 +212,12 @@ def _relaxed_assignment(state: NodeState, asks: Asks,
     # The MD step on the simplex is x <- x*exp(step*g) renormalized =
     # logits += step*g under softmax — NOT the Euclidean chain rule
     # x*(g - <x,g>), which stalls exactly when x is still diffuse.
-    # The loop is UNROLLED (SOLVE_ITERS is a compile-time constant):
-    # at these shapes a lax.scan's per-iteration dispatch overhead on
-    # CPU backends outweighs the whole body, and the flat graph fuses.
-    logits = lin  # init at the objective's own linear term
-    for _ in range(SOLVE_ITERS):
-        x = jax.nn.softmax(logits + mask, axis=1) * active
-        exp_load = base_frac + jnp.einsum("kn,kr->nr", x,
-                                          res_active) / denom_nr
-        over = jnp.maximum(exp_load - 1.0, 0.0)
-        over_bw = jnp.maximum(
-            base_bw_frac + (x.T @ bw_active) / bw_denom - 1.0, 0.0)
-        over_ports = jnp.maximum(
-            (x.T @ ports_active) / ports_denom - 1.0, 0.0)
-        tot = jnp.sum(exp_load, axis=1) / NUM_RESOURCES
-        node_term = (PACK_REWARD / NUM_RESOURCES) * tot[:, None] \
-            - 2.0 * OVER_PENALTY * over  # [N, R]: d obj / d exp_load
-        g = (lin
-             + jnp.einsum("nr,kr->kn", node_term / denom_nr, res_active)
-             - 2.0 * OVER_PENALTY
-             * (jnp.outer(bw_active, over_bw / bw_denom)
-                + jnp.outer(ports_active, over_ports / ports_denom)))
-        logits = logits + SOLVE_STEP * g
+    # The shared loop lives in mirror_descent() (the defrag solver
+    # warm-starts the same program across rounds).
+    logits = mirror_descent(
+        lin, lin, mask, res_active, bw_active, ports_active,
+        base_frac, base_bw_frac, denom_nr, bw_denom, ports_denom,
+        active, SOLVE_ITERS)  # init at the objective's own linear term
     return jax.nn.softmax(logits + mask, axis=1)
 
 

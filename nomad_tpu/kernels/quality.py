@@ -143,6 +143,14 @@ class QualityBoard:
         self._rings: Dict[str, list] = {}
         # kernel -> should_sample tick count. guarded-by: _lock
         self._ticks: Dict[str, int] = {}
+        # Rolling-window marks (reset_window): kernel -> sample count
+        # at the last reset, so window_snapshot() reads only samples
+        # that landed SINCE — the defrag trajectory on /v1/metrics
+        # without client-side delta math. guarded-by: _lock
+        self._window_marks: Dict[str, int] = {}
+        # broker.wait histogram snapshot at the last reset (count,
+        # buckets) for the windowed queueing p99. guarded-by: _lock
+        self._queue_mark = None
 
     def should_sample(self, kernel: str) -> bool:
         """Whether this eval should pay the O(N) scoring cost (see
@@ -169,6 +177,71 @@ class QualityBoard:
         with self._lock:
             self._rings.clear()
             self._ticks.clear()
+            self._window_marks.clear()
+            self._queue_mark = None
+
+    def reset_window(self) -> None:
+        """Start a fresh rolling window (reset_stats()-style, like the
+        migration governor's): marks every kernel's current sample
+        cursor and snapshots the broker-wait histogram. The telemetry
+        loop calls this each emission interval, so the window gauges on
+        /v1/metrics read per-interval medians — the axis the defrag
+        trajectory is judged on — while the lifetime medians and the
+        Prometheus counters stay monotonic."""
+        marks = self._queue_marks_now()
+        with self._lock:
+            for kernel, ent in self._rings.items():
+                self._window_marks[kernel] = ent[2]
+            self._queue_mark = marks
+
+    @staticmethod
+    def _queue_marks_now():
+        from .. import trace
+
+        return trace.get_recorder().stage_buckets("broker.wait")
+
+    def window_snapshot(self, reset: bool = False) -> Dict[str, dict]:
+        """Per-kernel medians over samples since the last
+        reset_window() (capped at the ring size), plus the windowed
+        broker-wait queueing p99. A kernel with no window samples is
+        omitted — a gauge repeating a stale median would fake a flat
+        trajectory."""
+        from ..utils.metrics import hist_percentile
+
+        with self._lock:
+            items = [(k, ent[0].copy(), ent[1].copy(), ent[2],
+                      self._window_marks.get(k, 0))
+                     for k, ent in self._rings.items()]
+            queue_mark = self._queue_mark
+        out: Dict[str, dict] = {}
+        kernels: Dict[str, dict] = {}
+        for kernel, frag, binp, count, mark in items:
+            n_window = min(count - mark, SAMPLE_CAP, count)
+            if n_window <= 0:
+                continue
+            # The window's slots are the n_window newest writes:
+            # cursor positions [count - n_window, count) mod cap.
+            slots = (np.arange(count - n_window, count) % SAMPLE_CAP)
+            kernels[kernel] = {
+                "fragmentation": round(float(np.median(frag[slots])), 4),
+                "binpack_score": round(float(np.median(binp[slots])), 4),
+                "samples": int(n_window),
+            }
+        out["kernels"] = kernels
+        cur = self._queue_marks_now()
+        queueing = 0.0
+        if cur is not None:
+            count, buckets = cur
+            if queue_mark is not None:
+                m_count, m_buckets = queue_mark
+                count -= m_count
+                buckets = [b - mb for b, mb in zip(buckets, m_buckets)]
+            if count > 0:
+                queueing = hist_percentile(buckets, count, 0.99)
+        out["queueing_delay_ms"] = round(float(queueing), 3)
+        if reset:
+            self.reset_window()
+        return out
 
     def snapshot(self) -> Dict[str, dict]:
         """Per-kernel medians + sample counts, plus the queueing-delay

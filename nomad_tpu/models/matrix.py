@@ -69,6 +69,16 @@ _BASE_CACHE_LOCK = __import__("threading").Lock()
 _BASE_TOKENS = __import__("itertools").count(1)
 
 
+def base_epoch() -> int:
+    """The stale-purge epoch (bumped by every plan-apply-rejection
+    purge in resolve_cluster_base). The defrag loop snapshots it before
+    a solve and discards the solved wave if it moved — a wave derived
+    from a chain the applier just convicted must commit nothing
+    (nomad_tpu/defrag, chaos site `defrag.solve_stale`)."""
+    with _BASE_CACHE_LOCK:
+        return _BASE_EPOCH
+
+
 class _ClusterBase:
     __slots__ = ("n_real", "n", "capacity", "sched_capacity",
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",

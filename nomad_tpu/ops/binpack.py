@@ -690,8 +690,14 @@ def _jit_entry_points():
 
 def jit_cache_size() -> int:
     """Total compiled-program count across the placement entry points
-    (jax's per-function in-process jit cache)."""
-    total = 0
+    (jax's per-function in-process jit cache). The defrag loop's
+    global-relaxation solve (nomad_tpu/defrag/solver.py) joins the
+    count: it is off the latency path, but a shape leak there would
+    eat the same multi-second compile stalls — steady state is exactly
+    cold+warm per live (K bucket, N) shape and then FLAT."""
+    from ..defrag.solver import solve_cache_size
+
+    total = solve_cache_size()
     for fn in _jit_entry_points():
         try:
             total += fn._cache_size()

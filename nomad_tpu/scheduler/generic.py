@@ -97,6 +97,7 @@ class GenericScheduler:
             consts.EVAL_TRIGGER_MAX_PLANS,
             consts.EVAL_TRIGGER_MIGRATION,
             consts.EVAL_TRIGGER_PREEMPTION,
+            consts.EVAL_TRIGGER_DEFRAG,
         ):
             desc = f"scheduler cannot handle '{eval.triggered_by}' evaluation reason"
             set_status(
@@ -254,6 +255,26 @@ class GenericScheduler:
         return inplace_update(
             self.ctx, self.eval, self.job, self.stack, updates)
 
+    def _live_defrag_marks(self) -> set:
+        """The eval's defrag-marked alloc ids, IF the wave is still
+        live. Expired markers (defrag_wave_expires passed — the loop
+        abandoned the wave and released its governor slots) are void:
+        staging budget-exempt evictions against slots nobody holds
+        would silently exceed migrate_max_parallel, and the solve the
+        markers came from is stale regardless. One gate feeds BOTH the
+        ignore->migrate promotion and the budget exemption, so they
+        can never disagree."""
+        ids = self.eval.defrag_alloc_ids
+        if not ids:
+            return set()
+        expires = self.eval.defrag_wave_expires
+        if expires and time.time() >= expires:
+            self.logger.info(
+                "eval %s: defrag wave markers expired; ignoring %d "
+                "marked allocs", self.eval.id, len(ids))
+            return set()
+        return set(ids)
+
     def _defer_migrations(self) -> None:
         """Mint (once per eval) the follow-up migration eval that
         re-runs this job's reconciliation for the displaced allocs the
@@ -316,6 +337,26 @@ class GenericScheduler:
         allocs, terminal_allocs = self._filter_complete_allocs(allocs)
 
         diff = diff_allocs(self.job, tainted, groups, allocs, terminal_allocs)
+
+        # Continuous defragmentation (nomad_tpu/defrag): a defrag eval
+        # marks specific healthy allocs for migration — promote them
+        # out of the ignore bucket so they ride the SAME evict-and-
+        # place leg as drain migrations (applier-verified eviction +
+        # replacement placement in one plan, exactly-once terminal).
+        # Allocs the diff already routed elsewhere (update/stop/lost:
+        # the cluster moved since the solve snapshot) keep their
+        # routing — defrag never overrides reconciliation.
+        marked = self._live_defrag_marks()
+        if marked:
+            keep: List[AllocTuple] = []
+            for tup in diff.ignore:
+                if (tup.alloc is not None and tup.alloc.id in marked
+                        and not tup.alloc.terminal_status()):
+                    diff.migrate.append(tup)
+                else:
+                    keep.append(tup)
+            diff.ignore = keep
+
         self.logger.debug("eval %s job %s: %s", self.eval.id, self.eval.job_id, diff)
 
         for e in diff.stop:
@@ -347,8 +388,27 @@ class GenericScheduler:
 
             check_migration_chaos(self.eval.id)
             _t0 = time.monotonic()
-            granted = get_governor().acquire(len(migrate_now))
-            self._migrate_permits += granted
+            # Defrag-marked migrations are budget-EXEMPT here: the
+            # defrag loop already claimed their governor slots when it
+            # minted the wave (and releases them when this eval goes
+            # terminal) — re-claiming would double-count the wave
+            # against migrate_max_parallel. They sort first so a
+            # partial grant never defers a pre-claimed move. The
+            # exemption applies only while the wave's markers are LIVE
+            # (_live_defrag_marks): past defrag_wave_expires the loop
+            # has released those slots.
+            pre_claimed = 0
+            marked = self._live_defrag_marks()
+            if marked:
+                pre = [t for t in migrate_now
+                       if t.alloc is not None and t.alloc.id in marked]
+                rest = [t for t in migrate_now
+                        if t.alloc is None or t.alloc.id not in marked]
+                migrate_now = pre + rest
+                pre_claimed = len(pre)
+            granted = pre_claimed + get_governor().acquire(
+                len(migrate_now) - pre_claimed)
+            self._migrate_permits += granted - pre_claimed
             deferred = len(migrate_now) - granted
             if deferred:
                 migrate_now = migrate_now[:granted]
@@ -427,7 +487,16 @@ class GenericScheduler:
                 self.failed_tg_allocs[missing.task_group.name] = self.ctx.metrics
 
     def _find_preferred_node(self, missing: AllocTuple):
-        """Sticky ephemeral disk pins the replacement to its old node."""
+        """Sticky ephemeral disk pins the replacement to its old node;
+        a defrag eval prefers the solver's target node for each marked
+        alloc (a PREFERENCE: select_preferring_nodes falls back to the
+        full node set, so an infeasible target costs nothing)."""
+        if missing.alloc is not None and self.eval.defrag_targets:
+            target_id = self.eval.defrag_targets.get(missing.alloc.id)
+            if target_id:
+                node = self.state.node_by_id(target_id)
+                if node is not None and node.ready():
+                    return node
         if missing.alloc is None or missing.alloc.job is None:
             return None
         tg = missing.alloc.job.lookup_task_group(missing.alloc.task_group)

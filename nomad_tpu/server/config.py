@@ -142,6 +142,26 @@ class ServerConfig:
     # job priority, so only above-normal work may evict).
     preempt_priority_threshold: int = 50
 
+    # ---- Continuous defragmentation (nomad_tpu/defrag) ----
+    # Leader-side background optimizer: periodically solves the relaxed
+    # GLOBAL re-placement (the convex kernel's mirror-descent program,
+    # warm-started across rounds) over the device-resident node state
+    # and proposes bounded migration waves through the migration budget
+    # + verified eviction legs. Off by default — it moves healthy
+    # allocs, which is an operator's call to enable.
+    defrag_enabled: bool = False
+    # Seconds between optimization rounds on a green, led cluster
+    # (yellow/red pressure backs off multiplicatively).
+    defrag_interval: float = 30.0
+    # Minimum NET fragmentation gain (0..1, the quality scoreboard's
+    # fragmentation units) a round must measure before it proposes any
+    # wave — below it, churning allocs isn't worth the disruption.
+    defrag_min_gain: float = 0.01
+    # Per-wave move cap; each wave also claims MigrationGovernor slots,
+    # so disruption is additionally bounded by migrate_max_parallel
+    # (one budget shared with drain storms).
+    defrag_max_moves_per_wave: int = 16
+
     # ---- Overload protection (nomad_tpu/admission) ----
     # Bounded broker ready queues: default per-scheduler-type depth cap
     # (0 = unbounded) plus per-type overrides. A full queue sheds the

@@ -371,6 +371,16 @@ class SchedulerExecutive:
         # handoff notify (new lease in hand) re-arms immediately; a
         # plain timeout re-arms at a 5x coarser cadence.
         next_drain = start
+        # Dry-broker early cut (the BENCH_r14 config-5 churn fix): once
+        # a bulk drain comes back EMPTY with a cohort in hand, holding
+        # that cohort for the rest of the window buys nothing — there
+        # is no work left to pack. Under churn the eval graph is a
+        # CHAIN (drain eval -> migration follow-up -> follow-up), so a
+        # full-window hold per hop compounds into the measured x0.71;
+        # the pipeline's dispatch_idle_grace is the same tradeoff,
+        # applied here mid-window. A handoff notify (fresh lease in
+        # hand) re-opens the window — in-flight work beats the grace.
+        empty_since = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
             with self._lock:
@@ -381,6 +391,7 @@ class SchedulerExecutive:
                 got = self.server.eval_dequeue_many(self.types, room)
                 if got:
                     now = time.monotonic()
+                    empty_since = 0.0
                     with self._cond:
                         for ev, token in got:
                             entry = _Entry(ev, token)
@@ -388,16 +399,23 @@ class SchedulerExecutive:
                             self._pending.append(entry)
                             self.evals_in += 1
                 else:
+                    if not empty_since:
+                        empty_since = now
                     next_drain = now + 5 * DEQUEUE_TOPUP_SLICE
             with self._cond:
                 if len(self._pending) >= self.max_batch:
                     break
-                if time.monotonic() - start >= window:
+                now = time.monotonic()
+                if now - start >= window:
+                    break
+                if (self._pending and empty_since
+                        and now - empty_since >= self.idle_grace):
                     break
                 if self._cond.wait(DEQUEUE_TOPUP_SLICE):
                     # Notified: a worker handed a fresh lease over —
                     # the broker plainly has work again.
                     next_drain = 0.0
+                    empty_since = 0.0
         with self._cond:
             batch = self._pending[: self.max_batch]
             del self._pending[: len(batch)]

@@ -166,6 +166,13 @@ class Server:
             preempt_priority_threshold=self.config.preempt_priority_threshold,
             pressure_probe=self.admission.level,
         )
+        # Continuous defragmentation (nomad_tpu/defrag): the leader-
+        # side optimizer loop. Constructed unconditionally (stats
+        # surface); it only optimizes while defrag_enabled AND this
+        # server leads AND the admission monitor reads green.
+        from ..defrag import DefragLoop
+
+        self.defrag = DefragLoop(self)
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -246,6 +253,7 @@ class Server:
             worker.start()
         self.dispatch.start()
         self.executive.start()
+        self.defrag.start()
         self.establish_leadership()
         self._start_telemetry()
 
@@ -318,6 +326,36 @@ class Server:
                         metrics.set_gauge(
                             ("placement_quality", kname,
                              "binpack_score"), q["binpack_score"])
+                    # Per-interval quality window (kernels/quality.py
+                    # window_snapshot): each emission publishes the
+                    # medians of the samples since the LAST emission
+                    # then re-marks — the defrag fragmentation
+                    # trajectory reads straight off /v1/metrics with
+                    # no client-side delta math.
+                    pw = _quality_board().window_snapshot(reset=True)
+                    metrics.set_gauge(
+                        ("placement_quality", "window",
+                         "queueing_delay_ms"), pw["queueing_delay_ms"])
+                    for kname, q in pw["kernels"].items():
+                        metrics.set_gauge(
+                            ("placement_quality", kname,
+                             "window_fragmentation"),
+                            q["fragmentation"])
+                        metrics.set_gauge(
+                            ("placement_quality", kname,
+                             "window_binpack_score"),
+                            q["binpack_score"])
+                    # Continuous defragmentation (nomad_tpu/defrag):
+                    # the loop's trajectory + gate counters, so an
+                    # operator can see rounds/waves/moves and the
+                    # last measured gain on a dashboard.
+                    df = self.defrag.stats()
+                    for gname in ("rounds", "waves", "waves_lost",
+                                  "moves_proposed", "moves_completed",
+                                  "pressure_skips", "stale_discards",
+                                  "last_gain", "last_fragmentation",
+                                  "last_solve_ms"):
+                        metrics.set_gauge(("defrag", gname), df[gname])
                     if not self._leader:
                         # Broker/plan-queue/heartbeats are leader-only
                         # (eval_broker.go:650 runs in the leader loop);
@@ -387,6 +425,7 @@ class Server:
             worker.start()
         self.dispatch.start()
         self.executive.start()
+        self.defrag.start()
         self.raft.start()
         threading.Thread(target=self._membership_reconcile_loop,
                          name="raft-membership-sweep", daemon=True).start()
@@ -504,6 +543,7 @@ class Server:
             self.raft.stop()
         self.dispatch.stop()
         self.executive.stop()
+        self.defrag.stop()
         for w in self.workers:
             w.stop()
         if self.vault is not None and hasattr(self.vault, "stop"):
@@ -665,6 +705,11 @@ class Server:
         # _restore_evals) — either way no eval is lost with the batch.
         self.dispatch.drain()
         self.executive.drain()
+        # The defrag loop pauses itself on is_leader() per tick; the
+        # explicit abandon here returns its wave's governor slots NOW
+        # instead of on the next tick (the new leader's drain storms
+        # should not find the budget pre-spent by a ghost wave).
+        self.defrag._abandon_wave("leadership-revoked")
         self._stop_eval_hygiene()
         for timer in self._gc_threads:
             timer.cancel()
@@ -1371,6 +1416,10 @@ class Server:
             # in-flight/high-water/deferral counters + preemption
             # staged/committed/placement tallies.
             "churn": _churn_stats(),
+            # Continuous defragmentation (nomad_tpu/defrag): rounds/
+            # waves/moves, gate skips (pressure/budget/stale), solve
+            # cost split cold-vs-warm, and the compiled-program count.
+            "defrag": self.defrag.stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

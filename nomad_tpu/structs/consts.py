@@ -71,6 +71,14 @@ EVAL_TRIGGER_MIGRATION = "migration-budget"
 # preemption pass gets a replacement eval with this trigger (it
 # typically blocks until capacity returns — the cluster was red).
 EVAL_TRIGGER_PREEMPTION = "preemption"
+# Continuous defragmentation (nomad_tpu/defrag): the leader-side
+# optimizer's bounded migration waves ride evals with this trigger,
+# carrying the alloc ids to move (Evaluation.defrag_alloc_ids) and the
+# solver's target nodes (Evaluation.defrag_targets) — the scheduler
+# treats the marked allocs as budget-exempt migrations (the loop holds
+# the governor slots) and prefers the solver's target for each
+# replacement placement.
+EVAL_TRIGGER_DEFRAG = "defrag-migration"
 
 # --- Task states (structs.go:2317) ---
 TASK_STATE_PENDING = "pending"

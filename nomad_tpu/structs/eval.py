@@ -53,6 +53,23 @@ class Evaluation:
     # 0.0 = no deadline. Stamped once at creation (priority-scaled,
     # admission/deadline.py) by the server's eval_update funnel.
     deadline: float = 0.0
+    # Continuous defragmentation (nomad_tpu/defrag): on a
+    # triggered_by=EVAL_TRIGGER_DEFRAG eval, the alloc ids of this job
+    # the optimizer wants moved this wave (the scheduler promotes them
+    # from the diff's ignore bucket to migrate — budget-exempt, the
+    # loop already holds the governor slots) and the solver's target
+    # node per alloc id (a placement PREFERENCE: the replacement still
+    # runs the full feasibility stack and falls back to a free select).
+    defrag_alloc_ids: List[str] = field(default_factory=list)
+    defrag_targets: Dict[str, str] = field(default_factory=dict)
+    # Wall-clock instant past which this wave's markers are VOID: the
+    # loop abandons a wave (and releases its governor slots) after
+    # WAVE_TIMEOUT, so an eval that surfaces later must not stage
+    # budget-exempt evictions against slots nobody holds — and its
+    # solve is stale anyway. The scheduler ignores expired markers
+    # (the eval degrades to a no-op reconciliation); the next round
+    # re-derives from fresh state. 0.0 = no deadline (tests).
+    defrag_wave_expires: float = 0.0
 
     def copy(self) -> "Evaluation":
         return copy.deepcopy(self)

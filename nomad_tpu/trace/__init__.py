@@ -20,6 +20,7 @@ from .span import (  # noqa: F401
     LIFECYCLE_CORE_STAGES,
     STAGE_ALLOC_UPSERT,
     STAGE_BROKER_WAIT,
+    STAGE_DEFRAG_SOLVE,
     STAGE_DEVICE_DISPATCH,
     STAGE_DEVICE_SOLVE,
     STAGE_DEVICE_TRANSFER,

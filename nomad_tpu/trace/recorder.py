@@ -403,6 +403,19 @@ class FlightRecorder:
             return hist_percentile(
                 self._e2e.buckets, self._e2e.count, 0.99)
 
+    def stage_buckets(self, stage: str):
+        """(count, bucket-list copy) of one stage's lifetime histogram,
+        or None before any sample. The rolling-window consumers
+        (kernels/quality.py's per-interval queueing gauge) snapshot
+        this at window reset and percentile over the bucket DELTA —
+        lifetime exposition stays monotonic for Prometheus while the
+        window reads only what landed since the reset."""
+        with self._hist_lock:
+            h = self._hists.get(stage)
+            if h is None or not h.count:
+                return None
+            return h.count, list(h.buckets)
+
     def stage_stats(self) -> Dict[str, dict]:
         """Per-stage latency table: count/mean/max and log-bucket
         p50/p95/p99, all in milliseconds."""

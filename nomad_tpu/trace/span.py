@@ -37,6 +37,10 @@ STAGE_MIGRATE_PLACE = "migrate.place"      # drain-displaced allocs staged
 #   claimed this wave, deferred to the follow-up eval)
 STAGE_PREEMPT_SELECT = "preempt.select"    # dense victim-selection +
 #   placement pass (ops/preempt.py; ann: asks, candidate victims)
+STAGE_DEFRAG_SOLVE = "defrag.solve"        # one defrag-loop round's
+#   warm-started global relaxation solve + move extraction
+#   (nomad_tpu/defrag; ann: movable, moves, gain, warm, solve_ms) —
+#   recorded on its own per-round trace, not an eval's
 STAGE_PLAN_SUBMIT = "plan.submit"          # plan queue wait + commit (worker view)
 STAGE_PLAN_EVALUATE = "plan.evaluate"      # applier per-node verification
 STAGE_PLAN_COMMIT = "plan.commit"          # raft apply of the accepted plan
@@ -54,6 +58,7 @@ ALL_STAGES = (
     STAGE_DEVICE_SOLVE,
     STAGE_MIGRATE_PLACE,
     STAGE_PREEMPT_SELECT,
+    STAGE_DEFRAG_SOLVE,
     STAGE_PLAN_SUBMIT,
     STAGE_PLAN_EVALUATE,
     STAGE_PLAN_COMMIT,

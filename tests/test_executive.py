@@ -137,6 +137,42 @@ def settle(server, evals, timeout=120.0):
         e: getattr(state.eval_by_id(e), "status", None) for e in evals}
 
 
+def test_drain_cuts_early_when_broker_dry():
+    """BENCH_r14's config-5 churn regression (x0.71): with finalize
+    tails outstanding the drain accumulated the FULL dispatch window
+    even after the broker ran dry — under churn's chained follow-up
+    evals that full-window hold compounds per hop. The fix: a cohort
+    in hand + an empty bulk drain for dispatch_idle_grace cuts early.
+    Driven directly against _drain with a deliberately huge window: a
+    3-eval cohort must come back in a fraction of it."""
+    # No threads: the drain is called directly (the running executive
+    # would race it for the broker's evals otherwise).
+    server = Server(ServerConfig(
+        num_schedulers=2,
+        scheduler_factories={"service": "service-tpu"},
+        eval_batch_size=16, scheduler_executive=True,
+        dispatch_window=30.0, dispatch_idle_grace=0.02))
+    try:
+        server.establish_leadership()
+        seed_nodes(server, 4)
+        for i in range(3):
+            server.job_register(make_job(f"dry-{i}", count=2))
+        assert server.broker.ready_count() == 3
+        # the worker handoff seed (what wakes the drain)
+        ev, token = server.broker.dequeue(["service"], timeout=1.0)
+        assert ev is not None
+        server.executive.submit(ev, token)
+        t0 = time.monotonic()
+        batch = server.executive._drain(window=30.0)
+        elapsed = time.monotonic() - t0
+        assert len(batch) == 3
+        assert elapsed < 5.0, f"drain held a dry broker {elapsed:.1f}s"
+        for entry in batch:
+            server.eval_nack(entry.eval.id, entry.token)
+    finally:
+        server.shutdown()
+
+
 def test_executive_storm_forms_cohorts_and_places_exactly_once():
     server = make_server()
     try:

@@ -564,7 +564,8 @@ CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/ops/", "nomad_tpu/parallel/",
              "nomad_tpu/trace/", "nomad_tpu/admission/",
              "nomad_tpu/models/", "nomad_tpu/kernels/",
-             "nomad_tpu/migrate/", "nomad_tpu/profile/")
+             "nomad_tpu/migrate/", "nomad_tpu/profile/",
+             "nomad_tpu/defrag/")
 
 
 def _tree_findings():
@@ -1861,6 +1862,41 @@ def test_migrate_module_raw_clean_and_in_every_scope():
     assert offenders == [], "\n".join(f.render() for f in offenders)
     assert [e for e in load_baseline()
             if e["path"].startswith("nomad_tpu/migrate/")] == []
+
+
+def test_defrag_module_raw_clean_and_in_every_scope():
+    """Defrag-PR acceptance (the ISSUE's ntalint satellite):
+    nomad_tpu/defrag/ (the background optimizer) is in the
+    baseline-free core set, the unbounded-wait / swallowed-exception
+    scopes, and both bench gates' dir sets, with ZERO findings of ANY
+    rule and ZERO baseline entries or inline suppressions — the loop
+    holds migration-budget slots across waves, where a swallowed
+    exception or an unbounded wait leaks budget every drain storm
+    then fights."""
+    from nomad_tpu.analysis.robustness import (
+        SWALLOW_SCOPE_MARKERS,
+        WAIT_SCOPE_MARKERS,
+    )
+
+    assert "nomad_tpu/defrag/" in CORE_DIRS
+    assert "/defrag/" in WAIT_SCOPE_MARKERS
+    assert "/defrag/" in SWALLOW_SCOPE_MARKERS
+    # bench.py imports heavy deps at module load; read the gate dir
+    # tuples textually instead (they are module-level literals).
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"defrag"' in bench_src.split(
+        "PURITY_GATE_DIRS")[1].split(")")[0]
+    assert '"nomad_tpu/defrag/"' in bench_src.split(
+        "CONCURRENCY_GATE_DIRS")[1].split(")")[0]
+    offenders = [f for f in _tree_findings()
+                 if f.path.startswith("nomad_tpu/defrag/")]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["path"].startswith("nomad_tpu/defrag/")] == []
+    for fname in ("__init__.py", "solver.py"):
+        src = open(os.path.join(
+            REPO, "nomad_tpu", "defrag", fname)).read()
+        assert "nta: disable" not in src, fname
 
 
 def test_executive_module_manifests_and_raw_clean():
