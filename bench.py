@@ -2511,6 +2511,281 @@ def run_defrag_ab(reps=2, check=False):
     return out
 
 
+GANG_STEP_MS = 1000.0  # sim-time per churn step (the DL-trace clock)
+
+
+def _gang_churn_arm(gang_on, seed, n_racks=8, rack_size=4, steps=12,
+                    k=6, arrivals_per_step=2, dl_lifetime=3):
+    """One gang-ab arm: a DL-trace-shaped workload, Tesserae-style —
+    large gangs (k=6 trainers, 1000cpu/1200mem each) ARRIVING OVER
+    CHURN on a racked topology cluster (racks of 4 × 3000cpu/3000mem
+    nodes, so an empty rack holds 8 members and a churned one may not
+    hold 6), with small filler services fragmenting racks between
+    arrivals. ON places each DL job as a slice gang (all-K on one
+    rack); OFF places the identical asks as plain independent groups.
+
+    Deterministic (Harness + dense factory, sim-time clock): each step
+    churns a slice of filler (stop + refill — the scatter that
+    fragments), lands ``arrivals_per_step`` new DL jobs, COMPLETES DL
+    jobs placed ``dl_lifetime`` steps ago (training runs finish — the
+    steady-state recycle that keeps gangs arriving onto partially-free
+    slices instead of a saturated wall), and re-evaluates every
+    not-fully-placed DL job (the blocked-eval re-run analog).
+    ``gang_wait`` for a job = steps from arrival until ALL k members
+    are live, in GANG_STEP_MS units — the queueing axis Tesserae says
+    gang packing dominates.
+
+    Returns gang_wait_p99_ms / slice_frag trajectory / contiguity /
+    the partial-commit sweep (ON: every DL job's live member count is
+    0 or exactly k at EVERY step — one partial observation anywhere
+    poisons the arm) and the jit program count after warmup."""
+    import random as _random
+
+    from nomad_tpu import mock
+    from nomad_tpu.gang import reset_gang_stats
+    from nomad_tpu.kernels.quality import slice_frag_from_store
+    from nomad_tpu.ops.binpack import jit_cache_size
+    from nomad_tpu.scheduler.testing import Harness, seed_harness_cluster
+    from nomad_tpu.structs import Gang, consts
+    from nomad_tpu.structs.eval import new_eval as _new_eval
+
+    rng = _random.Random(seed)
+    reset_gang_stats()
+
+    nodes = []
+    for i in range(n_racks * rack_size):
+        node = mock.node()
+        node.resources.cpu = 3000
+        node.resources.memory_mb = 3000
+        node.meta["rack"] = f"r{i // rack_size}"
+        node.meta["ici"] = f"r{i // rack_size}-i{(i % rack_size) // 2}"
+        node.compute_class()
+        nodes.append(node)
+    h = Harness(seed=seed)
+    seed_harness_cluster(h, nodes=nodes)
+    node_rack = {n.id: n.meta["rack"] for n in nodes}
+
+    def make_filler(idx):
+        job = mock.job()
+        job.id = f"filler-{idx}"
+        tg = job.task_groups[0]
+        tg.count = 2
+        t = tg.tasks[0]
+        t.resources.cpu = 600
+        t.resources.memory_mb = 500
+        t.resources.networks = []
+        return job
+
+    def make_dl(idx):
+        job = mock.job()
+        job.id = f"dl-{idx}"
+        tg = job.task_groups[0]
+        tg.count = k
+        if gang_on:
+            tg.gang = Gang(slice="rack")
+        t = tg.tasks[0]
+        t.resources.cpu = 1000
+        t.resources.memory_mb = 1200
+        t.resources.networks = []
+        return job
+
+    def register_and_eval(job):
+        h.state.upsert_job(h.next_index(), job.copy())
+        h.process("service-tpu", _new_eval(
+            h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+
+    def live_count(jid):
+        return len([a for a in h.state.allocs_by_job(jid)
+                    if not a.terminal_status()])
+
+    fillers = []
+    for i in range(2 * n_racks):
+        job = make_filler(i)
+        register_and_eval(job)
+        fillers.append(job)
+
+    pending = []  # (job, arrived_step)
+    placed = {}  # job id -> (job, arrived_step, placed_step)
+    placed_racks = {}  # job id -> rack set AT PLACEMENT TIME
+    partial_events = 0
+    frag_trajectory = []
+    jit_warm = None
+    arrived_total = 0
+    frag_ref = make_dl(-1)  # the slice_frag reference ask/k
+
+    for step in range(steps):
+        # departures: DL jobs placed dl_lifetime steps ago complete —
+        # the training run finished, the slice frees
+        for jid, (dl_job, _arr, pl) in list(placed.items()):
+            if pl is not None and step - pl >= dl_lifetime:
+                for a in h.state.allocs_by_job(jid):
+                    if not a.terminal_status():
+                        done = a.copy()
+                        done.desired_status = consts.ALLOC_DESIRED_STOP
+                        done.client_status = consts.ALLOC_CLIENT_COMPLETE
+                        h.state.upsert_allocs(h.next_index(), [done])
+                placed[jid] = (dl_job, _arr, pl)
+
+        # churn: client-complete a slice of filler allocs and refill
+        # the holes — the scatter that fragments racks
+        for job in fillers:
+            for a in h.state.allocs_by_job(job.id):
+                if not a.terminal_status() and rng.random() < 0.15:
+                    stopped = a.copy()
+                    stopped.desired_status = consts.ALLOC_DESIRED_STOP
+                    stopped.client_status = consts.ALLOC_CLIENT_COMPLETE
+                    h.state.upsert_allocs(h.next_index(), [stopped])
+        for job in fillers:
+            if live_count(job.id) < job.task_groups[0].count:
+                h.process("service-tpu", _new_eval(
+                    h.state.job_by_id(job.id),
+                    consts.EVAL_TRIGGER_NODE_UPDATE))
+
+        # arrivals: new DL jobs this step
+        for _ in range(arrivals_per_step):
+            job = make_dl(arrived_total)
+            arrived_total += 1
+            register_and_eval(job)
+            pending.append((job, step))
+
+        # blocked-gang re-runs: every not-fully-placed DL job retries
+        still = []
+        for dl_job, arrived in pending:
+            if live_count(dl_job.id) < k and arrived != step:
+                h.process("service-tpu", _new_eval(
+                    h.state.job_by_id(dl_job.id),
+                    consts.EVAL_TRIGGER_NODE_UPDATE))
+            n_live = live_count(dl_job.id)
+            if gang_on and n_live not in (0, k):
+                partial_events += 1
+            if n_live >= k:
+                placed[dl_job.id] = (dl_job, arrived, step)
+                placed_racks[dl_job.id] = {
+                    node_rack[a.node_id]
+                    for a in h.state.allocs_by_job(dl_job.id)
+                    if not a.terminal_status()}
+            else:
+                still.append((dl_job, arrived))
+        pending = still
+
+        if step == 1:
+            jit_warm = jit_cache_size()
+        frag_trajectory.append(slice_frag_from_store(
+            h.state.snapshot(), frag_ref, frag_ref.task_groups[0]))
+
+    # contiguity: fraction of fully-placed DL jobs whose members
+    # shared ONE rack at placement time (the ON arm's whole point;
+    # OFF reports what scattering costs)
+    contiguous = sum(1 for racks in placed_racks.values()
+                     if len(racks) == 1)
+    waits = [(pl - arr) * GANG_STEP_MS
+             for _j, arr, pl in placed.values()]
+    # still-unplaced jobs waited the whole remaining trace (censored
+    # at the horizon — dropping them would reward never placing)
+    waits += [(steps - arr) * GANG_STEP_MS for _j, arr in pending]
+    jit_end = jit_cache_size()
+    return {
+        "gang": bool(gang_on),
+        "jobs": arrived_total,
+        "jobs_fully_placed": len(placed),
+        "jobs_unplaced_at_horizon": len(pending),
+        "members_live": sum(live_count(f"dl-{i}")
+                            for i in range(arrived_total)),
+        "partial_commit_events": partial_events,
+        "placed_contiguous_frac": round(contiguous / len(placed), 4)
+        if placed else 0.0,
+        "gang_wait_p99_ms": round(float(np.percentile(waits, 99)), 1)
+        if waits else 0.0,
+        "gang_wait_mean_ms": round(float(np.mean(waits)), 1)
+        if waits else 0.0,
+        "slice_frag_final": round(frag_trajectory[-1], 4),
+        "slice_frag_mean": round(float(np.mean(frag_trajectory)), 4),
+        "slice_frag_trajectory": [round(f, 4) for f in frag_trajectory],
+        "jit_after_warmup": jit_warm if jit_warm is not None else jit_end,
+        "jit_end": jit_end,
+        "jit_recompiles": (jit_end - jit_warm)
+        if jit_warm is not None else 0,
+    }
+
+
+def run_gang_ab(reps=2, check=False):
+    """Gang ON/OFF A/B -> BENCH_r16: the identical DL-trace-shaped
+    seeded churn in both arms, ON placing slice gangs, OFF the same
+    asks as independent groups. Acceptance: ON places every fully-
+    placed gang on ONE contiguous rack with ZERO partial-commit
+    observations and steady-state recompiles 0; the scoreboard gets
+    the gang_wait_p99_ms / slice_frag columns both ways. With --check,
+    refuses numbers on any partially-committed gang, a non-contiguous
+    placed gang, or a recompile after warmup."""
+    arms = {"on": [], "off": []}
+    for rep in range(reps):
+        arms["on"].append(_gang_churn_arm(True, seed=16_000 + rep))
+        arms["off"].append(_gang_churn_arm(False, seed=16_000 + rep))
+
+    if check:
+        for rep, r in enumerate(arms["on"]):
+            if r["partial_commit_events"]:
+                print(f"bench: REFUSING gang-ab numbers: rep {rep} "
+                      f"observed {r['partial_commit_events']} "
+                      "partially-committed gang state(s) — the one "
+                      "thing the subsystem exists to prevent",
+                      file=sys.stderr)
+                sys.exit(2)
+            if r["jobs_fully_placed"] and \
+                    r["placed_contiguous_frac"] < 1.0:
+                print(f"bench: REFUSING gang-ab numbers: rep {rep} "
+                      f"placed a slice gang across racks "
+                      f"(contiguous {r['placed_contiguous_frac']})",
+                      file=sys.stderr)
+                sys.exit(2)
+            if r["jit_recompiles"] > 0:
+                print(f"bench: REFUSING gang-ab numbers: rep {rep} "
+                      f"recompiled after warmup "
+                      f"({r['jit_after_warmup']} -> {r['jit_end']})",
+                      file=sys.stderr)
+                sys.exit(2)
+
+    def med(rr, key):
+        m, _ = _median_iqr([float(r[key]) for r in rr])
+        return m
+
+    on, off = arms["on"], arms["off"]
+    out = {
+        "metric": (f"[gang-ab DL-trace churn, median-of-{reps}] "
+                   f"ON: {med(on, 'jobs_fully_placed'):.0f}/"
+                   f"{on[0]['jobs']} gangs on contiguous slices "
+                   f"(contiguous {med(on, 'placed_contiguous_frac'):.2f},"
+                   f" wait p99 {med(on, 'gang_wait_p99_ms'):.0f}ms, "
+                   f"slice_frag {med(on, 'slice_frag_final'):.4f}, "
+                   f"partials {med(on, 'partial_commit_events'):.0f}); "
+                   f"OFF: {med(off, 'jobs_fully_placed'):.0f} placed "
+                   f"(contiguous {med(off, 'placed_contiguous_frac'):.2f}"
+                   f", wait p99 {med(off, 'gang_wait_p99_ms'):.0f}ms, "
+                   f"slice_frag {med(off, 'slice_frag_final'):.4f})"),
+        "gang_on": {k: (on[0][k] if k == "slice_frag_trajectory"
+                        else med(on, k) if isinstance(on[0][k],
+                                                      (int, float))
+                        else on[0][k])
+                    for k in on[0]},
+        "gang_off": {k: (off[0][k] if k == "slice_frag_trajectory"
+                         else med(off, k) if isinstance(off[0][k],
+                                                        (int, float))
+                         else off[0][k])
+                     for k in off[0]},
+        "acceptance": {
+            "zero_partial_commits": all(
+                r["partial_commit_events"] == 0 for r in on),
+            "all_placed_gangs_contiguous": all(
+                r["placed_contiguous_frac"] == 1.0
+                for r in on if r["jobs_fully_placed"]),
+            "steady_state_recompiles_zero": all(
+                r["jit_recompiles"] == 0 for r in on),
+            "gangs_placed_on": [r["jobs_fully_placed"] for r in on],
+        },
+    }
+    return out
+
+
 def _exec_profile_snapshot():
     """Per-arm convoy/runq/dispatch-gap columns — the exact axes
     BENCH_r13 measured on the pre-executive shape (convoy width 63/64,
@@ -2824,10 +3099,11 @@ def _convoy_gate(out, n):
 # self-checks (tests/test_static_analysis.py) can assert the kernels
 # subsystem is inside both gates rather than trusting a string copy.
 PURITY_GATE_DIRS = ("ops", "scheduler", "kernels", "migrate",
-                    "defrag")
+                    "defrag", "gang")
 CONCURRENCY_GATE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
                          "nomad_tpu/server/", "nomad_tpu/kernels/",
-                         "nomad_tpu/migrate/", "nomad_tpu/defrag/")
+                         "nomad_tpu/migrate/", "nomad_tpu/defrag/",
+                         "nomad_tpu/gang/")
 
 
 def ntalint_purity_gate():
@@ -2962,6 +3238,17 @@ def main():
                              "(BENCH_r15)")
     parser.add_argument("--defrag-ab-reps", type=int, default=2,
                         help="seeded churn reps per defrag-ab arm")
+    parser.add_argument("--gang-ab", action="store_true",
+                        help="gang ON/OFF A/B on a DL-trace-shaped "
+                             "arm (large slice gangs arriving over "
+                             "churn, Tesserae-style), scored on "
+                             "gang_wait_p99_ms / slice_frag; with "
+                             "--check refuses numbers on any "
+                             "partially-committed gang, a "
+                             "non-contiguous placed slice gang, or "
+                             "steady-state recompiles > 0")
+    parser.add_argument("--gang-ab-reps", type=int, default=2,
+                        help="seeded churn reps per gang-ab arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -3069,6 +3356,11 @@ def main():
     if args.defrag_ab:
         print(json.dumps(run_defrag_ab(reps=args.defrag_ab_reps,
                                        check=args.check)))
+        return
+
+    if args.gang_ab:
+        print(json.dumps(run_gang_ab(reps=args.gang_ab_reps,
+                                     check=args.check)))
         return
 
     if args.resident_ab:

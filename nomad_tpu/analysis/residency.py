@@ -39,7 +39,8 @@ from .core import Finding, Module
 
 RULE_RESHIP = "full-matrix-reship"
 
-SCOPE_MARKERS = ("/dispatch/", "/scheduler/", "/models/", "/kernels/")
+SCOPE_MARKERS = ("/dispatch/", "/scheduler/", "/models/", "/kernels/",
+                 "/gang/")
 
 REBUILD_MANIFEST = "NTA_REBUILD_ENTRYPOINTS"
 # Call names that move host arrays onto the device. `device_put`

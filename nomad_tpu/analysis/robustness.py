@@ -67,10 +67,10 @@ RULE_RECORD_PATH = "record-path-blocking"
 
 WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/",
                       "/admission/", "/scheduler/", "/migrate/",
-                      "/profile/", "/defrag/")
+                      "/profile/", "/defrag/", "/gang/")
 SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
                          "/admission/", "/migrate/", "/profile/",
-                         "/defrag/")
+                         "/defrag/", "/gang/")
 
 # Attribute calls that block forever when called with no timeout.
 UNBOUNDED_WAIT_ATTRS = {"wait", "get", "join"}

@@ -78,6 +78,18 @@ site                   wired into
                        MigrationGovernor slot the loop claimed is
                        released; the wave's evals keep their own
                        exactly-once terminal path)
+``gang.partial_commit``  plan-applier gang verification
+                       (drop = one gang member's node is treated as
+                       under-fitting at verification time — the WHOLE
+                       gang must reject, every member filtered off
+                       accepted nodes too, nothing partial commits;
+                       server/plan_apply.py)
+``gang.member_lost``   gang reconciliation in the scheduler (drop =
+                       one live gang member is treated as lost — its
+                       node died mid-flight — which must trigger the
+                       whole-gang replacement: survivors stopped and
+                       all K re-placed atomically;
+                       scheduler/generic.py)
 =====================  =======================================================
 """
 
@@ -112,6 +124,8 @@ KNOWN_SITES = frozenset({
     "preempt.victim_lost",
     "defrag.solve_stale",
     "defrag.wave_lost",
+    "gang.partial_commit",
+    "gang.member_lost",
 })
 
 DROP = "drop"

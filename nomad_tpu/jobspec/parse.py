@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 from ..structs import (
     Constraint,
     EphemeralDisk,
+    Gang,
     Job,
     LogConfig,
     NetworkResource,
@@ -171,7 +172,8 @@ def _parse_constraints(node: Any) -> List[Constraint]:
 def _parse_group(name: str, body: Dict[str, Any]) -> TaskGroup:
     _check_keys(
         body,
-        ["count", "constraint", "restart", "meta", "task", "ephemeral_disk"],
+        ["count", "constraint", "restart", "meta", "task", "ephemeral_disk",
+         "gang"],
         f"group {name!r}",
     )
     tg = TaskGroup(
@@ -196,6 +198,14 @@ def _parse_group(name: str, body: Dict[str, Any]) -> TaskGroup:
             sticky=bool(d.get("sticky", False)),
             migrate=bool(d.get("migrate", False)),
             size_mb=int(d.get("size", 300)),
+        )
+    if "gang" in body:
+        g = body["gang"] or {}
+        _check_keys(g, ["slice", "affinity", "spread"], "gang")
+        tg.gang = Gang(
+            slice=str(g.get("slice", "")),
+            affinity=str(g.get("affinity", "")),
+            spread=str(g.get("spread", "")),
         )
     for task_name, task_body in _labeled_blocks(body.get("task")):
         tg.tasks.append(_parse_task(task_name, task_body))

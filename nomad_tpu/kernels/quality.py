@@ -115,6 +115,87 @@ def quality_from_store(state, job) -> Dict[str, float]:
         util, capacity, node_ok, reference_ask(job))
 
 
+def slice_fragmentation(util, capacity, node_ok, topo_ids, ask_res,
+                        k: int) -> float:
+    """Gang-scheduling quality axis (nomad_tpu/gang): the fraction of
+    the cluster's free cpu+mem capacity stranded in topology groups
+    that can no longer fit a WHOLE gang of ``k`` members asking
+    ``ask_res`` — node-level fragmentation's analog at rack/ICI
+    granularity. 0 = every group's free capacity is gang-usable; 1 =
+    all remaining headroom sits in groups too fragmented (or too
+    small) for any gang. Nodes with topo id < 0 count as stranded for
+    gangs (they can never prove slice contiguity)."""
+    util = np.asarray(util, np.float64)
+    capacity = np.asarray(capacity, np.float64)
+    node_ok = np.asarray(node_ok, bool)
+    topo_ids = np.asarray(topo_ids, np.int64)
+    ask = np.asarray(ask_res, np.float64)
+
+    real = node_ok & (capacity[:, 0] > 0)
+    if not real.any():
+        return 0.0
+    cap = capacity[real]
+    use = np.minimum(util[real], cap)
+    free = cap - use
+    ids = topo_ids[: len(node_ok)][real]
+
+    # Per-node member units from free capacity (ops/gang.py
+    # _member_units, resource dims only).
+    units = np.full(len(cap), np.inf)
+    for r in range(min(len(ask), cap.shape[1])):
+        if ask[r] > 0:
+            units = np.minimum(units, np.floor(free[:, r] / ask[r]))
+    units = np.where(np.isfinite(units), np.maximum(units, 0.0), 0.0)
+
+    weight = free[:, 0] / max(cap[:, 0].max(), 1.0) + \
+        free[:, 1] / max(cap[:, 1].max(), 1.0)
+    total = float(weight.sum())
+    if total <= 0:
+        return 0.0
+    stranded = float(weight[ids < 0].sum())
+    for gid in np.unique(ids[ids >= 0]):
+        sel = ids == gid
+        if units[sel].sum() < k:
+            stranded += float(weight[sel].sum())
+    return stranded / total
+
+
+def slice_frag_from_store(state, job, tg, level: str = "rack") -> float:
+    """slice_fragmentation recomputed from a state-store snapshot (the
+    bench --gang-ab column and rig checks). ``tg`` is the gang task
+    group whose member ask and count parameterize the axis."""
+    from ..models.topology import TOPOLOGY_META_KEYS
+    from ..structs import allocs_fit
+
+    key = TOPOLOGY_META_KEYS[level]
+    nodes = list(state.nodes())
+    n = len(nodes)
+    util = np.zeros((n, 4), np.float64)
+    capacity = np.zeros((n, 4), np.float64)
+    node_ok = np.zeros(n, bool)
+    topo = np.full(n, -1, np.int64)
+    interned = {}
+    for i, node in enumerate(nodes):
+        r = node.resources
+        capacity[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+        node_ok[i] = node.ready()
+        value = node.meta.get(key)
+        if value:
+            topo[i] = interned.setdefault(value, len(interned))
+        live = [a for a in state.allocs_by_node(node.id)
+                if not a.terminal_status()]
+        _fit, _dim, used = allocs_fit(node, live)
+        util[i] = (used.cpu, used.memory_mb, used.disk_mb, used.iops)
+    ask = np.zeros(4, np.float64)
+    for task in tg.tasks:
+        r = task.resources
+        ask += (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+    if tg.ephemeral_disk:
+        ask[2] += tg.ephemeral_disk.size_mb
+    return slice_fragmentation(util, capacity, node_ok, topo, ask,
+                               tg.count)
+
+
 def reference_ask(job) -> np.ndarray:
     """[R] cpu/mem/disk/iops ask of the job's first task group — the
     fragmentation reference."""

@@ -84,7 +84,7 @@ class _ClusterBase:
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
                  "alloc_groups", "token", "allocs_index", "table_len",
                  "nodes_index", "delta_parent", "class_ids", "class_reps",
-                 "_positions", "_positions_lock")
+                 "topology", "_positions", "_positions_lock")
 
     def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
                  table_len: int = -1, nodes_index: int = -1):
@@ -136,6 +136,14 @@ class _ClusterBase:
         ids, self.class_reps = compute_class_index(nodes)
         self.class_ids = np.full(self.n, -1, np.int32)
         self.class_ids[: len(nodes)] = ids
+        # Node-topology tensor (models/topology.py): rack/ICI id
+        # columns for the gang program. Node-level and alloc-
+        # independent like the class index — delta clones share it by
+        # reference; register/deregister breaks the family and this
+        # rebuild re-derives it.
+        from .topology import TopologyIndex
+
+        self.topology = TopologyIndex(nodes, self.n)
 
     def job_positions(self, job_id: str) -> Dict[str, np.ndarray]:
         """{task_group: node-row indices (with repeats)} for one job's
@@ -370,8 +378,12 @@ class _ClusterBase:
         new.nodes_index = max(base_nodes_index, new_nodes_index)
         new.delta_parent = (self.token, tuple(rows))
         new.n_real, new.n = self.n_real, self.n
-        # Node-level class index is alloc-independent: share it.
+        # Node-level class index is alloc-independent: share it. The
+        # topology tensor rides the same contract (a meta edit that
+        # moved a group also moved the computed class, and the class
+        # checks above already refused the row delta for that).
         new.class_ids, new.class_reps = self.class_ids, self.class_reps
+        new.topology = self.topology
         # Same profiled declaration site as __init__: delta clones ARE
         # the live pipeline's dominant base-build path, and an
         # unprofiled lock here would make the observatory's
@@ -1027,6 +1039,9 @@ class ClusterMatrix:
         # Padded [N] class index: rides the device base upload so the
         # compact overlay's verdict expansion happens on device.
         self.class_ids = base.class_ids
+        # Node-topology tensor (models/topology.py) for the gang
+        # program's slice/spread/affinity group ops.
+        self.topology = base.topology
 
         # Job-specific overlay: this job's per-node alloc counts, from
         # the base's lazy positions index (O(this job's allocs)).

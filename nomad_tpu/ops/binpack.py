@@ -669,9 +669,11 @@ _JIT_ENTRY_POINTS = ()
 def _jit_entry_points():
     global _JIT_ENTRY_POINTS
     if not _JIT_ENTRY_POINTS:
-        # The preemption leg (ops/preempt.py) is part of the placement
-        # path's compile budget: bench.py's jit_recompiles gate must
-        # see its cache too, or a preemption-shape leak would hide.
+        # The preemption leg (ops/preempt.py) and the gang leg
+        # (ops/gang.py) are part of the placement path's compile
+        # budget: bench.py's jit_recompiles gate must see their caches
+        # too, or a preemption/gang shape leak would hide.
+        from .gang import gang_placement_program_jit
         from .preempt import preempt_placement_program_jit
 
         _JIT_ENTRY_POINTS = (
@@ -684,6 +686,7 @@ def _jit_entry_points():
             apply_base_delta,
             device_resident,
             preempt_placement_program_jit,
+            gang_placement_program_jit,
         )
     return _JIT_ENTRY_POINTS
 

@@ -153,6 +153,16 @@ class BatchedTPUScheduler(GenericScheduler):
         from ..ops.binpack import host_prng_key, make_asks
         from .batcher import get_batcher
 
+        # Gang task groups (nomad_tpu/gang) take the dense all-K pass:
+        # one gang = one dispatch of ops/gang.py's program, atomically
+        # staged on the plan's gang leg.
+        gang_sets, place = self._split_gang_placements(place)
+        for tg, tuples in gang_sets:
+            self._place_gang_dense(tg, tuples)
+        if not place:
+            if gang_sets:
+                self._repay_cohort()
+            return
         # Sticky-disk placements keep the host path (they pin to one node).
         sticky: List[AllocTuple] = []
         bulk: List[AllocTuple] = []
@@ -371,6 +381,129 @@ class BatchedTPUScheduler(GenericScheduler):
 
         if unplaced:
             self._preempt_placements(unplaced, tg_indices)
+
+    def _place_gang_dense(self, tg, tuples: List[AllocTuple]) -> None:
+        """One gang's all-K dispatch (ops/gang.py): per-node fit mask
+        -> topology-group cumulative capacity -> contiguous-slice
+        selection -> K-step member assignment, one compiled program
+        over the device-resident base arrays. Members stage through
+        the plan's gang leg (Plan.append_gang_alloc) — the applier
+        verifies per node and rejects the WHOLE gang on any member's
+        under-fit. Device faults and an open breaker fall back to the
+        host gang stack with identical atomicity semantics."""
+        from ..admission import get_breaker
+        from ..chaos import chaos
+        from ..gang import build_gang_state, gang_key, note_gang_result
+        from ..models.matrix import ClusterMatrix
+        from ..ops.binpack import check_device_chaos, host_prng_key
+        from ..ops.gang import gang_placement_program_jit
+        from ..utils import metrics as _metrics
+
+        name = tg.name
+        if self.failed_tg_allocs and name in self.failed_tg_allocs:
+            self.failed_tg_allocs[name].coalesced_failures += len(tuples)
+            return
+
+        breaker = get_breaker()
+        if not breaker.acquire():
+            _metrics.incr_counter(
+                ("scheduler", "gang_breaker_rejected"), len(tuples))
+            self._place_gang_host(tg, tuples)
+            return
+
+        _t0 = time.monotonic()
+        # The matrix includes this plan's earlier staged legs (gang
+        # replacement stops free their capacity through the proposed-
+        # alloc overlay) — the all-K pass must see the room the
+        # survivors' stops open up.
+        matrix = ClusterMatrix(self.state, self.job, self.plan)
+        state, active, (ask_res, ask_bw, ask_ports), config = \
+            build_gang_state(matrix, self.job, tg)
+        key = host_prng_key(self.rng.getrandbits(31))
+        _t_solve = time.monotonic()
+        try:
+            if chaos.enabled:
+                chaos.fire("device.breaker_trip", eval_id=self.eval.id)
+            check_device_chaos()
+            choices, scores, slice_group = gang_placement_program_jit(
+                state, ask_res, ask_bw, ask_ports, active, key, config)
+        except Exception:
+            breaker.record_failure()
+            self.logger.warning(
+                "gang device dispatch failed; falling back to the host "
+                "gang stack for %d members", len(tuples), exc_info=True)
+            _metrics.incr_counter(
+                ("scheduler", "gang_host_fallback"), len(tuples))
+            trace.record_span(
+                self.eval.id, trace.STAGE_GANG_SELECT, _t0,
+                ann={"members": len(tuples), "mode": config.mode,
+                     "host_fallback": True},
+                trace_id=self.eval.trace_id)
+            self._place_gang_host(tg, tuples)
+            return
+        breaker.record_success((time.monotonic() - _t_solve) * 1000.0)
+        choices = np.asarray(choices)
+        scores = np.asarray(scores)
+        slice_gid = int(np.asarray(slice_group))
+        trace.record_span(
+            self.eval.id, trace.STAGE_GANG_SELECT, _t0,
+            ann={"members": len(tuples), "mode": config.mode,
+                 "slice_group": slice_gid},
+            trace_id=self.eval.trace_id)
+
+        if int(choices[0]) < 0:
+            # Whole-gang reject on device (no slice fits all K, or a
+            # member found no node): ONE failure for the TG, with
+            # class eligibility from the feasibility mask so the
+            # blocked eval re-runs when capacity returns.
+            note_gang_result(False, len(tuples), "device")
+            m = AllocMetric()
+            m.nodes_evaluated = matrix.n_real
+            m.nodes_available = matrix.nodes_by_dc
+            tg_indices = {g.name: i
+                          for i, g in enumerate(self.job.task_groups)}
+            self._record_placement_failure(tuples[0], matrix, m,
+                                           tg_indices)
+            if len(tuples) > 1:
+                self.failed_tg_allocs[name].coalesced_failures += (
+                    len(tuples) - 1)
+            return
+
+        # Materialize: exact host-side port offers per member, staged
+        # on the gang leg. ANY member failing port assignment unwinds
+        # the whole gang to the host stack (exact ports there) — a
+        # partial gang never survives this loop.
+        gkey = gang_key(self.job.id, name)
+        net_indexes: Dict[str, NetworkIndex] = {}
+        committed: List[Tuple[int, int]] = []
+        for j, missing in enumerate(tuples):
+            choice = int(choices[j])
+            node = (matrix.nodes[choice]
+                    if 0 <= choice < matrix.n_real else None)
+            m = AllocMetric()
+            m.nodes_evaluated = matrix.n_real
+            m.nodes_available = matrix.nodes_by_dc
+            task_resources = None
+            if node is not None:
+                m.score_node(node, "gang", float(scores[j]))
+                task_resources = _offer_networks(
+                    self.rng, missing, node, net_indexes, matrix)
+            if task_resources is None:
+                self.plan.pop_gang(gkey)
+                _metrics.incr_counter(
+                    ("scheduler", "gang_port_fallback"), len(tuples))
+                self._place_gang_host(tg, tuples)
+                return
+            self.plan.append_gang_alloc(gkey, _build_allocation(
+                self, missing, node, task_resources, m))
+            committed.append((j, choice))
+        note_gang_result(True, len(tuples), "device")
+        from ..kernels import active_kernel
+
+        self._note_quality(
+            self.kernel or active_kernel(), matrix,
+            np.tile(np.asarray(ask_res)[None, :], (len(tuples), 1)),
+            committed)
 
     def _preempt_placements(self, pending: List[AllocTuple],
                             tg_indices: Dict[str, int]) -> None:

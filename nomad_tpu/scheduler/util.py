@@ -35,6 +35,7 @@ ALLOC_LOST = "alloc is lost since its node is down"
 ALLOC_IN_PLACE = "alloc updating in-place"
 ALLOC_NODE_TAINTED = "system alloc not needed as node is tainted"
 ALLOC_PREEMPTED = "alloc preempted by a higher-priority evaluation"
+ALLOC_GANG_REPLACED = "alloc stopped for whole-gang replacement"
 
 
 @dataclass
@@ -648,6 +649,16 @@ def cohort_reconcile(state, evals: List[Evaluation]) -> List[CohortMember]:
                 tg.ephemeral_disk is not None and tg.ephemeral_disk.sticky
                 for tg in m.job.task_groups):
             m.reason = "sticky ephemeral disk"
+        elif any(getattr(tg, "gang", None) is not None
+                 for tg in m.job.task_groups):
+            # Gang task groups (nomad_tpu/gang) carry all-or-nothing
+            # semantics the array materialize path does not reproduce
+            # (atomic gang-leg staging, pop_gang unwind, whole-gang
+            # replacement). The per-eval DENSE scheduler is their
+            # single source of truth; routing there keeps the gang ONE
+            # eval with K asks — one dispatch of the all-K program —
+            # never K batch rows.
+            m.reason = "gang task group"
         required = materialize_task_groups(m.job) if not m.reason else {}
         requireds.append(required)
         if m.reason:

@@ -158,6 +158,10 @@ class PlanApplier:
         self.plans_evaluated = 0
         self.plans_rejected = 0  # plans that lost >= 1 node (refresh)
         self.nodes_rejected = 0  # node verifications that failed
+        # Gang atomicity (nomad_tpu/gang): whole gangs removed because
+        # a member's node failed verification — every one of these is a
+        # proven nothing-partial-committed event.
+        self.gangs_rejected = 0
 
     def start(self) -> None:
         with self._lifecycle:
@@ -356,6 +360,7 @@ class PlanApplier:
         self.plans_evaluated += 1
         rejected = 0
         suspect = False
+        rejected_nodes = set()
         for node_id, fut in futures.items():
             if fut.result():
                 continue
@@ -365,7 +370,8 @@ class PlanApplier:
             if not self._ordinary_conflict(snapshot, plan, node_id):
                 suspect = True
             if plan.all_at_once:
-                # Gang commit: reject everything, force a refresh.
+                # Whole-plan gang commit: reject everything, force a
+                # refresh.
                 result.node_update = {}
                 result.node_allocation = {}
                 result.node_preemptions = {}
@@ -379,10 +385,59 @@ class PlanApplier:
                     ann={"nodes_rejected": rejected, "gang": True},
                     create=False)
                 return result
+            rejected_nodes.add(node_id)
+        # Gang atomicity leg (nomad_tpu/gang): which nodes host which
+        # gang's members — decided from the PLAN (gang_groups stages
+        # alloc ids), applied to the RESULT below. The chaos site
+        # models an applier-side under-fit on exactly one member node;
+        # the invariant under test is that the whole gang rejects.
+        gang_nodes: Dict[str, set] = {}
+        if plan.gang_groups:
+            id_to_gang = {aid: gk
+                          for gk, ids in plan.gang_groups.items()
+                          for aid in ids}
+            for node_id, placed in plan.node_allocation.items():
+                for alloc in placed:
+                    gk = id_to_gang.get(alloc.id)
+                    if gk is not None:
+                        gang_nodes.setdefault(gk, set()).add(node_id)
+            from ..chaos import chaos
+
+            if chaos.enabled and chaos.fire(
+                    "gang.partial_commit",
+                    eval_id=plan.eval_id) == "drop":
+                for gk in sorted(gang_nodes):
+                    nodes = sorted(gang_nodes[gk] - rejected_nodes)
+                    if nodes:
+                        rejected += 1
+                        rejected_nodes.add(nodes[0])
+                        break
+        for node_id in rejected_nodes:
             result.node_update.pop(node_id, None)
             result.node_allocation.pop(node_id, None)
             result.node_preemptions.pop(node_id, None)
             result.refresh_index = snapshot.latest_index()
+        # All-K-or-nothing: a gang with ANY member on a rejected node
+        # loses EVERY member — filtered off accepted nodes too.
+        # Removing allocs only frees capacity, so the surviving
+        # placements that verified alongside them still fit.
+        doomed = sorted(gk for gk, nodes in gang_nodes.items()
+                        if nodes & rejected_nodes)
+        for gk in doomed:
+            ids = set(plan.gang_groups.get(gk, ()))
+            for node_id in sorted(gang_nodes[gk] - rejected_nodes):
+                placed = result.node_allocation.get(node_id)
+                if not placed:
+                    continue
+                kept = [a for a in placed if a.id not in ids]
+                if kept:
+                    result.node_allocation[node_id] = kept
+                else:
+                    del result.node_allocation[node_id]
+            result.refresh_index = snapshot.latest_index()
+        if doomed:
+            self.gangs_rejected += len(doomed)
+            metrics.incr_counter(("plan", "gang_rejected"), len(doomed))
         if rejected:
             self.plans_rejected += 1
             self.nodes_rejected += rejected
@@ -391,10 +446,14 @@ class PlanApplier:
         # create=False: the applier serves remote (follower-worker)
         # plans too — their lifecycle trace lives in the follower's
         # process, not this one.
+        ann = None
+        if rejected or doomed:
+            ann = {"nodes_rejected": rejected}
+            if doomed:
+                ann["gangs_rejected"] = len(doomed)
         trace.record_span(
             plan.eval_id, trace.STAGE_PLAN_EVALUATE, _t0,
-            ann=({"nodes_rejected": rejected} if rejected else None),
-            create=False)
+            ann=ann, create=False)
         return result
 
     def stats(self) -> dict:
@@ -405,6 +464,7 @@ class PlanApplier:
             "plans_evaluated": self.plans_evaluated,
             "plans_rejected": self.plans_rejected,
             "nodes_rejected": self.nodes_rejected,
+            "gangs_rejected": self.gangs_rejected,
         }
 
     def _commit(self, plan: Plan, result: PlanResult) -> int:

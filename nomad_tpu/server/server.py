@@ -32,6 +32,7 @@ from .. import trace
 from . import fsm as fsm_msgs
 from .blocked import BlockedEvals
 from .broker import FAILED_QUEUE, EvalBroker
+from ..gang import gang_stats as _gang_stats
 from ..kernels.quality import get_board as _quality_board
 from ..migrate import churn_stats as _churn_stats
 from ..models.resident import device_state_stats as _device_state_stats
@@ -1420,6 +1421,10 @@ class Server:
             # waves/moves, gate skips (pressure/budget/stale), solve
             # cost split cold-vs-warm, and the compiled-program count.
             "defrag": self.defrag.stats(),
+            # Gang scheduling (nomad_tpu/gang): gangs placed/rejected
+            # per path; the applier-side whole-gang rejections live in
+            # plan_applier stats ("gangs_rejected").
+            "gang": _gang_stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

@@ -18,6 +18,7 @@ from .job import (
     Constraint,
     DispatchPayloadConfig,
     EphemeralDisk,
+    Gang,
     Job,
     JobSummary,
     LogConfig,
@@ -62,6 +63,7 @@ __all__ = [
     "Constraint",
     "DispatchPayloadConfig",
     "EphemeralDisk",
+    "Gang",
     "Job",
     "JobSummary",
     "LogConfig",

@@ -177,6 +177,59 @@ class Task:
         return errs
 
 
+GANG_TOPOLOGY_LEVELS = ("rack", "ici")
+
+
+@dataclass
+class Gang:
+    """Gang-scheduling stanza (nomad_tpu/gang): a task group carrying
+    one places its `count` members ATOMICALLY — all K or none, in one
+    plan leg the applier verifies per node and commits in one raft
+    apply. Topology policy (all levels name a node-meta key,
+    models/topology.py):
+
+    - ``slice``: hard contiguity — all K members land inside ONE
+      topology group of this level (one rack / one ICI neighborhood),
+      or the whole gang is unplaceable. Nodes missing the meta key can
+      never prove contiguity and are excluded.
+    - ``spread``: balance — members spread across groups of this
+      level, at most ceil(K / eligible groups) per group.
+    - ``affinity``: soft co-location — members prefer groups already
+      holding gang members, without requiring a single slice.
+
+    ``slice`` subsumes ``affinity`` and contradicts ``spread``;
+    validation enforces the exclusivity."""
+
+    slice: str = ""  # "" | "rack" | "ici"
+    affinity: str = ""  # "" | "rack" | "ici"
+    spread: str = ""  # "" | "rack" | "ici"
+
+    def copy(self) -> "Gang":
+        return Gang(self.slice, self.affinity, self.spread)
+
+    def validate(self) -> List[str]:
+        errs = []
+        for label, level in (("slice", self.slice),
+                             ("affinity", self.affinity),
+                             ("spread", self.spread)):
+            if level and level not in GANG_TOPOLOGY_LEVELS:
+                errs.append(
+                    f"gang {label} must be one of {GANG_TOPOLOGY_LEVELS},"
+                    f" got {level!r}")
+        if self.slice and self.spread:
+            errs.append("gang slice and spread are mutually exclusive")
+        if self.slice and self.affinity:
+            errs.append(
+                "gang affinity is redundant with slice (a slice is "
+                "already maximally co-located)")
+        if self.spread and self.affinity:
+            errs.append(
+                "gang spread and affinity are mutually exclusive "
+                "(spread caps a group's members, affinity piles them "
+                "in — pick one policy)")
+        return errs
+
+
 @dataclass
 class TaskGroup:
     name: str = ""
@@ -186,6 +239,9 @@ class TaskGroup:
     tasks: List[Task] = field(default_factory=list)
     ephemeral_disk: Optional[EphemeralDisk] = None
     meta: Dict[str, str] = field(default_factory=dict)
+    # All-or-nothing multi-node placement (nomad_tpu/gang). None =
+    # ordinary independent placement.
+    gang: Optional[Gang] = None
 
     def copy(self) -> "TaskGroup":
         return copy.deepcopy(self)
@@ -225,6 +281,8 @@ class TaskGroup:
             errs.extend(t.validate())
         for c in self.constraints:
             errs.extend(c.validate())
+        if self.gang is not None:
+            errs.extend(self.gang.validate())
         return errs
 
 
@@ -374,6 +432,10 @@ class Job:
         if self.type == consts.JOB_TYPE_SYSTEM:
             if self.periodic and self.periodic.enabled:
                 errs.append("periodic is not allowed on system jobs")
+            if any(tg.gang is not None for tg in self.task_groups):
+                errs.append(
+                    "gang is not allowed on system jobs (system "
+                    "placements are pinned per node, never gangs)")
         if self.periodic:
             errs.extend(self.periodic.validate())
         return errs

@@ -34,6 +34,15 @@ class Plan:
     # changed underneath the scheduler rejects the node and forces a
     # replan, exactly like a placement that no longer fits.
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # Gang atomicity leg (nomad_tpu/gang): gang key ("job/<tg>") ->
+    # alloc ids of the gang's members in node_allocation. The plan
+    # applier treats each group as ALL-OR-NOTHING across nodes: any
+    # member's node failing verification removes every member of that
+    # gang from the result (on accepted nodes too) — partial-commit
+    # granularity stays per node for ordinary placements and becomes
+    # per GANG for these. All members still commit in the one raft
+    # apply the accepted plan rides.
+    gang_groups: Dict[str, List[str]] = field(default_factory=dict)
     annotations: Optional["PlanAnnotations"] = None
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
     # Raft watermark of the snapshot the dense node matrix serving this
@@ -68,6 +77,31 @@ class Plan:
 
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_gang_alloc(self, gang_key: str, alloc: Allocation) -> None:
+        """Stage one gang member: an ordinary placement PLUS membership
+        in the gang's atomicity group (see gang_groups)."""
+        self.append_alloc(alloc)
+        self.gang_groups.setdefault(gang_key, []).append(alloc.id)
+
+    def pop_gang(self, gang_key: str) -> int:
+        """Unstage every placement of one gang (the scheduler backs a
+        gang out when a member's host-side port assignment fails — an
+        incomplete gang must never reach the applier). Returns the
+        number of members removed."""
+        ids = set(self.gang_groups.pop(gang_key, ()))
+        if not ids:
+            return 0
+        removed = 0
+        for node_id in list(self.node_allocation):
+            kept = [a for a in self.node_allocation[node_id]
+                    if a.id not in ids]
+            removed += len(self.node_allocation[node_id]) - len(kept)
+            if kept:
+                self.node_allocation[node_id] = kept
+            else:
+                del self.node_allocation[node_id]
+        return removed
 
     def append_preemption(
         self, alloc: Allocation, desired_status: str, description: str

@@ -26,6 +26,7 @@ from .span import (  # noqa: F401
     STAGE_DEVICE_TRANSFER,
     STAGE_DISPATCH_ACCUMULATE,
     STAGE_DISPATCH_LAUNCH,
+    STAGE_GANG_SELECT,
     STAGE_MATRIX_BUILD,
     STAGE_MATRIX_UPDATE,
     STAGE_MIGRATE_PLACE,

@@ -37,6 +37,10 @@ STAGE_MIGRATE_PLACE = "migrate.place"      # drain-displaced allocs staged
 #   claimed this wave, deferred to the follow-up eval)
 STAGE_PREEMPT_SELECT = "preempt.select"    # dense victim-selection +
 #   placement pass (ops/preempt.py; ann: asks, candidate victims)
+STAGE_GANG_SELECT = "gang.select"          # all-K gang slice selection
+#   + member assignment (ops/gang.py; ann: members, mode,
+#   slice group, host_fallback) — one span per gang dispatch
+#   (nomad_tpu/gang)
 STAGE_DEFRAG_SOLVE = "defrag.solve"        # one defrag-loop round's
 #   warm-started global relaxation solve + move extraction
 #   (nomad_tpu/defrag; ann: movable, moves, gain, warm, solve_ms) —
@@ -58,6 +62,7 @@ ALL_STAGES = (
     STAGE_DEVICE_SOLVE,
     STAGE_MIGRATE_PLACE,
     STAGE_PREEMPT_SELECT,
+    STAGE_GANG_SELECT,
     STAGE_DEFRAG_SOLVE,
     STAGE_PLAN_SUBMIT,
     STAGE_PLAN_EVALUATE,

@@ -1,0 +1,1070 @@
+"""Gang scheduling + topology-aware placement (nomad_tpu/gang).
+
+The contract under test, end to end:
+
+- a task group with a ``gang`` stanza places its ``count`` members
+  ATOMICALLY — all K commit in one raft apply or nothing commits: the
+  device program's all-K enforcement, the plan's gang leg, and the
+  applier's whole-gang rejection each independently make a partial
+  gang unrepresentable;
+- ``slice`` gangs land inside ONE topology group (the tightest
+  sufficient one), ``spread`` gangs respect the per-group cap,
+  ``affinity`` co-locates softly — on the dense device program AND
+  the host iterator path, with parity on hand-built topologies;
+- losing any member replaces the WHOLE gang (survivors stopped, all K
+  re-placed), a gang that cannot place blocks as ONE eval and
+  unblocks when capacity arrives, the executive routes gang evals to
+  the per-eval scheduler (one cohort row with K asks, never K rows),
+  and the gang leg joins the placement path's jit-cache accounting
+  (steady-state recompiles 0);
+- chaos sites ``gang.partial_commit`` / ``gang.member_lost`` are
+  registered, deterministic, documented, and drive the invariants
+  above, and the 8-seed oracle differential sweep
+  (``judge_gang_plan``) is green.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.chaos import FaultSpec, chaos
+from nomad_tpu.gang import (
+    build_gang_state,
+    gang_key,
+    gang_stats,
+    reset_gang_stats,
+    spread_cap,
+)
+from nomad_tpu.models.topology import (
+    TOPO_GROUP_BUCKETS,
+    TopologyIndex,
+    topo_group_pad,
+)
+from nomad_tpu.ops.gang import (
+    GANG_MODE_AFFINITY,
+    GANG_MODE_FREE,
+    GANG_MODE_SLICE,
+    GANG_MODE_SPREAD,
+    GangConfig,
+    gang_placement_program_jit,
+    make_gang_state,
+)
+from nomad_tpu.scheduler.testing import Harness, seed_harness_cluster
+from nomad_tpu.structs import Gang, Job, Plan, consts
+from nomad_tpu.structs.eval import new_eval
+from nomad_tpu.utils.codec import decode, encode
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    reset_gang_stats()
+    yield
+    chaos.disarm()
+    reset_gang_stats()
+    from nomad_tpu.admission import get_breaker
+
+    b = get_breaker()
+    b.reset()
+    b.configure_defaults()
+
+
+# ---------------------------------------------------------------------
+# fixtures: a rack topology cluster + a gang job
+
+
+def topo_nodes(n=12, rack_size=4, cpu=3000, mem=3000, bare=0):
+    """n nodes in racks of rack_size with ICI pairs inside each rack;
+    the last `bare` nodes carry NO topology meta."""
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.resources.cpu = cpu
+        node.resources.memory_mb = mem
+        if i < n - bare:
+            node.meta["rack"] = f"r{i // rack_size}"
+            node.meta["ici"] = f"r{i // rack_size}-i{(i % rack_size) // 2}"
+        node.compute_class()
+        nodes.append(node)
+    return nodes
+
+
+def gang_job(k=4, cpu=400, mem=256, slice="", affinity="", spread="",
+             jid="gang-job"):
+    job = mock.job()
+    job.id = jid
+    tg = job.task_groups[0]
+    tg.count = k
+    tg.gang = Gang(slice=slice, affinity=affinity, spread=spread)
+    t = tg.tasks[0]
+    t.resources.cpu = cpu
+    t.resources.memory_mb = mem
+    t.resources.networks = []
+    return job
+
+
+def seeded_harness(nodes, job, seed=7):
+    h = Harness(seed=seed)
+    seed_harness_cluster(h, nodes=nodes, jobs=[job.copy()])
+    return h
+
+
+def live_members(h, job):
+    return [a for a in h.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+
+
+def member_racks(h, job, nodes):
+    by_id = {n.id: n for n in nodes}
+    return [by_id[a.node_id].meta.get("rack")
+            for a in live_members(h, job)]
+
+
+# ---------------------------------------------------------------------
+# stanza: parse, validate, wire
+
+
+def test_gang_stanza_parses_from_hcl():
+    from nomad_tpu.jobspec import parse
+
+    job = parse("""
+job "dl" {
+  datacenters = ["dc1"]
+  group "trainers" {
+    count = 8
+    gang { slice = "rack" }
+    task "train" {
+      driver = "exec"
+      config { command = "/bin/train" }
+      resources { cpu = 500\n memory = 256 }
+    }
+  }
+}
+""")
+    g = job.task_groups[0].gang
+    assert g is not None and g.slice == "rack"
+    assert g.spread == "" and g.affinity == ""
+
+
+def test_gang_validation_exclusivity_and_levels():
+    job = gang_job(slice="rack")
+    job.task_groups[0].gang.spread = "rack"
+    assert any("mutually exclusive" in e for e in job.validate())
+    job2 = gang_job(slice="rack", affinity="ici")
+    assert any("redundant" in e for e in job2.validate())
+    job3 = gang_job(spread="pod")
+    assert any("must be one of" in e for e in job3.validate())
+    job4 = gang_job(spread="rack", affinity="ici")
+    assert any("spread and affinity" in e for e in job4.validate())
+    ok = gang_job(slice="ici")
+    assert ok.validate() == []
+
+
+def test_gang_forbidden_on_system_jobs():
+    job = gang_job(slice="rack")
+    job.type = consts.JOB_TYPE_SYSTEM
+    assert any("system jobs" in e for e in job.validate())
+
+
+def test_gang_wire_round_trip():
+    job = gang_job(k=6, slice="rack")
+    back = decode(Job, encode(job))
+    assert back.task_groups[0].gang == Gang(slice="rack")
+    plain = mock.job()
+    assert decode(Job, encode(plain)).task_groups[0].gang is None
+
+
+# ---------------------------------------------------------------------
+# node-topology tensor
+
+
+def test_topology_index_interns_levels_and_pads():
+    nodes = topo_nodes(n=6, rack_size=2, bare=2)
+    idx = TopologyIndex(nodes, n_pad=8)
+    rack = idx.column("rack")
+    assert rack.shape == (8,)
+    # racks of 2: nodes 0-1 -> group 0, 2-3 -> group 1
+    assert list(rack[:4]) == [0, 0, 1, 1]
+    # bare nodes and padding rows carry -1
+    assert list(rack[4:]) == [-1, -1, -1, -1]
+    assert idx.counts["rack"] == 2
+    assert idx.group_name("rack", 0) == "r0"
+    assert idx.counts["ici"] == 2  # one pair per 2-rack
+
+
+def test_topology_singleton_column_for_spread():
+    nodes = topo_nodes(n=4, rack_size=2, bare=2)
+    idx = TopologyIndex(nodes, n_pad=6)
+    col, count = idx.singleton_column("rack")
+    # 1 real rack group + 2 bare singletons
+    assert count == 3
+    assert col[0] == col[1] == 0
+    assert col[2] != col[3] and col[2] >= 1 and col[3] >= 1
+    assert list(col[4:]) == [-1, -1]  # padding stays excluded
+
+
+def test_topology_rides_the_cluster_base_and_matrix():
+    from nomad_tpu.models.matrix import ClusterMatrix, resolve_cluster_base
+
+    nodes = topo_nodes(n=4)
+    job = gang_job(slice="rack")
+    h = seeded_harness(nodes, job)
+    snap = h.state.snapshot()
+    base, _kind = resolve_cluster_base(snap, ["dc1"])
+    assert base.topology.counts["rack"] == 1
+    matrix = ClusterMatrix(snap, job, Plan(job=job))
+    # the matrix SHARES the base's tensor (by-reference contract:
+    # delta clones and every per-job matrix read one interned copy)
+    assert matrix.topology is base.topology
+
+
+def test_topo_group_pad_buckets():
+    assert topo_group_pad(1) == TOPO_GROUP_BUCKETS[0]
+    assert topo_group_pad(17) == TOPO_GROUP_BUCKETS[1]
+    assert topo_group_pad(999) == TOPO_GROUP_BUCKETS[3]
+
+
+# ---------------------------------------------------------------------
+# plan gang leg
+
+
+def test_plan_gang_leg_append_and_pop():
+    job = mock.job()
+    plan = Plan(job=job)
+    allocs = []
+    for i in range(3):
+        a = mock.alloc()
+        a.node_id = f"n{i % 2}"
+        allocs.append(a)
+        plan.append_gang_alloc("j/web", a)
+    assert set(plan.gang_groups["j/web"]) == {a.id for a in allocs}
+    assert sum(len(v) for v in plan.node_allocation.values()) == 3
+    removed = plan.pop_gang("j/web")
+    assert removed == 3
+    assert plan.node_allocation == {} and "j/web" not in plan.gang_groups
+    assert plan.pop_gang("j/web") == 0
+
+
+# ---------------------------------------------------------------------
+# device program units (hand-built GangState)
+
+
+def _hand_state(caps, racks, used=None, feas=None):
+    """GangState over len(caps) nodes: caps[i] = (cpu, mem) free
+    capacity, racks[i] = topo group id (-1 = none)."""
+    n = len(caps)
+    capacity = np.zeros((n, 4), np.float32)
+    capacity[:, 0] = [c[0] for c in caps]
+    capacity[:, 1] = [c[1] for c in caps]
+    capacity[:, 2] = 100_000
+    capacity[:, 3] = 10_000
+    util = np.zeros((n, 4), np.float32)
+    if used:
+        util[:, 0] = [u[0] for u in used]
+        util[:, 1] = [u[1] for u in used]
+    return make_gang_state(
+        capacity=capacity, sched_capacity=capacity, util=util,
+        bw_avail=np.full(n, 1e9), bw_used=np.zeros(n),
+        ports_free=np.full(n, 100),
+        feas_row=np.ones(n, bool) if feas is None else feas,
+        job_count=np.zeros(n, np.int32),
+        dh_presence=np.zeros(n, np.int32),
+        topo_ids=np.asarray(racks, np.int32))
+
+
+def _run_program(state, k, config, cpu=400, mem=256, seed=3):
+    from nomad_tpu.ops.binpack import host_prng_key
+
+    active = np.zeros(8, bool)
+    active[:k] = True
+    ask = np.asarray([cpu, mem, 0, 0], np.float32)
+    choices, scores, grp = gang_placement_program_jit(
+        state, ask, np.float32(0), np.float32(0), active,
+        host_prng_key(seed), config)
+    return np.asarray(choices), np.asarray(scores), int(np.asarray(grp))
+
+
+def test_device_slice_picks_tightest_sufficient_group():
+    # rack 0: 2 nodes x 1 member; rack 1: 2 nodes x 2 members (tight
+    # for k=4); rack 2: 2 nodes x 5 members (roomy). k=4 must land
+    # ENTIRELY in rack 1 — consume the fragment that fits.
+    state = _hand_state(
+        caps=[(450, 300), (450, 300),
+              (900, 600), (900, 600),
+              (2200, 1500), (2200, 1500)],
+        racks=[0, 0, 1, 1, 2, 2])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_SLICE,
+                     g_pad=16)
+    choices, _s, grp = _run_program(state, k=4, config=cfg)
+    assert grp == 1
+    assert set(choices[:4]) == {2, 3}
+    assert all(c == -1 for c in choices[4:])  # padding members
+
+
+def test_device_all_k_or_nothing():
+    # Total capacity across racks covers k=4 but NO single rack does,
+    # and the whole cluster only holds 3 members anyway at these asks.
+    state = _hand_state(
+        caps=[(450, 300), (450, 300), (450, 300)],
+        racks=[0, 0, 1])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_FREE,
+                     g_pad=16)
+    choices, scores, grp = _run_program(state, k=4, config=cfg)
+    assert all(c == -1 for c in choices)
+    assert grp == -1
+    assert np.all(np.asarray(scores) == 0.0)
+
+
+def test_device_slice_requires_single_group():
+    # Two racks, each fits 2 members; k=4 fits nowhere contiguously.
+    state = _hand_state(
+        caps=[(900, 600), (900, 600)], racks=[0, 1])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_SLICE,
+                     g_pad=16)
+    choices, _s, grp = _run_program(state, k=4, config=cfg)
+    assert all(c == -1 for c in choices) and grp == -1
+    # free mode places the same gang: atomicity without contiguity
+    cfg_free = GangConfig(anti_affinity_penalty=0.0,
+                          mode=GANG_MODE_FREE, g_pad=16)
+    choices, _s, _g = _run_program(state, k=4, config=cfg_free)
+    assert all(c >= 0 for c in choices[:4])
+
+
+def test_device_slice_excludes_topologyless_nodes():
+    # The only node big enough for all of k=2 has no topology id.
+    state = _hand_state(
+        caps=[(450, 300), (5000, 5000)], racks=[0, -1])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_SLICE,
+                     g_pad=16)
+    choices, _s, _g = _run_program(state, k=2, config=cfg)
+    assert all(c == -1 for c in choices)
+
+
+def test_device_spread_caps_members_per_group():
+    # 4 groups of one roomy node each; k=4 -> cap ceil(4/4)=1 per
+    # group: every member on a DIFFERENT group.
+    state = _hand_state(
+        caps=[(5000, 5000)] * 4, racks=[0, 1, 2, 3])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_SPREAD,
+                     g_pad=16)
+    choices, _s, _g = _run_program(state, k=4, config=cfg)
+    assert sorted(choices[:4]) == [0, 1, 2, 3]
+    assert spread_cap(4, 4) == 1
+
+
+def test_device_distinct_hosts_one_member_per_node():
+    state = _hand_state(
+        caps=[(5000, 5000)] * 4, racks=[0, 0, 0, 0])
+    cfg = GangConfig(anti_affinity_penalty=0.0, mode=GANG_MODE_FREE,
+                     distinct_hosts=True, g_pad=16)
+    choices, _s, _g = _run_program(state, k=4, config=cfg)
+    assert sorted(choices[:4]) == [0, 1, 2, 3]
+    # k=5 over 4 nodes under distinct-hosts: whole-gang reject
+    choices, _s, _g = _run_program(state, k=5, config=cfg)
+    assert all(c == -1 for c in choices)
+
+
+def test_device_affinity_co_locates():
+    # Two equal racks; affinity should pull all members into ONE of
+    # them even though both fit (the bonus steers ties).
+    state = _hand_state(
+        caps=[(2000, 2000)] * 4, racks=[0, 0, 1, 1])
+    cfg = GangConfig(anti_affinity_penalty=0.0,
+                     mode=GANG_MODE_AFFINITY, g_pad=16)
+    choices, _s, _g = _run_program(state, k=4, config=cfg)
+    racks = [0 if c in (0, 1) else 1 for c in choices[:4]]
+    assert len(set(racks)) == 1
+
+
+# ---------------------------------------------------------------------
+# e2e through the harness: host and dense paths, atomic staging
+
+
+@pytest.mark.parametrize("factory", ["service", "service-tpu"])
+def test_gang_places_all_k_atomically(factory):
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process(factory, new_eval(h.state.job_by_id(job.id),
+                                consts.EVAL_TRIGGER_JOB_REGISTER))
+    live = live_members(h, job)
+    assert len(live) == 4
+    racks = member_racks(h, job, nodes)
+    assert len(set(racks)) == 1 and racks[0] is not None
+    # the committed plan carried the gang leg naming every member
+    (plan,) = [p for p in h.plans if p.node_allocation]
+    assert set(plan.gang_groups[gang_key(job.id, "web")]) == {
+        a.id for a in live}
+    path = "host" if factory == "service" else "device"
+    assert gang_stats().get(f"path_{path}", 0) >= 1
+
+
+@pytest.mark.parametrize("factory", ["service", "service-tpu"])
+def test_gang_rejects_whole_when_no_slice_fits(factory):
+    # k=9 members of 1/3-node size: every rack of 4 holds at most 8.
+    nodes = topo_nodes(n=12, rack_size=4, cpu=3000, mem=3000)
+    job = gang_job(k=9, cpu=1000, mem=1000, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process(factory, new_eval(h.state.job_by_id(job.id),
+                                consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert live_members(h, job) == []
+    assert h.plans == []  # nothing staged, nothing submitted
+    # ONE whole-gang failure for the TG -> a blocked eval carrying
+    # class eligibility (the blocked-eval machinery's input)
+    (blocked,) = h.create_evals
+    assert blocked.status == consts.EVAL_STATUS_BLOCKED
+    assert gang_stats().get("gangs_rejected", 0) >= 1
+    # free mode places the same 9 across racks
+    job2 = gang_job(k=9, cpu=1000, mem=1000, jid="free-gang")
+    h2 = seeded_harness(nodes, job2)
+    h2.process(factory, new_eval(h2.state.job_by_id(job2.id),
+                                 consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert len(live_members(h2, job2)) == 9
+
+
+@pytest.mark.parametrize("factory", ["service", "service-tpu"])
+def test_gang_spread_parity(factory):
+    # 3 racks x 4 roomy nodes, k=6 -> cap ceil(6/3)=2 per rack on
+    # BOTH paths.
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=6, spread="rack")
+    h = seeded_harness(nodes, job)
+    h.process(factory, new_eval(h.state.job_by_id(job.id),
+                                consts.EVAL_TRIGGER_JOB_REGISTER))
+    racks = member_racks(h, job, nodes)
+    assert len(racks) == 6
+    counts = {r: racks.count(r) for r in set(racks)}
+    assert max(counts.values()) <= spread_cap(6, 3)
+
+
+@pytest.mark.parametrize("factory", ["service", "service-tpu"])
+def test_gang_affinity_parity(factory):
+    nodes = topo_nodes(n=8, rack_size=4)
+    job = gang_job(k=3, affinity="rack")
+    h = seeded_harness(nodes, job)
+    h.process(factory, new_eval(h.state.job_by_id(job.id),
+                                consts.EVAL_TRIGGER_JOB_REGISTER))
+    racks = member_racks(h, job, nodes)
+    assert len(racks) == 3 and len(set(racks)) == 1
+
+
+def test_gang_distinct_hosts_dense_vs_host_parity():
+    from nomad_tpu.structs import Constraint
+
+    nodes = topo_nodes(n=8, rack_size=4)
+    for factory in ("service", "service-tpu"):
+        job = gang_job(k=4, slice="rack", jid=f"dh-{factory}")
+        job.task_groups[0].constraints.append(
+            Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS))
+        h = seeded_harness(nodes, job)
+        h.process(factory, new_eval(h.state.job_by_id(job.id),
+                                    consts.EVAL_TRIGGER_JOB_REGISTER))
+        live = live_members(h, job)
+        assert len(live) == 4
+        assert len({a.node_id for a in live}) == 4  # one per host
+        assert len(set(member_racks(h, job, nodes))) == 1
+
+
+# ---------------------------------------------------------------------
+# whole-gang replacement
+
+
+def test_node_down_replaces_whole_gang():
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = live_members(h, job)
+    assert len(first) == 4
+    # kill one member's node
+    downed = first[0].node_id
+    node = h.state.node_by_id(downed)
+    node.status = consts.NODE_STATUS_DOWN
+    h.state.upsert_node(h.next_index(), node)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_NODE_UPDATE))
+    live = live_members(h, job)
+    assert len(live) == 4
+    # every replacement is NEW (the whole gang moved, not just the
+    # lost member) and none landed on the dead node
+    assert {a.id for a in live}.isdisjoint({a.id for a in first})
+    assert downed not in {a.node_id for a in live}
+    assert len(set(member_racks(h, job, nodes))) == 1
+    # survivors carry stop terminals; the lost member a client LOST
+    stopped = [h.state.alloc_by_id(a.id) for a in first]
+    assert all(s.desired_status == consts.ALLOC_DESIRED_STOP
+               for s in stopped)
+    assert any(s.client_status == consts.ALLOC_CLIENT_LOST
+               for s in stopped)
+
+
+def test_gang_member_lost_chaos_replaces_whole_gang():
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = live_members(h, job)
+    assert len(first) == 4
+    with chaos.armed(42, [FaultSpec("gang.member_lost", "drop",
+                                    prob=1.0, count=1)]):
+        h.process("service-tpu",
+                  new_eval(h.state.job_by_id(job.id),
+                           consts.EVAL_TRIGGER_NODE_UPDATE))
+        assert any(s == "gang.member_lost"
+                   for s, _n, _k, _d in chaos.firing_log())
+    live = live_members(h, job)
+    assert len(live) == 4
+    assert {a.id for a in live}.isdisjoint({a.id for a in first})
+
+
+def test_mixed_inplace_destructive_update_replaces_whole_gang():
+    """An update that is in-place compatible for most members but
+    destructive for one (a tightened constraint failing on one
+    member's node) must NOT split the gang: every member routes
+    destructive and the whole gang re-places atomically off the bad
+    node — the review finding where in-place-routed members escaped
+    _promote_gang_replacements."""
+    from nomad_tpu.structs import Constraint
+
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = live_members(h, job)
+    assert len(first) == 4
+    # meta.keep=yes everywhere EXCEPT one member's node
+    bad_node = first[0].node_id
+    for node in nodes:
+        node.meta["keep"] = "no" if node.id == bad_node else "yes"
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+    # env tweak (in-place compatible) + tightened constraint (fails
+    # the in-place re-select on bad_node only -> the mixed verdict)
+    updated = h.state.job_by_id(job.id).copy()
+    updated.task_groups[0].tasks[0].env["PHASE"] = "2"
+    updated.constraints.append(Constraint(
+        ltarget="${meta.keep}", rtarget="yes", operand="="))
+    updated.job_modify_index += 1
+    updated.modify_index += 1
+    h.state.upsert_job(h.next_index(), updated)
+    h.process("service-tpu", new_eval(
+        h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    live = live_members(h, job)
+    assert len(live) == 4
+    assert bad_node not in {a.node_id for a in live}
+    assert len(set(member_racks(h, job, nodes))) == 1
+    # the WHOLE gang moved: no survivor kept its old alloc, and the
+    # committed plan's gang leg names all four
+    assert {a.id for a in live}.isdisjoint({a.id for a in first})
+    final = [p for p in h.plans if p.gang_groups][-1]
+    assert set(final.gang_groups[gang_key(job.id, "web")]) == {
+        a.id for a in live}
+
+
+def test_pure_env_tweak_keeps_gang_in_place():
+    """The zero-churn contract survives the all-or-nothing routing: a
+    pure env tweak updates every member IN PLACE — same alloc ids, no
+    gang re-placement."""
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = {a.id for a in live_members(h, job)}
+    updated = h.state.job_by_id(job.id).copy()
+    updated.task_groups[0].tasks[0].env["PHASE"] = "3"
+    updated.job_modify_index += 1
+    updated.modify_index += 1
+    h.state.upsert_job(h.next_index(), updated)
+    h.process("service-tpu", new_eval(
+        h.state.job_by_id(job.id), consts.EVAL_TRIGGER_JOB_REGISTER))
+    assert {a.id for a in live_members(h, job)} == first
+
+
+def test_untouched_gang_is_not_churned():
+    nodes = topo_nodes(n=12, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    first = {a.id for a in live_members(h, job)}
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_NODE_UPDATE))
+    assert {a.id for a in live_members(h, job)} == first
+
+
+# ---------------------------------------------------------------------
+# the REAL applier: all-K-or-nothing across nodes
+
+
+def _applier_world(n_nodes=3, cpu=1000):
+    from nomad_tpu.server.fsm import FSM, DevLog
+
+    fsm = FSM()
+    log = DevLog(fsm)
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.resources.cpu = cpu
+        node.meta["rack"] = "r0"
+        node.compute_class()
+        log.apply("node_register", {"node": node})
+        nodes.append(node)
+    return fsm, log, nodes
+
+
+def _run_real_applier(fsm, log, plans):
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    applier = PlanApplier(queue, fsm, log, pool_size=2)
+    applier.start()
+    pendings = [queue.enqueue(p) for p in plans]
+    results = [p.wait(timeout=20.0) for p in pendings]
+    stats = applier.stats()
+    applier.stop()
+    return results, stats
+
+
+def _gang_plan(job, placements, gang_tg="web"):
+    """placements: [(node, cpu, is_gang_member)]"""
+    from nomad_tpu.structs import Allocation
+    from nomad_tpu.utils.ids import generate_uuid
+
+    plan = Plan(job=job)
+    key = gang_key(job.id, gang_tg)
+    for node, cpu, in_gang in placements:
+        alloc = Allocation(
+            id=generate_uuid(), job_id=job.id, job=job, node_id=node.id,
+            task_group=gang_tg,
+            desired_status=consts.ALLOC_DESIRED_RUN)
+        alloc.task_resources = {
+            "web": mock.job().task_groups[0].tasks[0].resources.copy()}
+        alloc.task_resources["web"].cpu = cpu
+        alloc.task_resources["web"].networks = []
+        if in_gang:
+            plan.append_gang_alloc(key, alloc)
+        else:
+            plan.append_alloc(alloc)
+    return plan
+
+
+def test_applier_rejects_whole_gang_on_one_member_underfit():
+    fsm, log, nodes = _applier_world(n_nodes=3, cpu=1000)
+    job = mock.job()
+    # member on n0 fits, member on n1 over-fits, bystander on n2 fits
+    plan = _gang_plan(job, [(nodes[0], 100, True),
+                            (nodes[1], 10_000, True),
+                            (nodes[2], 100, False)])
+    (result,), stats = _run_real_applier(fsm, log, [plan])
+    # the fitting member was filtered off its ACCEPTED node too
+    assert nodes[0].id not in result.node_allocation
+    assert nodes[1].id not in result.node_allocation
+    # the independent bystander placement survived and committed
+    assert len(result.node_allocation[nodes[2].id]) == 1
+    assert stats["gangs_rejected"] == 1
+    # the store holds ZERO gang members (nothing partial committed)
+    stored = [a for a in fsm.state.allocs_by_job(job.id)
+              if a.task_group == "web"
+              and a.node_id in (nodes[0].id, nodes[1].id)]
+    assert stored == []
+    assert result.refresh_index > 0  # the scheduler replans
+
+
+def test_applier_commits_whole_gang_when_all_fit():
+    fsm, log, nodes = _applier_world(n_nodes=2, cpu=1000)
+    job = mock.job()
+    plan = _gang_plan(job, [(nodes[0], 100, True),
+                            (nodes[1], 100, True)])
+    (result,), stats = _run_real_applier(fsm, log, [plan])
+    assert sum(len(v) for v in result.node_allocation.values()) == 2
+    assert stats["gangs_rejected"] == 0
+    live = [a for a in fsm.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+    assert len(live) == 2  # all K in the ONE raft apply
+
+
+def test_gang_partial_commit_chaos_rejects_whole_gang():
+    """The chaos site models an applier-side under-fit on one member
+    node AFTER per-node verification passed: the invariant is that
+    the whole gang still rejects and nothing partial commits."""
+    fsm, log, nodes = _applier_world(n_nodes=2, cpu=1000)
+    job = mock.job()
+    plan = _gang_plan(job, [(nodes[0], 100, True),
+                            (nodes[1], 100, True)])
+    with chaos.armed(99, [FaultSpec("gang.partial_commit", "drop",
+                                    prob=1.0, count=1)]):
+        (result,), stats = _run_real_applier(fsm, log, [plan])
+        fired = [s for s, _n, _k, _d in chaos.firing_log()]
+    assert "gang.partial_commit" in fired
+    assert stats["gangs_rejected"] == 1
+    # NOTHING from the gang committed — not the "good" member either
+    live = [a for a in fsm.state.allocs_by_job(job.id)
+            if not a.terminal_status()]
+    assert live == []
+    assert result.refresh_index > 0
+
+
+def test_gang_partial_commit_soak_zero_partials():
+    """Seeded probabilistic soak: many two-member gangs through the
+    real applier with gang.partial_commit armed at p=0.5 — every
+    surviving gang is complete, every rejected gang left ZERO members,
+    exactly-once either way."""
+    fsm, log, nodes = _applier_world(n_nodes=2, cpu=100_000)
+    rejected_total = 0
+    with chaos.armed(1234, [FaultSpec("gang.partial_commit", "drop",
+                                      prob=0.5)]):
+        for i in range(12):
+            job = mock.job()
+            job.id = f"soak-{i}"
+            plan = _gang_plan(job, [(nodes[0], 10, True),
+                                    (nodes[1], 10, True)])
+            (_result,), stats = _run_real_applier(fsm, log, [plan])
+            live = [a for a in fsm.state.allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            assert len(live) in (0, 2), (i, len(live))
+            rejected_total += stats["gangs_rejected"]
+    assert 0 < rejected_total < 12  # the site actually fired AND spared
+
+
+# ---------------------------------------------------------------------
+# chaos registry: determinism + docs
+
+
+def test_gang_sites_registered_and_deterministic():
+    from nomad_tpu.chaos.registry import KNOWN_SITES
+
+    assert "gang.partial_commit" in KNOWN_SITES
+    assert "gang.member_lost" in KNOWN_SITES
+
+    schedule = [FaultSpec("gang.partial_commit", "drop", prob=0.5),
+                FaultSpec("gang.member_lost", "drop", prob=0.4)]
+
+    def drive():
+        for i in range(25):
+            chaos.fire("gang.partial_commit", eval_id=f"e{i}")
+            chaos.fire("gang.member_lost", eval_id=f"e{i}")
+        return chaos.firing_log()
+
+    with chaos.armed(2027, schedule):
+        log1 = drive()
+    with chaos.armed(2027, [
+            FaultSpec("gang.partial_commit", "drop", prob=0.5),
+            FaultSpec("gang.member_lost", "drop", prob=0.4)]):
+        log2 = drive()
+    assert log1 and log1 == log2
+    assert {s for s, _n, _k, _d in log1} == {"gang.partial_commit",
+                                             "gang.member_lost"}
+
+
+def test_gang_sites_documented_in_failure_model_table():
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    for site in ("gang.partial_commit", "gang.member_lost"):
+        assert f"`{site}`" in readme, site
+
+
+def test_gang_select_stage_registered_and_documented():
+    """gang.select is a first-class lifecycle stage: in ALL_STAGES and
+    both stage tables (README + trace/README.md) — doc drift guard,
+    same shape as the churn-stage check."""
+    from nomad_tpu.trace import ALL_STAGES, STAGE_GANG_SELECT
+
+    assert STAGE_GANG_SELECT in ALL_STAGES
+    root = os.path.join(os.path.dirname(__file__), "..")
+    for rel in ("README.md", os.path.join("nomad_tpu", "trace",
+                                          "README.md")):
+        assert STAGE_GANG_SELECT in open(os.path.join(root, rel)).read()
+
+
+# ---------------------------------------------------------------------
+# blocked-gang unblock on capacity (live server)
+
+
+def test_blocked_gang_unblocks_and_places_when_capacity_arrives():
+    import time as _time
+
+    from nomad_tpu.server import Server, ServerConfig
+
+    server = Server(ServerConfig(
+        num_schedulers=2,
+        scheduler_factories={"service": "service-tpu"},
+        eval_nack_timeout=5.0))
+    server.start()
+    try:
+        # one undersized rack: the k=4 gang cannot place
+        for node in topo_nodes(n=2, rack_size=4, cpu=500, mem=500):
+            server.node_register(node)
+        job = gang_job(k=4, cpu=400, mem=256, slice="rack")
+        server.job_register(job)
+        state = server.fsm.state
+
+        def blocked():
+            # the triggering eval completes; the placement failure
+            # mints a NEW blocked eval for the job
+            return any(e.job_id == job.id
+                       and e.status == consts.EVAL_STATUS_BLOCKED
+                       for e in state.evals())
+
+        deadline = _time.monotonic() + 60.0
+        while _time.monotonic() < deadline and not blocked():
+            _time.sleep(0.02)
+        assert blocked(), [(e.job_id, e.status) for e in state.evals()]
+        assert [a for a in state.allocs_by_job(job.id)
+                if not a.terminal_status()] == []
+
+        # capacity arrives: a fresh roomy rack -> the gang unblocks
+        # and places ALL K inside it
+        fresh = topo_nodes(n=4, rack_size=4)
+        for node in fresh:
+            node.meta["rack"] = "r-new"
+            node.compute_class()
+            server.node_register(node)
+
+        def placed():
+            return len([a for a in state.allocs_by_job(job.id)
+                        if not a.terminal_status()]) == 4
+
+        deadline = _time.monotonic() + 90.0
+        while _time.monotonic() < deadline and not placed():
+            _time.sleep(0.02)
+        assert placed()
+        live = [a for a in state.allocs_by_job(job.id)
+                if not a.terminal_status()]
+        fresh_ids = {n.id for n in fresh}
+        assert {a.node_id for a in live} <= fresh_ids
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# executive cohort routing: a gang is ONE row with K asks
+
+
+def test_cohort_reconcile_routes_gang_to_legacy_lane():
+    from nomad_tpu.scheduler.util import cohort_reconcile
+
+    nodes = topo_nodes(n=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    ev = new_eval(h.state.job_by_id(job.id),
+                  consts.EVAL_TRIGGER_JOB_REGISTER)
+    (member,) = cohort_reconcile(h.state.snapshot(), [ev])
+    assert member.reason == "gang task group"
+    plain = mock.job()
+    h.state.upsert_job(h.next_index(), plain)
+    (m2,) = cohort_reconcile(
+        h.state.snapshot(),
+        [new_eval(plain, consts.EVAL_TRIGGER_JOB_REGISTER)])
+    assert not m2.reason  # plain jobs stay on the cohort fast path
+
+
+def test_executive_places_gang_atomically():
+    import time as _time
+
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.server.worker import DEQUEUE_TIMEOUT
+
+    server = Server(ServerConfig(
+        num_schedulers=2,
+        scheduler_factories={"service": "service-tpu"},
+        scheduler_executive=True,
+        executive_threads=2,
+        eval_nack_timeout=5.0))
+    server.start()
+    try:
+        nodes = topo_nodes(n=8, rack_size=4)
+        for node in nodes:
+            server.node_register(node)
+        # quiesce so the eval drains through the EXECUTIVE's cohort
+        # path (not a worker's direct handoff window)
+        for w in server.workers:
+            w.set_pause(True)
+        server.executive.set_pause(True)
+        deadline = _time.monotonic() + 4 * DEQUEUE_TIMEOUT + 30.0
+        while _time.monotonic() < deadline and not (
+                all(w.parked() for w in server.workers)
+                and server.executive.parked()):
+            _time.sleep(0.02)
+        # a gang job AND plain jobs: the cohort clears dense_min_batch
+        # so the executive's array-reconcile actually classifies it
+        # (a singleton batch short-circuits to the host route)
+        job = gang_job(k=4, slice="rack")
+        ev, _ = server.job_register(job)
+        evals = [ev]
+        for i in range(3):
+            plain = mock.job()
+            plain.id = f"plain-{i}"
+            plain.task_groups[0].count = 2
+            plain.task_groups[0].tasks[0].resources.networks = []
+            pe, _ = server.job_register(plain)
+            evals.append(pe)
+        state = server.fsm.state
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline \
+                and server.broker.ready_count() < len(evals):
+            _time.sleep(0.02)
+        for w in server.workers:
+            w.set_pause(False)
+        server.executive.set_pause(False)
+
+        def done():
+            evs = [state.eval_by_id(e) for e in evals]
+            return all(e is not None and e.terminal_status()
+                       for e in evs)
+
+        deadline = _time.monotonic() + 90.0
+        while _time.monotonic() < deadline and not done():
+            _time.sleep(0.02)
+        assert done()
+        live = [a for a in state.allocs_by_job(job.id)
+                if not a.terminal_status()]
+        assert len(live) == 4
+        by_id = {n.id: n for n in nodes}
+        assert len({by_id[a.node_id].meta["rack"] for a in live}) == 1
+        st = server.executive.stats()
+        assert st["legacy_reasons"].get("gang task group", 0) >= 1
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------
+# jit-cache stability: the gang leg recompiles 0 in steady state
+
+
+def test_gang_jit_cache_stability():
+    from nomad_tpu.ops.binpack import jit_cache_size
+
+    nodes = topo_nodes(n=12, rack_size=4)
+    warm = None
+    for i in range(4):
+        job = gang_job(k=4, slice="rack", jid=f"jit-{i}",
+                       cpu=300 + 50 * i)
+        h = seeded_harness(nodes, job, seed=i)
+        h.process("service-tpu",
+                  new_eval(h.state.job_by_id(job.id),
+                           consts.EVAL_TRIGGER_JOB_REGISTER))
+        assert len(live_members(h, job)) == 4
+        if i == 0:
+            warm = jit_cache_size()
+    assert jit_cache_size() == warm, (
+        "gang dispatches recompiled in steady state")
+
+
+# ---------------------------------------------------------------------
+# oracle differential sweep
+
+
+def test_gang_differential_sweep_green():
+    from nomad_tpu.kernels.differential import run_gang_differential
+
+    out = run_gang_differential()
+    assert out["green"], "\n".join(out["violations"])
+    assert out["cases"] == 8
+    assert out["placed_gangs"] >= 1  # the sweep exercises real placements
+
+
+def test_judge_gang_plan_catches_partial_and_split_slices():
+    """TP check: the judge must convict a hand-tampered plan — a
+    partial gang and a slice spanning two racks."""
+    from nomad_tpu.kernels.differential import judge_gang_plan
+
+    nodes = topo_nodes(n=8, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    snap = h.state.snapshot()
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    (plan,) = [p for p in h.plans if p.node_allocation]
+    assert judge_gang_plan(snap, plan, job) == []
+
+    # tamper 1: drop one member (partial gang)
+    victim_node = next(iter(plan.node_allocation))
+    dropped = plan.node_allocation[victim_node].pop(0)
+    bad = judge_gang_plan(snap, plan, job)
+    assert any("partial gang" in v for v in bad)
+    plan.node_allocation[victim_node].insert(0, dropped)
+
+    # tamper 2: move one member to the OTHER rack (split slice)
+    by_id = {n.id: n for n in nodes}
+    used_rack = by_id[victim_node].meta["rack"]
+    other = next(n for n in nodes if n.meta["rack"] != used_rack)
+    moved = plan.node_allocation[victim_node].pop(0)
+    moved.node_id = other.id
+    plan.node_allocation.setdefault(other.id, []).append(moved)
+    bad = judge_gang_plan(snap, plan, job)
+    assert any("not contiguous" in v for v in bad)
+
+
+# ---------------------------------------------------------------------
+# quality axis: slice fragmentation
+
+
+def test_slice_fragmentation_units():
+    from nomad_tpu.kernels.quality import slice_fragmentation
+
+    capacity = np.full((4, 4), 1000.0)
+    node_ok = np.ones(4, bool)
+    ask = np.asarray([400.0, 0, 0, 0])
+    # empty cluster, racks of 2: every rack fits k=2 -> frag 0
+    util = np.zeros((4, 4))
+    assert slice_fragmentation(
+        util, capacity, node_ok, [0, 0, 1, 1], ask, k=2) == 0.0
+    # rack 1 half-used: each node fits 1 member, the rack still fits
+    # k=2 in total -> usable; k=4 fits NO rack -> frag 1.0
+    util2 = np.zeros((4, 4))
+    util2[2:, 0] = 600.0
+    assert slice_fragmentation(
+        util2, capacity, node_ok, [0, 0, 1, 1], ask, k=2) == 0.0
+    # k=4: rack 0 (empty, 2 members/node) still fits; rack 1's free
+    # capacity (1 member/node) is stranded -> its weight fraction
+    frag4 = slice_fragmentation(
+        util2, capacity, node_ok, [0, 0, 1, 1], ask, k=4)
+    assert 0.3 < frag4 < 0.5
+    # k=5 fits NO rack: every free byte is gang-stranded
+    assert slice_fragmentation(
+        util2, capacity, node_ok, [0, 0, 1, 1], ask,
+        k=5) == pytest.approx(1.0)
+    # topology-less free capacity counts stranded
+    frag = slice_fragmentation(
+        util, capacity, node_ok, [0, 0, -1, -1], ask, k=2)
+    assert 0.4 < frag < 0.6
+
+
+def test_slice_frag_from_store():
+    from nomad_tpu.kernels.quality import slice_frag_from_store
+
+    nodes = topo_nodes(n=8, rack_size=4)
+    job = gang_job(k=4, slice="rack")
+    h = seeded_harness(nodes, job)
+    empty = slice_frag_from_store(h.state.snapshot(), job,
+                                  job.task_groups[0])
+    assert empty == 0.0
+    h.process("service-tpu", new_eval(h.state.job_by_id(job.id),
+                                      consts.EVAL_TRIGGER_JOB_REGISTER))
+    after = slice_frag_from_store(h.state.snapshot(), job,
+                                  job.task_groups[0])
+    assert 0.0 <= after <= 1.0
+
+
+# ---------------------------------------------------------------------
+# stats surface
+
+
+def test_gang_stats_counters():
+    from nomad_tpu.gang import note_gang_result
+
+    note_gang_result(True, 4, "device")
+    note_gang_result(False, 4, "device")
+    note_gang_result(True, 2, "host")
+    st = gang_stats()
+    assert st["gangs_placed"] == 2
+    assert st["gangs_rejected"] == 1
+    assert st["members_placed"] == 6
+    assert st["path_device"] == 2 and st["path_host"] == 1
