@@ -27,7 +27,11 @@ One rule:
 
 ``parallel/mesh.py``'s sharding helpers are deliberately out of scope:
 they are infrastructure the manifest functions call, not a dispatch
-path of their own.
+path of their own. ``parallel/shard.py`` (the explicit shard_map
+programs) and ``models/classes.py`` (the compression plane's
+class-expansion helpers) ARE in scope, with a ZERO baseline: their
+whole design is that no transfer lives there, and the scope keeps an
+expansion helper from smuggling a ``device_put`` into the hot path.
 """
 
 from __future__ import annotations
@@ -40,7 +44,7 @@ from .core import Finding, Module
 RULE_RESHIP = "full-matrix-reship"
 
 SCOPE_MARKERS = ("/dispatch/", "/scheduler/", "/models/", "/kernels/",
-                 "/gang/")
+                 "/gang/", "/parallel/shard")
 
 REBUILD_MANIFEST = "NTA_REBUILD_ENTRYPOINTS"
 # Call names that move host arrays onto the device. `device_put`
@@ -53,6 +57,13 @@ TRANSFER_NAMES = {"device_put", "device_resident"}
 def _in_scope(rel_path: str) -> bool:
     p = "/" + rel_path
     return any(m in p for m in SCOPE_MARKERS)
+
+
+def manifest_entries(mod: Module) -> List[str]:
+    """The module's declared rebuild manifest (public: the static-
+    analysis suite's uniqueness gate walks every scoped module and
+    asserts the union stays the ONE sanctioned full-upload path)."""
+    return _rebuild_manifest(mod)
 
 
 def _rebuild_manifest(mod: Module) -> List[str]:

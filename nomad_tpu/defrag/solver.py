@@ -59,6 +59,14 @@ MAX_SOLVE_ALLOCS = 512
 # placement path) so steady-state churn in the movable count reuses
 # one compiled program per bucket.
 K_BUCKETS = [16, 32, 64, 128, 256, MAX_SOLVE_ALLOCS]
+# Class-compressed solve (models/classes.py): past this fleet size,
+# when the signature interning compresses at least this much, the
+# relaxed program runs over x[K, C] instead of x[K, N] and expands
+# back to nodes at the rounding step. Below the thresholds the exact
+# node-granular solve is already cheap — small fleets (and tier-1
+# tests) keep the uncompressed path bit-for-bit.
+CLASS_COMPRESS_MIN_NODES = 2048
+CLASS_COMPRESS_MIN_RATIO = 2.0
 
 
 @dataclass
@@ -88,6 +96,11 @@ class DefragPlan:
     warm: bool = False
     carried: int = 0
     solve_ms: float = 0.0
+    # Class-compression telemetry (models/classes.py): whether this
+    # round solved over classes, and at what N/C ratio.
+    compressed: bool = False
+    classes: int = 0
+    compression_ratio: float = 0.0
 
 
 class WarmState:
@@ -291,13 +304,29 @@ def movable_allocs(state, row_of: Dict[str, int], node_ok) -> List:
 
 def compute_defrag_plan(state, datacenters, *, max_moves: int,
                         min_gain: float, warm: WarmState,
-                        movable_cap: int = MAX_SOLVE_ALLOCS
-                        ) -> DefragPlan:
+                        movable_cap: int = MAX_SOLVE_ALLOCS,
+                        class_compress: Optional[bool] = None,
+                        mesh=None) -> DefragPlan:
     """One defrag round against an MVCC snapshot: resolve the resident
     cluster base (the same cacheable path the schedulers ride — in
     steady state this is a cache hit, not a rebuild), solve the relaxed
     global re-placement warm-started from `warm`, and extract the
-    gain-verified move set. Mutates `warm` with this round's iterate."""
+    gain-verified move set. Mutates `warm` with this round's iterate.
+
+    ``class_compress`` forces (True) or forbids (False) the
+    class-compressed solve; None auto-enables it past
+    CLASS_COMPRESS_MIN_NODES when the fleet compresses at least
+    CLASS_COMPRESS_MIN_RATIO. The compressed solve aggregates per-class
+    capacity/residual over SCHEDULABLE members only; the aggregate
+    relaxes feasibility (a class's pooled headroom can exceed any one
+    member's), which is safe here because the rounding walk and the
+    move simulation below both re-verify per-NODE headroom — a class
+    choice that no member can absorb rounds to nothing.
+
+    ``mesh`` (parallel/mesh.py) shards the UNcompressed solve's node
+    axis across devices via GSPMD input shardings — the x[K, N] tensor
+    is the biggest in the system and must shard past device memory.
+    The compressed solve is small enough to stay single-device."""
     from ..models.matrix import (
         _alloc_usage,
         resolve_cluster_base,
@@ -353,17 +382,64 @@ def compute_defrag_plan(state, datacenters, *, max_moves: int,
     np.maximum(bw_used, 0.0, out=bw_used)
     ports_free = base.ports_free.copy()
     np.add.at(ports_free, cur_row, ask_ports[:k_real])
+    node_ok = np.asarray(base.node_ok, bool)
+
+    # ---- class compression (models/classes.py): solve over x[K, C]
+    # instead of x[K, N] when the fleet is big and compresses. The
+    # residual state above stays node-granular; only the solve's view
+    # aggregates, and the expansion back happens before rounding.
+    cidx = getattr(base, "class_index", None)
+    compress = class_compress
+    if compress is None:
+        compress = (cidx is not None
+                    and base.n_real >= CLASS_COMPRESS_MIN_NODES
+                    and cidx.compression_ratio()
+                    >= CLASS_COMPRESS_MIN_RATIO)
+    compress = bool(compress) and cidx is not None
+    if compress:
+        from ..models.classes import class_any, class_sum
+        from ..models.matrix import BUCKETS, bucket_size
+
+        ids = cidx.ids[: cidx.n_real]
+        c_pad = bucket_size(cidx.n_classes, BUCKETS)
+        # Aggregate over SCHEDULABLE members only: a class's pooled
+        # capacity is its LIVE capacity, and an all-down class zeroes
+        # out (capacity 0 -> infeasible in the solve's mask).
+        solve_util = class_sum(base_util, ids, c_pad, where=node_ok)
+        solve_cap = class_sum(base.capacity, ids, c_pad, where=node_ok)
+        solve_sched = class_sum(base.sched_capacity, ids, c_pad,
+                                where=node_ok)
+        solve_bw_avail = class_sum(base.bw_avail, ids, c_pad,
+                                   where=node_ok)
+        solve_bw_used = class_sum(bw_used, ids, c_pad, where=node_ok)
+        solve_ports = class_sum(ports_free.astype(np.float32), ids,
+                                c_pad, where=node_ok)
+        solve_ok = class_any(node_ok, ids, c_pad)
+        width = c_pad
+        # A class move means a different warm-carry geometry: the
+        # "class" marker keys the carry apart from node-granular
+        # rounds so a mode flip drops the stale iterate.
+        key = (usig, c_pad, k, "class")
+        plan.compressed = True
+        plan.classes = int(cidx.n_classes)
+        plan.compression_ratio = round(cidx.compression_ratio(), 2)
+    else:
+        solve_util, solve_cap = base_util, base.capacity
+        solve_sched = base.sched_capacity
+        solve_bw_avail, solve_bw_used = base.bw_avail, bw_used
+        solve_ports, solve_ok = ports_free, node_ok
+        width = base.n
+        key = (usig, base.n, k)
 
     # Warm-start carry, keyed on the family signature (node-set
     # identity) + shape: gather carried rows per alloc id.
-    key = (usig, base.n, k)
     carried = warm.take(key)
-    logits0 = np.zeros((k, base.n), np.float32)
+    logits0 = np.zeros((k, width), np.float32)
     fresh = np.ones(k, bool)
     n_carried = 0
     for i, a in enumerate(movable):
         row = carried.get(a.id)
-        if row is not None and row.shape == (base.n,):
+        if row is not None and row.shape == (width,):
             logits0[i] = row
             fresh[i] = False
             n_carried += 1
@@ -371,13 +447,29 @@ def compute_defrag_plan(state, datacenters, *, max_moves: int,
     plan.warm = n_carried >= max(1, int(k_real * WARM_MIN_CARRY))
     iters = WARM_ITERS if plan.warm else COLD_ITERS
 
-    logits, x = _solve_jit()(
-        logits0, fresh, base_util, base.capacity, base.sched_capacity,
-        np.asarray(base.node_ok, bool), base.bw_avail, bw_used,
-        ports_free, ask_res, ask_bw, ask_ports, active, iters)
+    solve_args = (logits0, fresh, solve_util, solve_cap, solve_sched,
+                  solve_ok, solve_bw_avail, solve_bw_used, solve_ports,
+                  ask_res, ask_bw, ask_ports, active)
+    if mesh is not None and not compress:
+        from ..parallel.mesh import NODE_AXIS, shard_defrag_inputs
+
+        if base.n % int(mesh.shape[NODE_AXIS]) == 0:
+            solve_args = shard_defrag_inputs(mesh, solve_args)
+    logits, x = _solve_jit()(*solve_args, iters=iters)
     logits = np.asarray(logits)
     x = np.asarray(x)
     warm.store(key, {a.id: logits[i] for i, a in enumerate(movable)})
+    if compress:
+        # Expand the class-granular solution back to node granularity
+        # for the rounding walk: each class's mass splits evenly over
+        # its members (a tie-break, not a feasibility claim — the walk
+        # checks actual per-node headroom).
+        from ..models.classes import expand_to_nodes
+
+        x_nodes = np.zeros((k_real, base.n), np.float32)
+        x_nodes[:, : cidx.n_real] = expand_to_nodes(
+            x[:k_real], ids, cidx.counts)
+        x = x_nodes
 
     # ---- rounding: the convex kernel's repair scan, on the host. A
     # per-row argmax is degenerate (symmetric asks get symmetric rows
@@ -385,7 +477,6 @@ def compute_defrag_plan(state, datacenters, *, max_moves: int,
     # rounds with a SEQUENTIAL feasibility-respecting scan biased by
     # the row preference + the aggregate node mass y — the same shape
     # here, in numpy (this path runs once per round, off the hot path).
-    node_ok = np.asarray(base.node_ok, bool)
     y = x[:k_real].sum(axis=0)
     pref = (x[:k_real] / (x[:k_real].max(axis=1, keepdims=True) + 1e-9)
             + y[None, :] / (y.max() + 1e-9))

@@ -84,7 +84,8 @@ class _ClusterBase:
                  "util", "bw_avail", "bw_used", "ports_free", "node_ok",
                  "alloc_groups", "token", "allocs_index", "table_len",
                  "nodes_index", "delta_parent", "class_ids", "class_reps",
-                 "topology", "_positions", "_positions_lock")
+                 "class_index", "topology", "_positions",
+                 "_positions_lock")
 
     def __init__(self, nodes, proposed_fn, allocs_index: int = -1,
                  table_len: int = -1, nodes_index: int = -1):
@@ -136,6 +137,14 @@ class _ClusterBase:
         ids, self.class_reps = compute_class_index(nodes)
         self.class_ids = np.full(self.n, -1, np.int32)
         self.class_ids[: len(nodes)] = ids
+        # Signature-class interning (models/classes.py): REFINES the
+        # computed class with the static row state, so class-granular
+        # dense programs (the defrag solve's x[K, C]) can expand back
+        # to bit-identical node rows. Escaped nodes get singleton
+        # classes there, so aggregation always covers the whole fleet.
+        from .classes import ClassIndex
+
+        self.class_index = ClassIndex(nodes, self.n)
         # Node-topology tensor (models/topology.py): rack/ICI id
         # columns for the gang program. Node-level and alloc-
         # independent like the class index — delta clones share it by
@@ -300,6 +309,18 @@ class _ClusterBase:
                         return None
                 elif node.computed_class:
                     return None
+                # Class-split path (models/classes.py): the signature
+                # covers capacity/reserved/link state beyond the
+                # computed class — a node whose signature moved cannot
+                # keep riding the shared interning; rebuild re-interns.
+                # Readiness/drain flips are row state, outside the
+                # signature, and stay deltas.
+                from .classes import node_signature
+
+                if (i < self.n_real
+                        and self.class_index.signature_of(i)
+                        != node_signature(node)):
+                    return None
                 node_rows.append(i)
         allocs = state.allocs()
         created = sum(1 for a in allocs if a.create_index > base_allocs_index)
@@ -383,6 +404,7 @@ class _ClusterBase:
         # moved a group also moved the computed class, and the class
         # checks above already refused the row delta for that).
         new.class_ids, new.class_reps = self.class_ids, self.class_reps
+        new.class_index = self.class_index
         new.topology = self.topology
         # Same profiled declaration site as __init__: delta clones ARE
         # the live pipeline's dominant base-build path, and an
@@ -1039,6 +1061,10 @@ class ClusterMatrix:
         # Padded [N] class index: rides the device base upload so the
         # compact overlay's verdict expansion happens on device.
         self.class_ids = base.class_ids
+        # Signature-class interning (models/classes.py): the defrag
+        # solver's class-compressed solve reads this off the resolved
+        # matrix.
+        self.class_index = base.class_index
         # Node-topology tensor (models/topology.py) for the gang
         # program's slice/spread/affinity group ops.
         self.topology = base.topology

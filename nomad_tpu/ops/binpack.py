@@ -699,8 +699,9 @@ def jit_cache_size() -> int:
     eat the same multi-second compile stalls — steady state is exactly
     cold+warm per live (K bucket, N) shape and then FLAT."""
     from ..defrag.solver import solve_cache_size
+    from ..parallel.shard import shard_cache_size
 
-    total = solve_cache_size()
+    total = solve_cache_size() + shard_cache_size()
     for fn in _jit_entry_points():
         try:
             total += fn._cache_size()

@@ -138,7 +138,10 @@ def _member_units(state: GangState, ask_res, ask_bw, ask_ports,
 
 def _group_capacity(units, topo_ids, g_pad):
     """[g_pad] f32 member capacity per topology group; ids < 0 scatter
-    out of range and drop."""
+    out of range and drop. Under shard_map the inputs are one node-axis
+    SHARD of the fleet and the result is the shard's PARTIAL group
+    capacity — parallel/shard.py sharded_group_capacity psums the
+    partials (a gang slice can span shards)."""
     safe_ids = jnp.where(topo_ids >= 0, topo_ids, g_pad)
     return jnp.zeros(g_pad, jnp.float32).at[safe_ids].add(
         units, mode="drop")

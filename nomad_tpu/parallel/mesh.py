@@ -146,6 +146,69 @@ def shard_placement_inputs(
     return state_sh, asks_sh, keys_sh
 
 
+def gang_state_specs() -> "object":
+    """PartitionSpecs for ops/gang.py's GangState, IN FIELD ORDER:
+    node-axis leaves shard, everything is per-node. Lives here (with
+    base_specs) so the gang program's sharded inputs can't drift from
+    the dispatch-side layout. The topology-group scatter-add inside the
+    program crosses shards (a gang slice can span them) — GSPMD lowers
+    it to a segment-sum + all-reduce, the same collective the explicit
+    parallel/shard.py sharded_group_capacity states by hand."""
+    from ..ops.gang import GangState
+
+    vec = P(NODE_AXIS)
+    mat = P(NODE_AXIS, None)
+    return GangState(
+        capacity=mat,
+        sched_capacity=mat,
+        util=mat,
+        bw_avail=vec,
+        bw_used=vec,
+        ports_free=vec,
+        feas_row=vec,
+        job_count=vec,
+        dh_presence=vec,
+        topo_ids=vec,
+    )
+
+
+def shard_gang_inputs(mesh: Mesh, state) -> "object":
+    """Place a GangState on the mesh, node axis sharded. One
+    device_put for the whole pytree (single transfer commit, like
+    shard_placement_inputs)."""
+    return jax.device_put(
+        state,
+        jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                     gang_state_specs()),
+    )
+
+
+def defrag_solve_specs() -> Tuple:
+    """PartitionSpecs for the defrag global solve's arguments, IN
+    defrag/solver.py _solve_jit order: (logits0, fresh, base_util,
+    capacity, sched_capacity, node_ok, bw_avail, bw_used, ports_free,
+    ask_res, ask_bw, ask_ports, active). The x[K, N] tensor (logits0
+    and the program's intermediates) shards over its NODE column —
+    the biggest tensor in the system is what caps the fleet on one
+    device. Ask-axis arrays replicate (K is bounded by
+    MAX_SOLVE_ALLOCS)."""
+    vec = P(NODE_AXIS)
+    mat = P(NODE_AXIS, None)
+    return (P(None, NODE_AXIS), P(), mat, mat, mat, vec, vec, vec, vec,
+            P(None, None), P(), P(), P())
+
+
+def shard_defrag_inputs(mesh: Mesh, args: Tuple) -> Tuple:
+    """Place the defrag solve's argument tuple on the mesh
+    (defrag_solve_specs order). GSPMD propagates through mirror
+    descent: the per-alloc softmax over the sharded node axis lowers
+    to a cross-device reduction, the gradient terms stay node-local."""
+    return jax.device_put(
+        args,
+        tuple(NamedSharding(mesh, s) for s in defrag_solve_specs()),
+    )
+
+
 def sharded_placement(mesh: Mesh, state: NodeState, asks: Asks, keys, config,
                       batched: bool = False):
     """Run the placement program with mesh-sharded inputs. GSPMD

@@ -549,17 +549,26 @@ class PlacementBatcher:
                     # delta payload on the SAME mesh up front so the
                     # scatter keeps the node axis sharded instead of
                     # gathering it to one device (parallel/mesh.py
-                    # pins the payload specs next to base_specs).
+                    # pins the payload specs next to base_specs),
+                    # then run the explicit shard_map scatter
+                    # (parallel/shard.py) — each shard keeps only the
+                    # rows landing in its slice, zero collectives.
                     from jax.sharding import NamedSharding
 
                     from ..parallel.mesh import delta_row_specs
+                    from ..parallel.shard import sharded_base_delta
 
                     payload = jax.device_put(
                         payload,
                         tuple(NamedSharding(psh.mesh, s)
                               for s in delta_row_specs()))
-                util2, bw2, ports2, ok2 = apply_base_delta(
-                    parent[2], parent[4], parent[5], parent[6], *payload)
+                    util2, bw2, ports2, ok2 = sharded_base_delta(
+                        psh.mesh)(parent[2], parent[4], parent[5],
+                                  parent[6], *payload)
+                else:
+                    util2, bw2, ports2, ok2 = apply_base_delta(
+                        parent[2], parent[4], parent[5], parent[6],
+                        *payload)
                 # capacity/sched_capacity/bw_avail/class_ids never
                 # change with allocs: share the parent's device arrays.
                 # node_ok rides the scatter (node-down deltas mask rows
@@ -997,6 +1006,20 @@ class PlacementBatcher:
                     self._dispatchers.pop(shape_key, None)
             if spawn:
                 self._spawn_dispatcher(shape_key, config)
+
+    def shard_occupancy(self) -> list:
+        """Per-shard [{device, rows, bytes}] of the newest resident
+        base (parallel/shard.py per_shard_occupancy) — the bench's
+        per-shard occupancy / device-memory columns. Snapshot under
+        the lock, read layouts outside it (pure metadata)."""
+        with self._lock:
+            dev = next(reversed(self._device_bases.values()), None) \
+                if self._device_bases else None
+        if dev is None:
+            return []
+        from ..parallel.shard import per_shard_occupancy
+
+        return per_shard_occupancy(dev)
 
     def stats(self) -> dict:
         from ..ops.binpack import jit_cache_size

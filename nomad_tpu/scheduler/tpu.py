@@ -252,6 +252,16 @@ class BatchedTPUScheduler(GenericScheduler):
                 self.eval.id, trace.STAGE_MATRIX_UPDATE, _t0, _t_base,
                 ann={"kind": kind, "rows": matrix.delta_rows},
                 trace_id=self.eval.trace_id)
+        # Compression-plane attribution (models/classes.py): how far
+        # the fleet interned — C classes over N nodes. Zero-duration
+        # marker span (the interning rides the base build above); its
+        # value is the annotation in the flight recorder.
+        cidx = getattr(matrix, "class_index", None)
+        if cidx is not None:
+            trace.record_span(
+                self.eval.id, trace.STAGE_MATRIX_COMPRESS, _t_base,
+                _t_base, ann=cidx.stats(),
+                trace_id=self.eval.trace_id)
         # In-batch conflict pre-resolution rides the Planner (worker /
         # dispatch-pipeline sessions set it from server config): batch
         # members of one shared-snapshot dispatch then see each other's

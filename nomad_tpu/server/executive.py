@@ -655,6 +655,13 @@ class SchedulerExecutive:
                 ev.id, trace.STAGE_MATRIX_UPDATE, _t0, _t_base,
                 ann={"kind": kind, "rows": row.matrix.delta_rows},
                 trace_id=ev.trace_id)
+        # Compression-plane marker, mirroring scheduler/tpu.py: the
+        # executive's evals carry the same C/N/ratio annotation.
+        cidx = getattr(row.matrix, "class_index", None)
+        if cidx is not None:
+            trace.record_span(
+                ev.id, trace.STAGE_MATRIX_COMPRESS, _t_base, _t_base,
+                ann=cidx.stats(), trace_id=ev.trace_id)
         # The factory's kernel pin ("service-convex-tpu" -> convex)
         # rides into the config exactly as BatchedTPUScheduler.kernel
         # would — the fast path must run the SAME program the per-eval

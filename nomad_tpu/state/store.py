@@ -59,13 +59,21 @@ class _Table:
 
 
 class _Index:
-    """Secondary index: key -> frozenset-ish of ids, copy-on-write."""
+    """Secondary index: key -> frozenset-ish of ids, copy-on-write.
 
-    __slots__ = ("data", "shared")
+    COW granularity is per-SET, not per-dict: `_fresh` names the keys
+    whose set was created or copied since the last `share()` — no
+    snapshot can hold those, so they mutate in place. Without this,
+    every `add` under one hot key (500k allocs of one job) copies the
+    whole growing set and a bulk load goes quadratic.
+    """
+
+    __slots__ = ("data", "shared", "_fresh")
 
     def __init__(self):
         self.data: Dict[str, Set[str]] = {}
         self.shared = False
+        self._fresh: Set[str] = set()
 
     def _for_write(self) -> Dict[str, Set[str]]:
         if self.shared:
@@ -78,21 +86,33 @@ class _Index:
         cur = data.get(key)
         if cur is None:
             data[key] = {id_}
+            self._fresh.add(key)
+        elif key in self._fresh:
+            cur.add(id_)  # private since last share(): mutate in place
         else:
             data[key] = cur | {id_}  # copy: snapshots may hold cur
+            self._fresh.add(key)
 
     def remove(self, key: str, id_: str) -> None:
         data = self._for_write()
         cur = data.get(key)
         if cur and id_ in cur:
-            nxt = cur - {id_}
-            if nxt:
-                data[key] = nxt
+            if key in self._fresh:
+                cur.discard(id_)
+                if not cur:
+                    del data[key]
+                    self._fresh.discard(key)
             else:
-                del data[key]
+                nxt = cur - {id_}
+                if nxt:
+                    data[key] = nxt
+                    self._fresh.add(key)
+                else:
+                    del data[key]
 
     def share(self) -> Dict[str, Set[str]]:
         self.shared = True
+        self._fresh.clear()
         return self.data
 
 

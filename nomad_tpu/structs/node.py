@@ -61,9 +61,19 @@ class Node:
             for k in sorted(m):
                 if is_unique_namespace(k):
                     continue
+                v = m[k]
+                if not isinstance(v, (str, int, float, bool)):
+                    # Escape hatch: a dynamic, non-hashable value (the
+                    # reference's HashIncludeMap error path) has no
+                    # stable digest — str() of a list/dict would make
+                    # the class depend on repr ordering. Classless
+                    # nodes evaluate feasibility per node and get a
+                    # singleton class in models/classes.py.
+                    self.computed_class = ""
+                    return
                 h.update(k.encode())
                 h.update(b"\x02")
-                h.update(str(m[k]).encode())
+                h.update(str(v).encode())
                 h.update(b"\x03")
         self.computed_class = "v1:" + h.hexdigest()
 

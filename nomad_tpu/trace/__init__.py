@@ -28,6 +28,7 @@ from .span import (  # noqa: F401
     STAGE_DISPATCH_LAUNCH,
     STAGE_GANG_SELECT,
     STAGE_MATRIX_BUILD,
+    STAGE_MATRIX_COMPRESS,
     STAGE_MATRIX_UPDATE,
     STAGE_MIGRATE_PLACE,
     STAGE_PLAN_COMMIT,

@@ -27,6 +27,8 @@ STAGE_DISPATCH_LAUNCH = "dispatch.launch"  # launch prologue (catch-up + snapsho
 STAGE_SCHED_PROCESS = "scheduler.process"  # scheduler invoke, end to end
 STAGE_MATRIX_BUILD = "matrix.build"        # ClusterMatrix + ask construction
 STAGE_MATRIX_UPDATE = "matrix.update"      # incremental delta vs full rebuild
+STAGE_MATRIX_COMPRESS = "matrix.compress"  # signature-class interning
+#   (models/classes.py; ann: classes C, nodes N, escaped, ratio N/C)
 STAGE_DEVICE_TRANSFER = "device.transfer"  # base prefetch host->device
 STAGE_DEVICE_DISPATCH = "device.dispatch"  # batcher.place round-trip
 STAGE_DEVICE_SOLVE = "device.solve"        # the jitted placement-kernel
@@ -57,6 +59,7 @@ ALL_STAGES = (
     STAGE_SCHED_PROCESS,
     STAGE_MATRIX_BUILD,
     STAGE_MATRIX_UPDATE,
+    STAGE_MATRIX_COMPRESS,
     STAGE_DEVICE_TRANSFER,
     STAGE_DEVICE_DISPATCH,
     STAGE_DEVICE_SOLVE,

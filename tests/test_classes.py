@@ -1,0 +1,233 @@
+"""The compression plane (nomad_tpu/models/classes.py): signature
+interning correctness.
+
+The load-bearing property: two nodes with EQUAL signatures are
+placement-indistinguishable — for any job, the HOST oracle feasibility
+chain (the differential rig's judge, kernels/differential.py
+_oracle_feasible) and the dense constraint mask (models/matrix.py
+node_feasibility) give both nodes the same verdict. The property test
+sweeps randomized template-derived fleets against randomized
+constrained jobs; a counterexample means the signature misses a field
+some feasibility iterator reads (the parity bug the class-granular
+defrag solve would silently inherit).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.models.classes import (
+    ClassIndex,
+    class_any,
+    class_sum,
+    expand_to_nodes,
+    node_signature,
+)
+from nomad_tpu.structs import Constraint, consts
+
+
+def _template_nodes(rng: random.Random, n_templates: int, copies: int):
+    """A fleet of `n_templates` randomized node shapes, `copies` nodes
+    each — unique identity (id/name/unique-attrs) per node, shared
+    everything-feasibility-reads per template."""
+    nodes = []
+    for t in range(n_templates):
+        dc = f"dc{rng.randint(1, 2)}"
+        node_class = rng.choice(["linux-small", "linux-medium-pci", ""])
+        rack = f"r{rng.randint(0, 3)}" if rng.random() < 0.7 else None
+        half = rng.random() < 0.5
+        exec_drv = rng.random() < 0.7
+        version = rng.choice(["0.5.0", "0.8.0"])
+        for _ in range(copies):
+            node = mock.node()
+            node.datacenter = dc
+            node.node_class = node_class
+            node.attributes["nomad.version"] = version
+            if rack is not None:
+                node.meta["rack"] = rack
+            if not exec_drv:
+                del node.attributes["driver.exec"]
+            if half:
+                node.resources.cpu //= 2
+                node.resources.memory_mb //= 2
+            node.compute_class()
+            nodes.append(node)
+    rng.shuffle(nodes)
+    return nodes
+
+
+def _random_job(rng: random.Random):
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 4
+    task = tg.tasks[0]
+    task.resources.cpu = rng.choice([100, 500, 1500])
+    task.resources.memory_mb = rng.choice([64, 256, 2048])
+    if rng.random() < 0.5:
+        task.resources.networks = []
+    if rng.random() < 0.4:
+        job.constraints.append(Constraint(
+            ltarget="${node.datacenter}", operand="=", rtarget="dc1"))
+    if rng.random() < 0.4:
+        job.constraints.append(Constraint(
+            ltarget="${meta.rack}", operand="regexp", rtarget="^r[01]$"))
+    if rng.random() < 0.4:
+        job.constraints.append(Constraint(
+            ltarget="${attr.nomad.version}", operand="version",
+            rtarget=">= 0.6.0"))
+    if rng.random() < 0.3:
+        job.constraints.append(Constraint(
+            ltarget="${node.class}", operand="=",
+            rtarget="linux-medium-pci"))
+    if rng.random() < 0.3:
+        task.driver = "exec"
+    return job
+
+
+@pytest.mark.parametrize("seed", range(4100, 4108))
+def test_same_signature_nodes_placement_indistinguishable(seed):
+    """Oracle-judged parity: every same-signature pair gets identical
+    feasibility verdicts from BOTH the host iterator stack and the
+    dense constraint mask, for randomized constrained jobs."""
+    from nomad_tpu.kernels.differential import _oracle_feasible
+    from nomad_tpu.models.matrix import (
+        compute_class_index,
+        node_feasibility,
+    )
+    from nomad_tpu.scheduler.testing import Harness, seed_harness_cluster
+
+    rng = random.Random(seed)
+    nodes = _template_nodes(rng, n_templates=rng.choice([3, 5]),
+                            copies=rng.choice([2, 3]))
+    jobs = [_random_job(rng) for _ in range(3)]
+
+    by_sig = {}
+    for i, node in enumerate(nodes):
+        sig = node_signature(node)
+        assert sig is not None  # mock nodes always class
+        by_sig.setdefault(sig, []).append(i)
+    pairs = [(rows[0], rows[1])
+             for rows in by_sig.values() if len(rows) >= 2]
+    assert pairs, "fleet degenerated to singletons — no property to test"
+
+    h = Harness(seed=seed)
+    seed_harness_cluster(h, nodes=nodes, jobs=jobs)
+    snap = h.state.snapshot()
+
+    ids, reps = compute_class_index(nodes)
+    for job in jobs:
+        groups = job.task_groups
+        feas = node_feasibility(snap, job, groups, nodes, ids, reps)
+        for (i, j) in pairs:
+            assert np.array_equal(feas[i], feas[j]), (
+                f"seed {seed}: dense mask tells signature-equal rows "
+                f"{i}/{j} apart for job constraints "
+                f"{[c.operand for c in job.constraints]}")
+            for tg in groups:
+                oi = _oracle_feasible(snap, job, tg, nodes[i])
+                oj = _oracle_feasible(snap, job, tg, nodes[j])
+                assert oi == oj, (
+                    f"seed {seed}: oracle tells signature-equal rows "
+                    f"{i}/{j} apart on tg {tg.name}")
+
+
+def test_signature_refines_computed_class():
+    """Equal computed class but different capacity => different
+    signatures (the static matrix rows differ, so the classes must
+    too)."""
+    a, b = mock.node(), mock.node()
+    b.resources.cpu //= 2
+    a.compute_class()
+    b.compute_class()
+    assert a.computed_class == b.computed_class
+    assert node_signature(a) != node_signature(b)
+
+    c = mock.node()
+    c.compute_class()
+    assert node_signature(a) == node_signature(c)
+
+
+def test_escape_hatch_non_hashable_attr():
+    """A dynamic non-scalar attribute value refuses the digest
+    (computed_class == "") and the node lands in a SINGLETON class —
+    never merged, even with an identically-shaped peer."""
+    a, b = mock.node(), mock.node()
+    for node in (a, b):
+        node.attributes["gpus"] = ["a100", "a100"]  # non-hashable value
+        node.compute_class()
+        assert node.computed_class == ""
+        assert node_signature(node) is None
+
+    idx = ClassIndex([a, b])
+    assert idx.n_classes == 2
+    assert idx.n_escaped == 2
+    assert idx.ids[0] != idx.ids[1]
+    assert idx.compression_ratio() == 1.0
+
+
+def test_class_index_partition_and_helpers():
+    rng = random.Random(0)
+    nodes = _template_nodes(rng, n_templates=3, copies=4)
+    n_pad = 16
+    idx = ClassIndex(nodes, n_pad)
+
+    # ids: every real row classed, padding rows -1.
+    assert (idx.ids[: len(nodes)] >= 0).all()
+    assert (idx.ids[len(nodes):] == -1).all()
+    # members() partitions the real rows.
+    seen = np.concatenate([idx.members(c) for c in range(idx.n_classes)])
+    assert sorted(seen.tolist()) == list(range(len(nodes)))
+    for c in range(idx.n_classes):
+        rows = idx.members(c)
+        assert len(rows) == idx.counts[c]
+        sigs = {node_signature(nodes[r]) for r in rows}
+        assert len(sigs) == 1
+    # Deterministic construction: same node list => equal index.
+    idx2 = ClassIndex(nodes, n_pad)
+    assert np.array_equal(idx.ids, idx2.ids)
+    assert idx.reps == idx2.reps
+    # stats() carries the matrix.compress annotation shape.
+    st = idx.stats()
+    assert set(st) == {"classes", "nodes", "escaped", "ratio"}
+    assert st["ratio"] == round(len(nodes) / idx.n_classes, 2)
+
+
+def test_class_sum_any_expand_roundtrip():
+    ids = np.array([0, 1, 0, 2, 1], np.int32)
+    counts = np.array([2, 2, 1], np.int32)
+    vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+    agg = class_sum(vals, ids, 4)
+    assert agg.shape == (4, 2)
+    assert np.array_equal(agg[0], vals[0] + vals[2])
+    assert np.array_equal(agg[3], [0, 0])  # padded class stays zero
+    # where= masks rows out of the aggregate.
+    ok = np.array([True, True, False, True, True])
+    agg_ok = class_sum(vals, ids, 4, where=ok)
+    assert np.array_equal(agg_ok[0], vals[0])
+    # class_any ORs a row property.
+    flags = class_any(np.array([False, True, False, False, False]), ids, 4)
+    assert flags.tolist() == [False, True, False, False]
+    # Expansion splits class mass evenly over members; total preserved.
+    per_class = np.array([[4.0, 6.0, 5.0]], np.float32)
+    per_node = expand_to_nodes(per_class, ids, counts)
+    assert per_node.shape == (1, 5)
+    assert np.allclose(per_node[0], [2.0, 3.0, 2.0, 5.0, 3.0])
+    assert np.isclose(per_node.sum(), per_class.sum())
+
+
+def test_drain_and_readiness_stay_out_of_the_signature():
+    """Readiness is ROW state (the node_ok scatter), not class
+    identity: a drained node keeps its signature, so drain flips ride
+    the delta path and never split a class."""
+    a = mock.node()
+    a.compute_class()
+    before = node_signature(a)
+    a.drain = True
+    a.status = consts.NODE_STATUS_DOWN
+    a.compute_class()
+    assert node_signature(a) == before

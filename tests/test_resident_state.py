@@ -86,10 +86,29 @@ def assert_device_parity(m):
             err_msg=f"device {f} (token {m.base_token})")
 
 
+def assert_class_parity(m):
+    """The compression plane rides the same parity contract: the
+    (possibly delta-chain-shared) class index == a fresh interning of
+    the same node list. Construction is deterministic in row order, so
+    equality is array-for-array — including after a class SPLIT (meta
+    edit) forced a rebuild that re-interned."""
+    from nomad_tpu.models.classes import ClassIndex
+
+    base = m._cached_base()
+    fresh = ClassIndex(m.nodes, base.n)
+    np.testing.assert_array_equal(base.class_index.ids, fresh.ids)
+    assert base.class_index.reps == fresh.reps
+    np.testing.assert_array_equal(base.class_index.counts, fresh.counts)
+    assert base.class_index.signatures == fresh.signatures
+
+
 def test_incremental_vs_rebuild_parity_randomized():
-    """40 randomized steps of plan commits / node up-down / drain
-    events; the resident tensor must equal a fresh build at every
-    raft index, on host and on device."""
+    """52 randomized steps of plan commits / node up-down / drain /
+    meta-edit / register / deregister events; the resident tensor must
+    equal a fresh build at every raft index, on host and on device —
+    and the interned class index must equal a fresh interning (the
+    class-split path: a meta edit moves the node's signature, refuses
+    the delta, and the rebuild re-interns)."""
     rng = random.Random(0xA11C)
     store = StateStore()
     job = mock.job()
@@ -112,8 +131,14 @@ def test_incremental_vs_rebuild_parity_randomized():
     tracker = resident.get_tracker()
     before = tracker.stats()
 
-    for step in range(40):
-        op = rng.choice(("create", "stop", "down", "up", "drain"))
+    # Alloc/readiness churn dominates (the delta steady state); the
+    # class-splitting ops — meta edit, register, deregister — are the
+    # rare structural transitions that must fall back to a rebuild.
+    ops = (("create", "stop", "down", "up", "drain") * 2
+           + ("meta", "register", "deregister"))
+    ops_seen = set()
+    for step in range(52):
+        op = rng.choice(ops)
         index += 1
         if op == "create":
             fresh = make_alloc(rng.choice(nodes), job,
@@ -134,14 +159,37 @@ def test_incremental_vs_rebuild_parity_randomized():
             node.status = consts.NODE_STATUS_READY
             node.drain = False
             store.upsert_node(index, node)
-        else:  # drain
+        elif op == "drain":
             node = rng.choice(nodes)
             node.drain = not node.drain
             store.upsert_node(index, node)
+        elif op == "meta":
+            # Non-unique meta edit: moves the computed class AND the
+            # signature — the class-split path (delta refused, rebuild
+            # re-interns).
+            node = rng.choice(nodes)
+            node.meta["tier"] = f"t{step}"
+            node.compute_class()
+            store.upsert_node(index, node)
+        elif op == "register":
+            node = mock.node()
+            node.compute_class()
+            nodes.append(node)
+            store.upsert_node(index, node)
+        else:  # deregister
+            if len(nodes) <= 8:
+                continue
+            gone = nodes.pop(rng.randrange(len(nodes)))
+            live = [a for a in live if a.node_id != gone.id]
+            store.delete_node(index, gone.id)
+        ops_seen.add(op)
         snap = store.snapshot()
         m = ClusterMatrix(snap, job)
         assert_parity(m, snap, msg=f"step {step} op {op}")
         assert_device_parity(m)
+        assert_class_parity(m)
+    # The seeded walk must actually exercise the structural ops.
+    assert {"meta", "register", "deregister"} <= ops_seen
 
     after = tracker.stats()
     # The point of the design: the steady state rode deltas, including

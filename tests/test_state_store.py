@@ -47,6 +47,35 @@ def test_snapshot_isolation():
     assert snap.latest_index() == 1
 
 
+def test_index_set_isolation_under_hot_key():
+    """The secondary indexes mutate a set in place only while it is
+    private (created/copied since the last snapshot) — a snapshot's
+    view of a hot key must not grow or shrink under later writes."""
+    s = StateStore()
+
+    def mk():
+        a = mock.alloc()
+        a.job_id = "hot"
+        return [a]
+
+    s.upsert_allocs(1, mk())
+    snap1 = s.snapshot()
+    # These adds hit the in-place path (sets copied once post-share,
+    # then mutated privately): snap1 must keep seeing exactly 1.
+    for i in range(2, 6):
+        s.upsert_allocs(i, mk())
+    assert len(snap1.allocs_by_job("hot")) == 1
+    assert len(s.snapshot().allocs_by_job("hot")) == 5
+    # Same for removal: deleting from the live index leaves snapshots
+    # intact, including one taken mid-burst.
+    snap5 = s.snapshot()
+    doomed = [a.id for a in s.snapshot().allocs_by_job("hot")][:3]
+    s.delete_evals(6, [], doomed)
+    assert len(snap5.allocs_by_job("hot")) == 5
+    assert len(snap1.allocs_by_job("hot")) == 1
+    assert len(s.snapshot().allocs_by_job("hot")) == 2
+
+
 def test_upsert_job_preserves_create_index():
     s = StateStore()
     j = mock.job()

@@ -555,6 +555,58 @@ def test_real_batcher_passes_its_own_manifest():
     assert [f for f in findings if f.rule == "full-matrix-reship"] == []
 
 
+def test_reship_scopes_parallel_shard(tmp_path):
+    """parallel/ as a whole stays out of scope (mesh.py is the
+    sanctioned upload infrastructure), but the explicit shard_map
+    module IS scoped: a device_put creeping into parallel/shard.py
+    must flag."""
+    findings = run_on(tmp_path, RESHIP_BAD, name="shard.py",
+                      subdir="parallel")
+    assert rules_of(findings) == ["full-matrix-reship"] * 2
+
+
+def test_compression_plane_modules_are_raw_clean():
+    """The compression plane's zero-baseline self-check:
+    models/classes.py and parallel/shard.py carry no findings at all
+    AND no inline suppressions — their design premise is that no
+    transfer (or any other lint debt) lives there."""
+    paths = [os.path.join(REPO, "nomad_tpu", "models", "classes.py"),
+             os.path.join(REPO, "nomad_tpu", "parallel", "shard.py")]
+    findings = analyze_paths(paths)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    for p in paths:
+        with open(p) as fh:
+            assert "nta: disable" not in fh.read(), p
+
+
+def test_reship_manifest_globally_unique():
+    """The ONE sanctioned full-upload path stays unique: across every
+    module in the residency scope, the union of declared
+    NTA_REBUILD_ENTRYPOINTS manifests is exactly the batcher's rebuild
+    entry point. A second manifest anywhere (e.g. a class-expansion
+    helper sanctioning its own device_put) widens the steady-state
+    upload surface and must be a deliberate, reviewed change here."""
+    from nomad_tpu.analysis.core import Module
+    from nomad_tpu.analysis.residency import _in_scope, manifest_entries
+
+    entries = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "nomad_tpu")):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if not _in_scope(rel):
+                continue
+            with open(path) as fh:
+                mod = Module(path, rel, fh.read())
+            for ent in manifest_entries(mod):
+                entries.setdefault(ent, []).append(rel)
+    assert set(entries) == {"PlacementBatcher._build_device_base"}, entries
+    assert entries["PlacementBatcher._build_device_base"] == [
+        "nomad_tpu/scheduler/batcher.py"]
+
+
 # ---------------------------------------------------------------------
 # the tier-1 gate: whole tree clean modulo baseline, baseline
 # non-growing, concurrency-core dirs baseline-free
