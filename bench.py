@@ -3410,6 +3410,41 @@ CONCURRENCY_GATE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
                          "nomad_tpu/server/", "nomad_tpu/kernels/",
                          "nomad_tpu/migrate/", "nomad_tpu/defrag/",
                          "nomad_tpu/gang/")
+COMPILE_SURFACE_GATE_DIRS = ("nomad_tpu/ops/", "nomad_tpu/kernels/",
+                             "nomad_tpu/models/", "nomad_tpu/parallel/")
+
+
+def ntalint_compile_surface_gate():
+    """Compile-surface findings invalidate dense-path numbers before a
+    single device call runs: an unbucketed shape or a drifting static
+    key IS the recompile storm the jit_recompiles column would catch a
+    full bench rep later, and an unregistered jit entry point means
+    that column is blind. This gate runs FIRST under --check — pure
+    host AST work, so a compile-surface regression fails in ~1s
+    instead of after warmup. Whole-tree analysis (whole-program
+    rules), findings filtered to the jit-accounted dirs. Returns the
+    non-baselined findings."""
+    import os
+
+    from nomad_tpu.analysis import (
+        analyze_paths,
+        apply_baseline,
+        load_baseline,
+    )
+    from nomad_tpu.analysis.compile_surface import (
+        RULE_DONATION,
+        RULE_KEY_DRIFT,
+        RULE_UNBUCKETED,
+        RULE_UNREGISTERED,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    findings = analyze_paths(
+        [os.path.join(root, "nomad_tpu")],
+        rules={RULE_UNBUCKETED, RULE_KEY_DRIFT, RULE_UNREGISTERED,
+               RULE_DONATION, "parse-error"})
+    new, _stale = apply_baseline(findings, load_baseline())
+    return [f for f in new if f.path.startswith(COMPILE_SURFACE_GATE_DIRS)]
 
 
 def ntalint_purity_gate():
@@ -3479,9 +3514,11 @@ def main():
                         help="interleaved CPU/TPU repetitions per config;"
                              " medians + IQR are reported")
     parser.add_argument("--check", action="store_true",
-                        help="run the ntalint trace-purity gate over "
-                             "ops/ and scheduler/ first; refuse to "
-                             "report dense-path numbers on findings")
+                        help="run the ntalint compile-surface gate "
+                             "(jit-cache bounding / shape buckets), "
+                             "then the trace-purity and concurrency "
+                             "gates, before any device warmup; refuse "
+                             "to report dense-path numbers on findings")
     parser.add_argument("--chaos", type=int, default=None, metavar="SEED",
                         help="run config 4 clean AND under a mild seeded "
                              "fault schedule (nomad_tpu/chaos); reports "
@@ -3599,6 +3636,18 @@ def main():
         get_profiler().ensure_sampler()
 
     if args.check:
+        bad = ntalint_compile_surface_gate()
+        if bad:
+            for f in bad:
+                print(f.render(), file=sys.stderr)
+            print(f"bench: REFUSING to report dense-path numbers: "
+                  f"{len(bad)} compile-surface finding(s) in ops//"
+                  f"kernels//models//parallel/ — the jit cache is no "
+                  f"longer statically bounded (fix them or run "
+                  f"without --check)", file=sys.stderr)
+            sys.exit(2)
+        print("bench: ntalint compile-surface gate clean",
+              file=sys.stderr)
         bad = ntalint_purity_gate()
         if bad:
             for f in bad:

@@ -26,6 +26,16 @@ Checker families, run over `nomad_tpu/` as a tier-1 test
 - ``protocol`` — the raft funnel: state-store mutators and terminal
   status/trigger stamps only inside (or flowing into) the funnels an
   `NTA_RAFT_FUNNELS` manifest declares.
+- ``compile_surface`` — the jit cache is statically bounded:
+  data-dependent shapes route through registered bucket functions
+  before they can reach a jitted entry point (`unbucketed-shape`),
+  static args at jitted call sites are stable keys, not per-eval
+  builds (`static-key-drift`), every compiled entry point in
+  ops//kernels//models//parallel/ is accounted by
+  `ops/binpack.py jit_cache_size()` via the `NTA_JIT_ACCOUNTED`
+  manifest (`unregistered-jit`), and no buffer is read after being
+  passed in a donated position (`donation-unsafe-read` — the rail
+  for ROADMAP item 3's donated cohort programs).
 
 All manifest rules share ONE definition of "reachable from":
 `core.Program`, the cross-module call graph (imports, module-attr
@@ -64,4 +74,53 @@ ALL_RULES = (
     "full-matrix-reship",
     "deadlock-cycle",
     "raft-funnel",
+    "unbucketed-shape",
+    "static-key-drift",
+    "unregistered-jit",
+    "donation-unsafe-read",
 )
+
+# One-line docs per rule, emitted as SARIF driver rule metadata by
+# tools/ntalint.py. tests/test_static_analysis.py asserts this table
+# covers ALL_RULES exactly — a new rule that forgets its entry fails
+# tier-1 (the generalized fix for the PR 7 full-matrix-reship SARIF
+# omission).
+RULE_DOCS = {
+    "parse-error": "file does not parse (mid-edit tree, --diff)",
+    "guarded-by": "attribute with a '# guarded-by:' contract accessed "
+                  "outside its lock",
+    "lock-blocking-call": "blocking call while holding a hot lock",
+    "dispatcher-blocking-call": "blocking call reachable from an "
+                                "NTA_DISPATCHER_ENTRYPOINTS entry",
+    "trace-impure-call": "RNG/clock/IO inside traced code runs at "
+                         "trace time only",
+    "trace-host-sync": "device->host materialization inside traced "
+                       "code",
+    "trace-closure-mutation": "closed-over state mutated inside "
+                              "traced code",
+    "trace-python-branch": "Python branch on a traced value",
+    "jit-unhashable-static": "unhashable literal in a jitted static "
+                             "position",
+    "live-state-read": "scheduler/dispatch read of live state instead "
+                       "of a snapshot handle",
+    "unbounded-wait": "no-timeout wait/get/join on a control-plane "
+                      "path",
+    "swallowed-exception": "broad exception handler with an empty "
+                           "body",
+    "record-path-blocking": "blocking call or unbounded growth on the "
+                            "flight-recorder record path",
+    "full-matrix-reship": "full-matrix device reship outside "
+                          "NTA_REBUILD_ENTRYPOINTS",
+    "deadlock-cycle": "cycle in the whole-program lock acquisition "
+                      "order",
+    "raft-funnel": "state mutation outside the NTA_RAFT_FUNNELS "
+                   "funnels",
+    "unbucketed-shape": "data-dependent array shape escapes toward a "
+                        "jitted entry point without a bucket function",
+    "static-key-drift": "per-eval static arg (f-string/computed "
+                        "value/fresh tuple) at a jitted call site",
+    "unregistered-jit": "compiled entry point absent from the "
+                        "NTA_JIT_ACCOUNTED jit_cache_size() manifest",
+    "donation-unsafe-read": "buffer read after being passed in a "
+                            "donated argument position",
+}

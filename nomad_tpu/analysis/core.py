@@ -50,7 +50,7 @@ _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 # Bump whenever any rule's behavior changes: every cache key includes
 # it, so a stale on-disk cache from an older rule set can never mask a
 # new finding (or resurrect a fixed one).
-RULESET_VERSION = "8.0-profiled-locks"
+RULESET_VERSION = "9.0-compile-surface"
 
 
 class Finding:
@@ -860,8 +860,8 @@ def analyze_paths(paths: List[str],
     Local rules come from the per-file cache when (sha, registry
     digest) match; program rules from the tree-digest cache when no
     analyzed file changed."""
-    from . import (deadlock, locks, protocol, purity, residency,
-                   robustness, snapshot)
+    from . import (compile_surface, deadlock, locks, protocol, purity,
+                   residency, robustness, snapshot)
 
     files = _iter_py_files(paths)
     loaded = [(_load_file(f)) for f in files]
@@ -903,7 +903,9 @@ def analyze_paths(paths: List[str],
     # building the cross-module graph to discard its findings is the
     # most expensive no-op in the suite.
     program_rules = {"dispatcher-blocking-call", "record-path-blocking",
-                     "unbounded-wait", "deadlock-cycle", "raft-funnel"}
+                     "unbounded-wait", "deadlock-cycle", "raft-funnel",
+                     "unbucketed-shape", "static-key-drift",
+                     "unregistered-jit", "donation-unsafe-read"}
     if rules is not None and not (rules & program_rules):
         findings = [f for f in findings if f.rule in rules]
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -917,6 +919,7 @@ def analyze_paths(paths: List[str],
         raw.extend(robustness.program_check(program))
         raw.extend(deadlock.program_check(program))
         raw.extend(protocol.program_check(program))
+        raw.extend(compile_surface.program_check(program))
         prog_findings = [f for f in raw
                          if not _suppressed(by_rel.get(f.path), f)]
         _PROGRAM_CACHE[pkey] = prog_findings

@@ -59,6 +59,10 @@ MAX_SOLVE_ALLOCS = 512
 # placement path) so steady-state churn in the movable count reuses
 # one compiled program per bucket.
 K_BUCKETS = [16, 32, 64, 128, 256, MAX_SOLVE_ALLOCS]
+# Registered sizer for ntalint's `unbucketed-shape` rule (_k_bucket is
+# also sanctioned structurally — it returns a bucket_size call — but
+# the manifest keeps the sanction explicit; see models/topology.py).
+NTA_BUCKET_FNS = ("_k_bucket",)
 # Class-compressed solve (models/classes.py): past this fleet size,
 # when the signature interning compresses at least this much, the
 # relaxed program runs over x[K, C] instead of x[K, N] and expands

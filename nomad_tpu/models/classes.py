@@ -98,8 +98,14 @@ class ClassIndex:
     def __init__(self, nodes: List[Node], n_pad: Optional[int] = None):
         n_real = len(nodes)
         self.n_real = n_real
-        self.ids = np.full(n_pad if n_pad is not None else n_real,
-                           -1, np.int32)
+        if n_pad is None:
+            # Default-sized builds land on the node bucket ladder: a
+            # raw len(nodes) shape here becomes a per-N compile key
+            # the moment ids rides a device program (ntalint
+            # unbucketed-shape). Lazy import: matrix.py imports us.
+            from .matrix import BUCKETS, bucket_size
+            n_pad = bucket_size(max(n_real, 1), BUCKETS)
+        self.ids = np.full(n_pad, -1, np.int32)
         self.reps: List[int] = []
         self.signatures: List[Optional[Tuple]] = []
         counts: List[int] = []

@@ -663,6 +663,29 @@ def uniform_dh_flag(placements, job_dh, tg_dh) -> bool:
 # jit_recompiles column (whose --check gate refuses dense numbers when
 # it moves after warmup).
 
+# The static mirror of _jit_entry_points() + the parallel/shard.py
+# factory caches, enforced two ways: ntalint's `unregistered-jit` rule
+# flags any jit/lru_cache site in ops//kernels//models//parallel/
+# missing from this manifest, and tests/test_compile_surface.py diffs
+# it against both the AST scan and the runtime tuple below — the
+# static rule and jit_cache_size() accounting can never disagree.
+NTA_JIT_ACCOUNTED = (
+    "placement_program_jit",
+    "batched_placement_program",
+    "batched_placement_program_shared",
+    "batched_placement_program_overlay",
+    "batched_placement_program_compact",
+    "batched_placement_program_compact_delta",
+    "apply_base_delta",
+    "device_resident",
+    "preempt_placement_program_jit",
+    "gang_placement_program_jit",
+    # parallel/shard.py program factories, accounted via
+    # shard_cache_size() (one compile per (mesh, pad) build key).
+    "sharded_base_delta",
+    "sharded_group_capacity",
+)
+
 _JIT_ENTRY_POINTS = ()
 
 

@@ -28,6 +28,11 @@ scheduler/batcher.py's rebuild entry point or parallel/mesh.py.
 Programs are cached per mesh (and static shape knobs) and registered in
 ops/binpack.py's jit accounting via ``shard_cache_size()`` — the
 steady-state-recompiles-0 contract covers the sharded programs too.
+The factory names are declared in binpack's ``NTA_JIT_ACCOUNTED``
+manifest, so ntalint's `unregistered-jit` rule holds this module's
+nested ``jax.jit`` sites to that accounting statically
+(tests/test_compile_surface.py diffs manifest, AST scan, and the
+runtime registry both ways).
 """
 
 from __future__ import annotations

@@ -139,6 +139,12 @@ class _Request:
 ROW_BUCKETS = (256, 4096)
 BATCH_BUCKETS = (4, 16, 64)
 
+# Registered sizers for ntalint's `unbucketed-shape` rule: these two
+# ARE this module's bucket functions (hand-rolled ladders over the
+# tuples above, with a deliberate pow2 overflow fallback), so shapes
+# they produce are sanctioned the same as matrix.py bucket_size.
+NTA_BUCKET_FNS = ("_pad_rows", "_pad_batch")
+
 
 def _pad_rows(rows) -> np.ndarray:
     """Pad a changed-row index list up to a ladder bucket; padding

@@ -231,3 +231,30 @@ def test_drain_and_readiness_stay_out_of_the_signature():
     a.status = consts.NODE_STATUS_DOWN
     a.compute_class()
     assert node_signature(a) == before
+
+
+def test_default_build_lands_on_node_bucket_ladder():
+    """A default-sized ClassIndex (no explicit n_pad) pads `ids` up
+    the node bucket ladder instead of raw len(nodes): a raw shape
+    here becomes a per-N compile key the moment ids rides a device
+    program (the ntalint `unbucketed-shape` finding PR 17 fixed).
+    All class-granular views stay keyed on n_real, so the padding is
+    invisible to consumers."""
+    from nomad_tpu.models.matrix import BUCKETS, bucket_size
+
+    rng = random.Random(7)
+    nodes = _template_nodes(rng, n_templates=3, copies=4)
+    idx = ClassIndex(nodes)
+    assert idx.n_real == len(nodes)
+    assert len(idx.ids) == bucket_size(len(nodes), BUCKETS)
+    assert (idx.ids[: idx.n_real] >= 0).all()
+    assert (idx.ids[idx.n_real:] == -1).all()
+    # members() partitions exactly the REAL rows, padding excluded.
+    seen = np.concatenate([idx.members(c) for c in range(idx.n_classes)])
+    assert sorted(seen.tolist()) == list(range(len(nodes)))
+    # An explicitly-padded build of the same fleet agrees on the reals.
+    explicit = ClassIndex(nodes, len(idx.ids))
+    assert np.array_equal(idx.ids, explicit.ids)
+    # Empty fleet: still a ladder shape, zero real rows.
+    empty = ClassIndex([])
+    assert empty.n_real == 0 and len(empty.ids) == bucket_size(1, BUCKETS)

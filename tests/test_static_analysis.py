@@ -2058,3 +2058,126 @@ def drop_quietly(alloc):
     assert any(f.rule == "raft-funnel"
                and "ALLOC_DESIRED_EVICT" in f.message for f in findings), (
         [f.render() for f in findings])
+
+
+# ---------------------------------------------------------------------
+# PR 17: ruleset-version skew, SARIF rule-table completeness, and the
+# --diff CLI gate.
+
+
+def test_old_version_disk_cache_primes_nothing_and_is_rewritten():
+    """The disk cache keys on RULESET_VERSION: a cache written by an
+    OLD ruleset must prime NOTHING (its entries were computed by rules
+    that no longer exist / have different semantics), and the next
+    save must rewrite the file clean under the current version. The
+    poison probe: every cached entry is doctored to claim a fabricated
+    finding — if the stale cache primed anything, analysis would
+    report it."""
+    import tempfile
+
+    from nomad_tpu.analysis import (RULESET_VERSION, clear_caches,
+                                    load_disk_cache, save_disk_cache)
+
+    target = os.path.join(REPO, "nomad_tpu", "trace")
+    poison = {"rule": "guarded-by", "path": "nomad_tpu/poisoned.py",
+              "line": 1, "col": 0, "message": "stale-cache ghost",
+              "symbol": "", "related": []}
+    with tempfile.TemporaryDirectory() as td:
+        cache_file = os.path.join(td, "cache.json")
+        try:
+            clear_caches()
+            before = [f.render() for f in analyze_paths([target])]
+            save_disk_cache(cache_file)
+            with open(cache_file, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            assert data["version"] == RULESET_VERSION
+            data["version"] = "0.0-stale"
+            for ent in data["local"].values():
+                ent["findings"] = [dict(poison)]
+            data["program"] = {d: [dict(poison)]
+                               for d in data.get("program", {})}
+            with open(cache_file, "w", encoding="utf-8") as fh:
+                json.dump(data, fh)
+            clear_caches()
+            load_disk_cache(cache_file)
+            after = [f.render() for f in analyze_paths([target])]
+            assert after == before  # no ghost: stale cache primed nothing
+            save_disk_cache(cache_file)
+            with open(cache_file, "r", encoding="utf-8") as fh:
+                rewritten = json.load(fh)
+            assert rewritten["version"] == RULESET_VERSION
+            assert not any(
+                ent["findings"] for ent in rewritten["local"].values())
+        finally:
+            clear_caches()
+
+
+def test_rule_docs_cover_all_rules_exactly():
+    """Every rule has a RULE_DOCS entry and no entry is stale — the
+    generalized fix for the PR 7 SARIF rule-list omission: a new rule
+    that forgets its one-liner fails tier-1 here."""
+    from nomad_tpu.analysis import ALL_RULES, RULE_DOCS
+
+    assert set(RULE_DOCS) == set(ALL_RULES)
+    assert all(isinstance(v, str) and v for v in RULE_DOCS.values())
+
+
+def test_sarif_driver_rule_table_complete():
+    """The SARIF driver advertises EVERY rule with its doc — CI
+    annotation surfaces key on this table."""
+    import importlib.util
+
+    from nomad_tpu.analysis import ALL_RULES, RULE_DOCS
+
+    spec = importlib.util.spec_from_file_location(
+        "ntalint_cli_probe", os.path.join(REPO, "tools", "ntalint.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    driver = cli._to_sarif([])["runs"][0]["tool"]["driver"]
+    assert [r["id"] for r in driver["rules"]] == list(ALL_RULES)
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"] == RULE_DOCS[r["id"]]
+
+
+def test_cli_diff_gate_clean_tree_exits_zero():
+    """`python tools/ntalint.py --diff` IS the tier-1 pre-commit gate:
+    on the current work tree it must exit 0 (json and sarif modes
+    agree) — any new finding in the changed call-graph region fails
+    the suite right here."""
+    base = [sys.executable, os.path.join(REPO, "tools", "ntalint.py"),
+            "--diff", "--no-cache"]
+    res = subprocess.run(base, capture_output=True, text=True,
+                         timeout=300, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = subprocess.run(base + ["--json"], capture_output=True,
+                         text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = json.loads(res.stdout)
+    assert out["findings"] == []
+    res = subprocess.run(base + ["--sarif"], capture_output=True,
+                         text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert json.loads(res.stdout)["runs"][0]["results"] == []
+
+
+def test_cli_diff_flags_new_finding_in_changed_region():
+    """The exit-1 arm: an untracked file with a finding is inside the
+    changed region, so --diff must report it and fail — in SARIF mode
+    too (the satellite regression: every output mode gates)."""
+    probe = os.path.join(REPO, "nomad_tpu", "_diff_smoke_fixture.py")
+    assert not os.path.exists(probe)
+    try:
+        with open(probe, "w", encoding="utf-8") as fh:
+            fh.write(GUARDED_BAD)
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ntalint.py"),
+             "--diff", "--no-cache", "--sarif"],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert res.returncode == 1, res.stdout + res.stderr
+        results = json.loads(res.stdout)["runs"][0]["results"]
+        assert {r["ruleId"] for r in results} == {"guarded-by"}
+        uris = {r["locations"][0]["physicalLocation"]
+                 ["artifactLocation"]["uri"] for r in results}
+        assert uris == {"nomad_tpu/_diff_smoke_fixture.py"}
+    finally:
+        os.unlink(probe)

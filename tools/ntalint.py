@@ -37,6 +37,7 @@ sys.path.insert(0, _ROOT)
 
 from nomad_tpu.analysis import (  # noqa: E402
     ALL_RULES,
+    RULE_DOCS,
     RULESET_VERSION,
     analyze_paths,
     apply_baseline,
@@ -129,7 +130,9 @@ def _to_sarif(findings) -> dict:
             "tool": {"driver": {
                 "name": "ntalint",
                 "version": RULESET_VERSION,
-                "rules": [{"id": r} for r in ALL_RULES],
+                "rules": [{"id": r,
+                           "shortDescription": {"text": RULE_DOCS[r]}}
+                          for r in ALL_RULES],
             }},
             "results": results,
         }],
