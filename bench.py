@@ -1878,6 +1878,312 @@ def run_overload(seed, n_nodes=400, probe_jobs=24, window_s=6.0, cap=16):
     }
 
 
+def _read_storm_server(mux_enabled, scoped, n_watchers):
+    """Live server + HTTP front end for one read-storm arm. Mux ON +
+    scoped is the shipping read plane (parked continuations, zero
+    handler threads, per-scope wakes); OFF + global is the pre-PR-19
+    baseline kept reachable for the A/B — a thread per blocking query,
+    woken by ANY commit."""
+    from nomad_tpu.api import HTTPServer
+    from nomad_tpu.server import Server, ServerConfig
+
+    cfg = ServerConfig(
+        num_schedulers=1,
+        eval_nack_timeout=60.0,
+        read_mux_enabled=mux_enabled,
+        read_scoped_index=scoped,
+        read_mux_max_parked=max(4096, 4 * n_watchers))
+    server = Server(cfg)
+    server.start()
+    http = HTTPServer(server)
+    http.start()
+    host, port = http.addr.split("//")[1].split(":")
+    return server, http, host, int(port)
+
+
+def _read_storm_park(host, port, path):
+    import socket as _socket
+
+    s = _socket.create_connection((host, port), timeout=90)
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: bench\r\n\r\n".encode())
+    return s
+
+
+def _read_storm_recv(sock, timeout=15.0):
+    """Read one HTTP response off a parked socket; returns
+    (status_ok, payload_bytes). Reads headers + Content-Length bytes
+    rather than draining to EOF — the mux serve thunk closes the
+    connection but the thread-park baseline answers over keep-alive
+    and would block an EOF reader until the socket times out."""
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    head, _, payload = buf.partition(b"\r\n\r\n")
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        key, _, val = line.partition(b":")
+        if key.strip().lower() == b"content-length":
+            clen = int(val.strip())
+    while len(payload) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        payload += chunk
+    try:
+        status = int(head.split(b"\r\n", 1)[0].split()[1])
+    except (IndexError, ValueError):
+        status = 0
+    return status == 200, payload
+
+
+def _read_storm_mode_ab(addr, n=150):
+    """Stale-vs-consistent read latency A/B against the same (leader)
+    server: `?stale` serves straight from the local snapshot, while
+    `?consistent` first waits for the FSM to reach the last known
+    commit index (a no-op barrier on the leader, a real wait on a
+    follower)."""
+    import urllib.request
+
+    out = {}
+    for mode in ("stale", "consistent"):
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(
+                    f"{addr}/v1/jobs?{mode}", timeout=10.0) as resp:
+                resp.read()
+            lat.append(time.perf_counter() - t0)
+        out[mode] = {
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 2),
+        }
+    return out
+
+
+def _read_storm_plain_reads(addr, n=200, stop=None, lat=None):
+    """`n` non-blocking /v1/jobs reads (or until `stop` is set when
+    given); appends latencies (s) to `lat` and returns it."""
+    import urllib.request
+
+    lat = [] if lat is None else lat
+    for _ in range(n):
+        if stop is not None and stop.is_set():
+            break
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(f"{addr}/v1/jobs", timeout=10.0) as r:
+            r.read()
+        lat.append(time.perf_counter() - t0)
+        if stop is not None:
+            time.sleep(0.002)
+    return lat
+
+
+def _read_storm_arm(mux_enabled, scoped, n_watchers, wait_s, rounds):
+    """One read-storm arm: park `n_watchers` blocking queries on
+    disjoint alloc_job scopes, measure the parked-thread footprint,
+    then run `rounds` waves of scope writes (one writer client per
+    10 watchers) with a concurrent plain reader, and time each
+    wake-to-serve. The untouched sockets are then polled for spurious
+    responses — on the scoped arm that must be none; on the
+    global-index baseline EVERY commit satisfies every watcher, so
+    the ratio reads ~1.0. The stale/consistent A/B runs on the mux
+    arm under the still-parked load."""
+    import select
+    import threading as _threading
+
+    from nomad_tpu import mock
+
+    server, http, host, port = _read_storm_server(
+        mux_enabled, scoped, n_watchers)
+    socks = []
+    out = {"mux_enabled": mux_enabled, "scoped_index": scoped,
+           "watchers": n_watchers}
+    try:
+        state = server.fsm.state
+        # Seed one commit so the first scope write lands at index >= 2:
+        # a write AT the watchers' ?index=1 is correctly not-newer and
+        # must not wake anyone — keep it out of the measurement.
+        server.log.apply("node_register", {"node": mock.node()})
+        idle = _read_storm_plain_reads(http.addr)
+        out["read_idle_p99_ms"] = round(
+            float(np.percentile(idle, 99)) * 1000, 2)
+        thread_floor = _threading.active_count()
+        for i in range(n_watchers):
+            socks.append(_read_storm_park(
+                host, port,
+                f"/v1/job/rs-{i}/allocations?index=1&wait={wait_s}"))
+
+        deadline = time.perf_counter() + 30.0
+        if mux_enabled:
+            while (server.read_mux.stats()["parked"] < n_watchers
+                   and time.perf_counter() < deadline):
+                time.sleep(0.02)
+            if server.read_mux.stats()["parked"] < n_watchers:
+                raise TimeoutError("read-storm watchers never parked")
+            # Handler threads unwind once the socket is detached; give
+            # the last few a moment before reading the footprint.
+            settle = time.perf_counter() + 10.0
+            while (_threading.active_count() > thread_floor + 8
+                   and time.perf_counter() < settle):
+                time.sleep(0.05)
+        else:
+            # Thread-park baseline: every watcher HOLDS its handler
+            # thread, so the footprint itself is the settle signal.
+            while (_threading.active_count() - thread_floor < n_watchers
+                   and time.perf_counter() < deadline):
+                time.sleep(0.02)
+        out["parked_thread_delta"] = (_threading.active_count()
+                                      - thread_floor)
+
+        n_writers = max(1, n_watchers // 10)
+        wlock = _threading.Lock()
+        results = {}
+
+        def wake_client(slot):
+            a = mock.alloc()
+            a.job_id = f"rs-{slot}"
+            with wlock:
+                t0 = time.perf_counter()
+                state.upsert_allocs(state.latest_index() + 1, [a])
+            try:
+                ok, _payload = _read_storm_recv(socks[slot])
+            except OSError:
+                ok = False
+            results[slot] = (time.perf_counter() - t0, ok)
+
+        # Plain reads keep flowing while the write waves run — the
+        # read-under-churn column the idle figure baselines.
+        churn_stop = _threading.Event()
+        churn_lat = []
+        reader = _threading.Thread(
+            target=_read_storm_plain_reads, name="rs-reader",
+            args=(http.addr, 100000, churn_stop, churn_lat))
+        reader.start()
+        woken = 0
+        try:
+            for r in range(rounds):
+                clients = [
+                    _threading.Thread(target=wake_client,
+                                      args=(r * n_writers + j,),
+                                      name=f"rs-client-{r}-{j}")
+                    for j in range(n_writers)]
+                for t in clients:
+                    t.start()
+                for t in clients:
+                    t.join(timeout=30.0)
+                woken += n_writers
+        finally:
+            churn_stop.set()
+            reader.join(timeout=15.0)
+        out["read_churn_p99_ms"] = round(
+            float(np.percentile(churn_lat, 99)) * 1000, 2) if churn_lat \
+            else None
+
+        lat = [s for s, ok in results.values() if ok]
+        out["write_clients"] = n_writers
+        out["wakes"] = woken
+        out["wake_failures"] = woken - len(lat)
+        out["wake_to_serve_p50_ms"] = round(
+            float(np.percentile(lat, 50)) * 1000, 2) if lat else None
+        out["wake_to_serve_p99_ms"] = round(
+            float(np.percentile(lat, 99)) * 1000, 2) if lat else None
+
+        # Spurious check, client side: the remaining sockets watch
+        # scopes nothing wrote — any readable one got a response whose
+        # body cannot have changed (a spurious wake). Scoped arm: must
+        # be none. Global-index arm: every commit satisfied every
+        # watcher, so expect ~all of them. Settle first, THEN count:
+        # select returns on the FIRST readable fd, and the thread-park
+        # baseline answers at its 1s re-check boundary — an immediate
+        # select would tally only the earliest arrivals.
+        time.sleep(1.5)
+        remaining = socks[woken:]
+        readable, _, _ = select.select(remaining, [], [], 0.2)
+        out["spurious_responses"] = len(readable)
+        out["spurious_ratio"] = round(
+            len(readable) / max(1, len(remaining)), 4)
+        if mux_enabled:
+            out["mode_ab"] = _read_storm_mode_ab(http.addr)
+            out["mux"] = server.read_mux.stats()
+        return out
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        http.stop()
+        server.shutdown()
+
+
+def run_read_storm(n_watchers=200, check=False):
+    """Read-plane storm A/B (the quantitative twin of
+    tests/test_readplane.py's storm): park N blocking queries on
+    disjoint scopes with one write client per 10 watchers, mux ON vs
+    the thread-park baseline. ON must hold an O(1) parked-thread
+    footprint and zero spurious wakes; OFF shows the thread-per-
+    watcher scaling the mux removes. With --check, refuses numbers
+    when the spurious ratio exceeds 1% or the mux footprint scales
+    with the watcher count."""
+    on = _read_storm_arm(True, True, n_watchers, wait_s=60, rounds=5)
+    base_watchers = n_watchers
+    off = _read_storm_arm(False, False, base_watchers, wait_s=30,
+                          rounds=1)
+
+    churn_x = (round(on["read_churn_p99_ms"] / on["read_idle_p99_ms"], 2)
+               if on.get("read_churn_p99_ms") and on.get("read_idle_p99_ms")
+               else None)
+    out = {
+        "metric": (
+            f"[read-storm n={n_watchers}] mux+scoped ON: parked-thread "
+            f"delta {on['parked_thread_delta']} (O(1)), wake p99 "
+            f"{on['wake_to_serve_p99_ms']}ms, spurious "
+            f"{on['spurious_ratio']:.4f}, churn/idle read p99 x"
+            f"{churn_x}; thread-park global-index OFF: delta "
+            f"{off['parked_thread_delta']} (~1/watcher), spurious "
+            f"{off['spurious_ratio']:.4f}"
+        ),
+        "watchers": n_watchers,
+        "read_churn_over_idle_p99": churn_x,
+        "mux_on": on,
+        "threadpark_off": off,
+    }
+    if check:
+        if on["spurious_ratio"] > 0.01 or on["mux"]["spurious"] > 0:
+            print(f"bench: REFUSING read-storm numbers: spurious wake "
+                  f"ratio {on['spurious_ratio']} (client) / "
+                  f"{on['mux']['spurious']} (mux) exceeds the 1% "
+                  f"budget — scope routing is waking watchers whose "
+                  f"scope did not move", file=sys.stderr)
+            sys.exit(2)
+        if on["parked_thread_delta"] > 8:
+            print(f"bench: REFUSING read-storm numbers: mux arm held "
+                  f"{on['parked_thread_delta']} extra threads with "
+                  f"{n_watchers} parked watchers — the parked-watcher "
+                  f"footprint must be O(1), not O(watchers)",
+                  file=sys.stderr)
+            sys.exit(2)
+        if off["parked_thread_delta"] < base_watchers // 2:
+            print(f"bench: REFUSING read-storm numbers: the thread-"
+                  f"park baseline held only "
+                  f"{off['parked_thread_delta']} threads for "
+                  f"{base_watchers} watchers — the A/B's OFF arm is "
+                  f"not measuring the pre-mux behaviour",
+                  file=sys.stderr)
+            sys.exit(2)
+        if on["wake_failures"] or off["wake_failures"]:
+            print(f"bench: REFUSING read-storm numbers: "
+                  f"{on['wake_failures']} (ON) / "
+                  f"{off['wake_failures']} (OFF) written scopes never "
+                  f"served their watcher", file=sys.stderr)
+            sys.exit(2)
+    return out
+
+
 def _shed_gate(out, n):
     """--check: a NON-overload config that shed or expired evals was
     measured while the server protected itself — its dense-path
@@ -3603,6 +3909,18 @@ def main():
                              "With --check, refuses numbers on "
                              "steady-state recompiles > 0, ratio < 2x, "
                              "or a 100k p99 past 2x the 10k figure")
+    parser.add_argument("--read-storm", action="store_true",
+                        help="read-plane storm A/B (nomad_tpu/readplane):"
+                             " park N blocking queries on disjoint "
+                             "scopes with 1 write client per 10 "
+                             "watchers, mux vs thread-park baseline — "
+                             "parked-thread footprint, wake-to-serve "
+                             "p99, spurious ratio, stale-vs-consistent "
+                             "read latency. With --check, refuses "
+                             "numbers on spurious > 1% or a mux "
+                             "footprint that scales with watchers")
+    parser.add_argument("--read-storm-watchers", type=int, default=200,
+                        help="parked watchers per read-storm arm")
     parser.add_argument("--no-trace", action="store_true",
                         help="disable the eval-lifecycle flight recorder "
                              "(nomad_tpu/trace) for this run — the A/B "
@@ -3750,6 +4068,11 @@ def main():
                       file=sys.stderr)
                 sys.exit(2)
         print(json.dumps(out))
+        return
+
+    if args.read_storm:
+        print(json.dumps(run_read_storm(
+            n_watchers=args.read_storm_watchers, check=args.check)))
         return
 
     if args.chaos is not None:

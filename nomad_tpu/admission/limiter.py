@@ -129,6 +129,10 @@ class AdmissionController:
 
     def __init__(self, server, config):
         self.enabled = bool(config.admission_enabled)
+        # Kept for the read-degradation probe: a red-limited read can
+        # be downgraded to a stale local-replica serve instead of a 429
+        # when this server has replica state to serve from.
+        self._server = server
         self.pressure = PressureMonitor(server, config)
         self._write = TokenBucket(config.admission_write_rate,
                                   config.admission_write_burst)
@@ -162,11 +166,13 @@ class AdmissionController:
     # ---------------------------------------------------------- checks
 
     def check_http(self, method: str, path: str,
-                   handler_name: str = "") -> None:
-        """Admission gate for one HTTP request: returns on admit,
-        raises AdmissionRejected on shed/limit."""
+                   handler_name: str = "") -> Optional[str]:
+        """Admission gate for one HTTP request: returns None on admit,
+        returns the verdict "stale" to degrade a red-pressure read to
+        stale local-replica serving, raises AdmissionRejected on
+        shed/limit."""
         if not self.enabled:
-            return
+            return None
         route_class = classify_http(method, path, handler_name)
         if route_class == ROUTE_EXEMPT:
             return
@@ -192,10 +198,29 @@ class AdmissionController:
         if level == LEVEL_RED:
             ok, retry = self._read.try_acquire()
             if not ok:
+                if self._has_replica_state():
+                    # Degrade, don't deny: over-budget red reads serve
+                    # the local replica in stale mode (http.py injects
+                    # ?stale and stamps X-Nomad-Degraded) — a bounded-
+                    # staleness answer beats a 429 when state exists.
+                    return "stale"
                 self._reject_http()
                 raise AdmissionRejected(
                     429, "read rate limited (pressure red)",
                     max(retry, 0.05))
+        return None
+
+    def _has_replica_state(self) -> bool:
+        """True when this server holds a replica snapshot worth serving
+        stale reads from. The getattr chain tolerates the stub servers
+        tests hand to AdmissionController (no fsm → old 429 path)."""
+        state = getattr(getattr(self._server, "fsm", None), "state", None)
+        if state is None:
+            return False
+        try:
+            return state.latest_index() > 0
+        except Exception:  # noqa: BLE001
+            return False
 
     def check_rpc(self, kind: str) -> None:
         """Admission gate for one transport RPC frame. Raft consensus

@@ -67,11 +67,11 @@ RULE_RECORD_PATH = "record-path-blocking"
 
 WAIT_SCOPE_MARKERS = ("/server/", "/dispatch/", "/trace/",
                       "/admission/", "/scheduler/", "/migrate/",
-                      "/profile/", "/defrag/", "/gang/",
+                      "/profile/", "/defrag/", "/gang/", "/readplane/",
                       "/models/classes", "/parallel/shard")
 SWALLOW_SCOPE_MARKERS = ("/server/", "/dispatch/", "/client/", "/trace/",
                          "/admission/", "/migrate/", "/profile/",
-                         "/defrag/", "/gang/",
+                         "/defrag/", "/gang/", "/readplane/",
                          "/models/classes", "/parallel/shard")
 
 # Attribute calls that block forever when called with no timeout.

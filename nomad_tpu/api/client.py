@@ -24,8 +24,18 @@ class APIError(Exception):
 
 class Client:
     def __init__(self, address: str, timeout: float = 305.0, region: str = "",
-                 ssl_context=None):
+                 ssl_context=None, consistency: str = "default"):
         self.timeout = timeout
+        # Read-consistency mode stamped on every query (api.go
+        # QueryOptions.AllowStale): "stale" serves the contacted
+        # replica's local state immediately (X-Nomad-LastContact
+        # bounds the staleness), "consistent" makes a follower catch
+        # up to the leader's commit index first, "default" keeps the
+        # server's standard semantics. Per-call override via
+        # _query_params(stale=/consistent=).
+        if consistency not in ("default", "stale", "consistent"):
+            raise ValueError(f"unknown consistency mode {consistency!r}")
+        self.consistency = consistency
         self._ssl_context = ssl_context
         self._address = ""
         self._addr_lock = threading.Lock()
@@ -78,6 +88,14 @@ class Client:
             else:
                 params = dict(params or {})
                 params.setdefault("region", self.region)
+        mode = self.consistency
+        if mode in ("stale", "consistent"):
+            if isinstance(params, list):
+                if not any(k == mode for k, _ in params):
+                    params = params + [(mode, "true")]
+            else:
+                params = dict(params or {})
+                params.setdefault(mode, "true")
         if params:
             path += "?" + urllib.parse.urlencode(params)
         return path
@@ -138,12 +156,18 @@ class Client:
         return self._request("DELETE", path)
 
 
-def _query_params(index: Optional[int], wait: Optional[float]) -> Dict[str, str]:
+def _query_params(index: Optional[int], wait: Optional[float],
+                  stale: bool = False,
+                  consistent: bool = False) -> Dict[str, str]:
     params: Dict[str, str] = {}
     if index is not None:
         params["index"] = str(index)
     if wait is not None:
         params["wait"] = str(wait)
+    if stale:
+        params["stale"] = "true"
+    if consistent:
+        params["consistent"] = "true"
     return params
 
 

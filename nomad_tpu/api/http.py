@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import re
+import socket
 import threading
 import time
 import urllib.error
@@ -34,6 +35,46 @@ class HTTPError(Exception):
         super().__init__(message)
         self.status = status
         self.message = message
+
+
+class JSONResponse:
+    """JSON reply carrying an explicit X-Nomad-Index and extra headers
+    — how blocking reads report their watch SCOPE's modify index (not
+    the global raft index) plus staleness / effective-wait headers
+    through _dispatch. index=None falls back to the global index."""
+
+    __slots__ = ("body", "index", "headers")
+
+    def __init__(self, body, index: Optional[int] = None, headers=None):
+        self.body = body
+        self.index = index
+        self.headers = dict(headers) if headers else {}
+
+
+class _ParkSignal(Exception):
+    """Raised out of _blocking to hand a long-poll to the read mux:
+    _dispatch catches it, registers the continuation (readplane/
+    mux.py), and detaches the client socket so the handler thread can
+    exit — a parked watcher holds no thread. Falls back to the
+    thread-parking loop when the mux refuses (full or stopped)."""
+
+    def __init__(self, items, min_index: int, deadline: float, run,
+                 headers):
+        super().__init__("blocking query parked")
+        self.items = items
+        self.min_index = min_index
+        self.deadline = deadline
+        self.run = run
+        self.headers = headers
+
+
+def _qflag(query, name: str) -> bool:
+    """True when `?name` is present bare or with a truthy value (both
+    `?stale` and `?stale=true` select the mode, like the reference)."""
+    if name not in query:
+        return False
+    v = query[name][0]
+    return v == "" or v.lower() in ("1", "true")
 
 
 class RawResponse:
@@ -109,6 +150,21 @@ class HTTPServer:
         self.connections_accepted = 0
         self._conn_count_lock = threading.Lock()
 
+        # Raw-socket ids of connections handed to the read mux: the
+        # handler thread exits while the continuation owns the socket,
+        # so socketserver's per-request close must be skipped — one
+        # skip CREDIT per park, consumed by shutdown_request. A
+        # counter, not a set: a served keep-alive connection is resumed
+        # via process_request and can park AGAIN before the previous
+        # handler thread reaches its shutdown hook, so two credits must
+        # coexist. Keyed by the PRE-TLS socket — that is the object
+        # socketserver closes. _resumed marks sockets re-entering the
+        # server after a parked serve (skip the accept count; under TLS
+        # carry the live wrapped socket so setup() doesn't re-handshake).
+        self._detached: dict = {}
+        self._resumed: dict = {}
+        self._detached_lock = threading.Lock()
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             # Idle keep-alive connections must not pin handler threads
@@ -119,9 +175,27 @@ class HTTPServer:
             timeout = MAX_BLOCKING_WAIT + 30.0
 
             def setup(self):
-                with api._conn_count_lock:
-                    api.connections_accepted += 1
-                if api.ssl_context is not None:
+                with api._detached_lock:
+                    resumed = id(self.request) in api._resumed
+                    wrapped = api._resumed.pop(id(self.request), None)
+                if not resumed:
+                    # A resumed connection (back from a parked serve)
+                    # is NOT a new accept.
+                    with api._conn_count_lock:
+                        api.connections_accepted += 1
+                # Captured BEFORE any TLS wrap: _Server.shutdown_request
+                # closes this exact object, so the detached-socket
+                # protocol must key on it (the wrapped socket is a
+                # different Python object).
+                self._raw_request = self.request
+                self._nomad_parked = False
+                if api.ssl_context is not None and wrapped is not None:
+                    # The TLS session on a resumed socket is live:
+                    # re-wrapping would force a second handshake on an
+                    # established stream. Reuse the wrapped object.
+                    self.request = wrapped
+                    self.connection = wrapped
+                elif api.ssl_context is not None:
                     # Bound the handshake: Handler.timeout only lands
                     # in super().setup(), and an unbounded wrap lets a
                     # connect-and-say-nothing client pin this thread.
@@ -147,6 +221,22 @@ class HTTPServer:
                 self.nomad_route = "unmatched"
                 try:
                     body = api.handle(self)
+                except _ParkSignal as sig:
+                    # The blocking query wants to park: hand the
+                    # continuation to the read mux and detach the
+                    # socket. Mux full/stopped → classic thread-park.
+                    try:
+                        if api._park_handler(self, sig):
+                            self._nomad_parked = True
+                            self.close_connection = True
+                        else:
+                            self._reply_body(api._blocking_threadpark(
+                                sig.items, sig.min_index, sig.deadline,
+                                sig.run, sig.headers, True))
+                    except HTTPError as e:
+                        self._reply(e.status, {"error": e.message})
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, {"error": str(e)})
                 except AdmissionRejected as e:
                     # Overload shed/limit (nomad_tpu/admission): a
                     # machine-readable Retry-After so well-behaved
@@ -164,12 +254,40 @@ class HTTPServer:
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": str(e)})
                 else:
-                    index = (api.server.fsm.state.latest_index()
-                             if api.server is not None else 0)
-                    self._reply(200, body, index)
+                    self._reply_body(body)
                 metrics.measure_since(
                     ("http", "request", self.command, self.nomad_route),
                     _start)
+
+            def _reply_body(self, body):
+                """200 reply with the right X-Nomad-Index: a
+                JSONResponse carries its scope index (and extra
+                headers); everything else gets the global index."""
+                headers = None
+                index = None
+                if isinstance(body, JSONResponse):
+                    index = body.index
+                    headers = body.headers or None
+                    body = body.body
+                if index is None:
+                    index = (api.server.fsm.state.latest_index()
+                             if api.server is not None else 0)
+                self._reply(200, body, index, headers=headers)
+
+            def finish(self):
+                if self._nomad_parked:
+                    # The parked continuation owns the socket now: do
+                    # not flush or close it — but DO drop rfile/wfile,
+                    # whose makefile io-refs would otherwise keep the
+                    # fd open after the continuation's conn.close()
+                    # (nothing was written, so closing flushes nothing).
+                    for f in (self.wfile, self.rfile):
+                        try:
+                            f.close()
+                        except OSError:
+                            pass
+                    return
+                super().finish()
 
             def _reply(self, status, body, index=None, headers=None):
                 stream = None
@@ -240,6 +358,22 @@ class HTTPServer:
                     return
                 super().handle_error(request, client_address)
 
+            def shutdown_request(self, request):
+                # Detached-socket protocol: each park banks exactly one
+                # close-skip credit (registered strictly before the
+                # handler returns — handle() runs inside the handler
+                # constructor) and each handler exit consumes at most
+                # one, keeping the table self-cleaning.
+                with api._detached_lock:
+                    n = api._detached.get(id(request), 0)
+                    if n:
+                        if n == 1:
+                            del api._detached[id(request)]
+                        else:
+                            api._detached[id(request)] = n - 1
+                        return
+                super().shutdown_request(request)
+
         self._httpd = _Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
         scheme = "https" if ssl_context is not None else "http"
@@ -261,7 +395,10 @@ class HTTPServer:
     def handle(self, req) -> Any:
         parsed = urllib.parse.urlparse(req.path)
         path = parsed.path.rstrip("/")
-        query = urllib.parse.parse_qs(parsed.query)
+        # keep_blank_values: the consistency flags are bare in the
+        # reference API (`?stale`, `?consistent`) and parse_qs drops
+        # valueless keys by default.
+        query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
         method = req.command
         body = None
         length = int(req.headers.get("Content-Length") or 0)
@@ -273,7 +410,7 @@ class HTTPServer:
         region = query.get("region", [None])[0]
         if (region and self.server is not None
                 and region != self.server.config.region):
-            return self._forward_region(region, method, parsed, body)
+            return self._forward_region(region, method, parsed, body, req)
 
         route_handlers: List[Tuple[str, Callable]] = [
             (r"^/v1/regions$", self._regions),
@@ -362,35 +499,215 @@ class HTTPServer:
                 # and observability routes are exempt (limiter.py).
                 ctl = (getattr(self.server, "admission", None)
                        if self.server is not None else None)
+                degraded = False
                 if ctl is not None:
-                    ctl.check_http(method, path, req.nomad_route)
-                return handler(method, query, body, **m.groupdict())
+                    verdict = ctl.check_http(method, path, req.nomad_route)
+                    if verdict == "stale":
+                        # Red-pressure read degradation: serve from the
+                        # local replica (stale mode) instead of 429ing
+                        # — a degraded answer beats no answer when a
+                        # snapshot exists to serve from.
+                        query["stale"] = ["true"]
+                        degraded = True
+                result = handler(method, query, body, **m.groupdict())
+                if degraded:
+                    if not isinstance(result, JSONResponse):
+                        result = JSONResponse(result)
+                    result.headers["X-Nomad-Degraded"] = "stale"
+                return result
         raise HTTPError(404, f"no handler for {path!r}")
 
     # ------------------------------------------------------------------
 
     def _blocking(self, query, items, run: Callable[[], Any]) -> Any:
-        """Blocking-query wrapper: re-run until the state index passes
-        ?index=N or the wait expires."""
+        """Blocking-query wrapper: serve once the watch SCOPE's index
+        passes ?index=N or the wait expires. Consistency modes ride on
+        every blocking route: `?stale` serves the local replica
+        immediately-on-satisfaction with X-Nomad-LastContact /
+        X-Nomad-KnownLeader staleness headers; `?consistent` first
+        waits for the local FSM to reach the leader's last-known
+        commit index (read-your-writes on a follower). The default
+        preserves the pre-read-plane semantics.
+
+        Queries that must park go to the read mux (_ParkSignal) so no
+        HTTP thread waits; the thread-parking loop remains as the
+        mux-full / global-index-arm fallback."""
         min_index = int(query.get("index", ["0"])[0])
-        wait = min(
-            float(query.get("wait", [DEFAULT_BLOCKING_WAIT])[0]), MAX_BLOCKING_WAIT
-        )
-        state = self.server.fsm.state
-        if min_index <= 0:
-            return run()
+        requested = float(query.get("wait", [DEFAULT_BLOCKING_WAIT])[0])
+        wait = min(requested, MAX_BLOCKING_WAIT)
+        headers = {}
+        if "wait" in query:
+            # The clamp is not silent (the PR 5 dequeue contract,
+            # extended to every blocking route): the EFFECTIVE wait
+            # goes back so a client asking past MAX_BLOCKING_WAIT can
+            # see its actual long-poll budget.
+            headers["X-Nomad-Effective-Wait"] = f"{wait:.3f}"
+        server = self.server
+        state = server.fsm.state
+        scoped = getattr(server.config, "read_scoped_index", True)
+        stale = _qflag(query, "stale")
+        consistent = _qflag(query, "consistent")
+        if stale and consistent:
+            raise HTTPError(
+                400, "?stale and ?consistent are mutually exclusive")
+        if stale:
+            contact_ms, known = server.read_staleness()
+            headers["X-Nomad-LastContact"] = str(int(round(contact_ms)))
+            headers["X-Nomad-KnownLeader"] = "true" if known else "false"
+        elif consistent:
+            try:
+                server.wait_consistent()
+            except TimeoutError as e:
+                raise HTTPError(
+                    504, f"consistent read barrier timed out: {e}")
+
+        def cur_index() -> int:
+            return (state.scope_index(items) if scoped
+                    else state.latest_index())
+
+        if min_index <= 0 or cur_index() > min_index:
+            return JSONResponse(run(), index=max(cur_index(), 1),
+                                headers=headers)
         deadline = time.monotonic() + wait
+        mux = getattr(server, "read_mux", None)
+        if scoped and mux is not None:
+            raise _ParkSignal(items, min_index, deadline, run, headers)
+        return self._blocking_threadpark(
+            items, min_index, deadline, run, headers, scoped)
+
+    def _blocking_threadpark(self, items, min_index: int, deadline: float,
+                             run, headers, scoped: bool) -> "JSONResponse":
+        """The pre-mux blocking loop: park THIS handler thread on the
+        watch until satisfied or expired. Baseline arm for the bench
+        A/B (`read_mux_enabled=false` / `read_scoped_index=false`) and
+        the overflow path when the mux is full."""
+        state = self.server.fsm.state
+
+        def cur_index() -> int:
+            return (state.scope_index(items) if scoped
+                    else state.latest_index())
+
         while True:
             ev = state.watch(items)
-            if state.latest_index() > min_index:
+            if cur_index() > min_index:
                 state.stop_watch(items, ev)
-                return run()
+                break
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 state.stop_watch(items, ev)
-                return run()
+                break
             ev.wait(min(remaining, 1.0))
             state.stop_watch(items, ev)
+        return JSONResponse(run(), index=max(cur_index(), 1),
+                            headers=headers)
+
+    def _park_handler(self, handler, sig: "_ParkSignal") -> bool:
+        """Build the serialized-response continuation for a parking
+        blocking query and register it with the read mux. On success
+        the handler thread must exit WITHOUT closing the connection —
+        the continuation owns the socket and writes the raw HTTP/1.1
+        response when the mux wakes or expires it, then hands the
+        still-open socket back to the HTTP server for its next request
+        cycle (pooled SDK clients ride ONE socket per client across
+        the whole long-poll loop — tests/test_httppool.py)."""
+        from http.client import responses as _status_phrases
+
+        server = self.server
+        conn = handler.connection
+        raw = handler._raw_request
+        client_address = handler.client_address
+        # The client's keep-alive wish, read off the request headers
+        # BEFORE _dispatch forces close_connection to exit its loop.
+        keepalive = not handler.close_connection
+        scopes = list(sig.items)
+
+        def serve(reason: str) -> None:
+            try:
+                payload, status = sig.run(), 200
+            except HTTPError as e:
+                payload, status = {"error": e.message}, e.status
+            except Exception as e:  # noqa: BLE001
+                payload, status = {"error": str(e)}, 500
+            state = server.fsm.state
+            scoped = getattr(server.config, "read_scoped_index", True)
+            index = (state.scope_index(scopes) if scoped
+                     else state.latest_index())
+            headers = dict(sig.headers)
+            if "X-Nomad-LastContact" in headers:
+                # Staleness is measured at SERVE time, not park time.
+                contact_ms, known = server.read_staleness()
+                headers["X-Nomad-LastContact"] = str(int(round(contact_ms)))
+                headers["X-Nomad-KnownLeader"] = (
+                    "true" if known else "false")
+            # On shutdown the server is going away with the socket;
+            # otherwise honor the client's keep-alive so its next
+            # blocking query reuses this connection instead of dialing.
+            keep = keepalive and reason != "shutdown"
+            data = json.dumps(payload).encode()
+            lines = [
+                f"HTTP/1.1 {status} {_status_phrases.get(status, 'OK')}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(data)}",
+                f"X-Nomad-Index: {max(index, 1)}",
+            ]
+            lines.extend(f"{k}: {v}" for k, v in headers.items())
+            lines.extend(
+                ["Connection: keep-alive" if keep else "Connection: close",
+                 "", ""])
+
+            def close_conn():
+                # shutdown() pushes the FIN out NOW — close() alone
+                # only drops this reference, and a lingering ref (idle
+                # pool worker locals, exception tracebacks) would leave
+                # the client waiting on a connection that never ends.
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+            try:
+                # Bound the write: a stalled client must not wedge a
+                # serve-pool thread for good.
+                conn.settimeout(30.0)
+                conn.sendall("\r\n".join(lines).encode() + data)
+            except BaseException:
+                close_conn()
+                raise
+            if keep:
+                try:
+                    self._resume_connection(raw, conn, client_address)
+                    return
+                except Exception:  # noqa: BLE001
+                    pass  # server torn down mid-serve: fall through
+            close_conn()
+
+        parked = server.read_mux.park(
+            scopes, sig.min_index, sig.deadline, serve)
+        if parked:
+            with self._detached_lock:
+                rid = id(raw)
+                self._detached[rid] = self._detached.get(rid, 0) + 1
+        return parked
+
+    def _resume_connection(self, raw, conn, client_address) -> None:
+        """Hand a just-served keep-alive socket back to the HTTP server
+        for its next request cycle. The _resumed entry tells the fresh
+        handler's setup() this is not a new accept and, under TLS,
+        carries the live wrapped socket (conn) so it isn't re-wrapped;
+        process_request is handed the PRE-TLS object so the close
+        machinery keys on the right socket."""
+        with self._detached_lock:
+            self._resumed[id(raw)] = None if conn is raw else conn
+        try:
+            self._httpd.process_request(raw, client_address)
+        except BaseException:
+            with self._detached_lock:
+                self._resumed.pop(id(raw), None)
+            raise
 
     # ------------------------------------------------------------- jobs
 
@@ -820,10 +1137,24 @@ class HTTPServer:
 
     # ------------------------------------------------- regions + gossip
 
-    def _forward_region(self, region: str, method: str, parsed, body):
+    def _forward_region(self, region: str, method: str, parsed, body,
+                        req=None):
         """Proxy the request to a server in the target region, keeping
         path and query intact (the remote matches the region so it
-        handles locally)."""
+        handles locally). Each hop appends itself to
+        X-Nomad-Forwarded-For; seeing ourselves in that list means the
+        serf region table is cyclic (split-brain or misconfigured
+        federation) and the request 508s instead of ping-ponging until
+        both regions' handler threads are exhausted."""
+        hops: List[str] = []
+        if req is not None:
+            raw_hops = req.headers.get("X-Nomad-Forwarded-For") or ""
+            hops = [h.strip() for h in raw_hops.split(",") if h.strip()]
+        me = f"{self.server.node_id}.{self.server.config.region}"
+        if me in hops:
+            raise HTTPError(
+                508, "region forwarding loop detected: "
+                + " -> ".join(hops + [me]))
         peer = self.server.peer_http_addr(region)
         if peer is None:
             raise HTTPError(500, f"no path to region {region!r}")
@@ -844,6 +1175,7 @@ class HTTPServer:
         data = json.dumps(body).encode() if body is not None else None
         freq = urllib.request.Request(url, data=data, method=method)
         freq.add_header("Content-Type", "application/json")
+        freq.add_header("X-Nomad-Forwarded-For", ", ".join(hops + [me]))
         try:
             # Outlive the longest server-side blocking query
             # (MAX_BLOCKING_WAIT) so forwarded long-polls don't 500.

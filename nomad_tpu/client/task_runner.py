@@ -84,6 +84,12 @@ class TaskRunner:
         # Set by update_inplace: the next start must re-render the
         # task environment from the adopted alloc/task definition.
         self._env_stale = False  # guarded-by: _lock
+        # Bumped by update_inplace. An update landing while a start is
+        # in flight (env already rendered, RUNNING not yet emitted)
+        # finds no live handle to restart-kill and no future start to
+        # adopt it — the run loop compares generations after coming up
+        # and restarts itself if one was missed.
+        self._def_gen = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------------
 
@@ -127,6 +133,7 @@ class TaskRunner:
             self.alloc = alloc
             self.task = task
             self._env_stale = True
+            self._def_gen += 1
             handle = self.handle
         if handle is None or self.state.state != consts.TASK_STATE_RUNNING:
             return
@@ -223,6 +230,7 @@ class TaskRunner:
             with self._lock:
                 env_stale = self._env_stale
                 self._env_stale = False
+                start_gen = self._def_gen
             if env_stale:
                 ctx.env = task_env_from_alloc_dir(
                     self.alloc, self.task, self.alloc_dir)
@@ -313,6 +321,27 @@ class TaskRunner:
                 result = WaitResult(exit_code=-1, error=str(e))
             else:
                 self._emit(consts.TASK_STATE_RUNNING, new_task_event(consts.TASK_EVENT_STARTED))
+                # An in-place update that landed while this start was
+                # in flight adopted its definition (update_inplace saw
+                # no RUNNING task to bounce) but this start rendered
+                # the OLD env — and a `sleep`-forever task never starts
+                # again on its own. Close the window: restart now.
+                # _env_stale is still set (the update set it after the
+                # consume above), so the next iteration re-renders.
+                with self._lock:
+                    missed_update = self._def_gen != start_gen
+                if missed_update:
+                    self._restart_requested.set()
+                    ev = new_task_event(consts.TASK_EVENT_RESTART_SIGNAL)
+                    ev.message = ("In-place update: restarting with "
+                                  "the new task environment")
+                    self._emit(consts.TASK_STATE_RUNNING, ev)
+                    try:
+                        handle.kill(min(self.task.kill_timeout,
+                                        self.max_kill_timeout))
+                    except Exception:
+                        self.logger.exception(
+                            "in-place update restart kill failed")
                 result = None
                 while result is None and not self._kill.is_set():
                     result = self.handle.wait(timeout=0.25)

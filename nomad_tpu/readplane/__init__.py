@@ -1,0 +1,7 @@
+"""The read plane: scoped-index blocking queries served by a
+parked-watcher multiplexer, with stale/consistent read modes layered
+on in api/http.py. See readplane/README.md."""
+
+from .mux import ParkedQuery, ReadMux
+
+__all__ = ["ParkedQuery", "ReadMux"]

@@ -162,6 +162,25 @@ class ServerConfig:
     # (one budget shared with drain storms).
     defrag_max_moves_per_wave: int = 16
 
+    # ---- Read plane (nomad_tpu/readplane) ----
+    # Parked-watcher multiplexer: blocking queries past their ?index
+    # register a continuation with the mux and free their HTTP handler
+    # thread; one wake-owner thread + a small serve pool re-run them
+    # on scope notifications. False reverts to thread-parking long
+    # polls (the bench --read-storm baseline arm).
+    read_mux_enabled: bool = True
+    # Serve-pool threads re-running satisfied/expired queries.
+    read_mux_workers: int = 4
+    # Continuations parked at once before new blocking queries fall
+    # back to thread-parking (bounds mux memory under a watcher storm).
+    read_mux_max_parked: int = 4096
+    # Scoped modify-index tracking: blocking queries wake on — and
+    # X-Nomad-Index reports — their watch scope's index instead of the
+    # global raft index. False restores global-index wakes (the
+    # spurious-wakeup A/B arm); the mux requires scoped tracking, so
+    # False also implies thread-parking long polls.
+    read_scoped_index: bool = True
+
     # ---- Overload protection (nomad_tpu/admission) ----
     # Bounded broker ready queues: default per-scheduler-type depth cap
     # (0 = unbounded) plus per-type overrides. A full queue sheds the

@@ -970,6 +970,22 @@ class RaftNode:
         with self._lock:
             return self.last_applied
 
+    def last_contact(self) -> float:
+        """Seconds since this node last heard from a leader (0.0 while
+        leading) — the staleness bound a `?stale` read advertises via
+        X-Nomad-LastContact."""
+        with self._lock:
+            if self.state == LEADER:
+                return 0.0
+            return max(0.0, time.monotonic() - self._last_leader_contact)
+
+    def known_commit_index(self) -> int:
+        """The leader commit index this node has observed — the wait
+        target a `?consistent` follower read uses for read-your-writes
+        without leader forwarding."""
+        with self._lock:
+            return self.commit_index
+
     def barrier(self) -> int:
         return self.last_index()
 

@@ -174,6 +174,20 @@ class Server:
         from ..defrag import DefragLoop
 
         self.defrag = DefragLoop(self)
+        # Read plane (nomad_tpu/readplane): the parked-watcher long-poll
+        # multiplexer. Constructed unconditionally (stats surface); the
+        # HTTP layer only parks continuations here while
+        # read_mux_enabled — otherwise blocking queries fall back to
+        # the thread-parking loop (the bench baseline arm). The store
+        # accessor is a callable because FSM snapshot-restore swaps the
+        # StateStore instance.
+        from ..readplane import ReadMux
+
+        self.read_mux = ReadMux(
+            lambda: self.fsm.state,
+            workers=self.config.read_mux_workers,
+            max_parked=self.config.read_mux_max_parked,
+        )
         self._leader = False
         self._shutdown = False
         self._gc_threads: List[threading.Timer] = []
@@ -255,6 +269,8 @@ class Server:
         self.dispatch.start()
         self.executive.start()
         self.defrag.start()
+        if self.config.read_mux_enabled:
+            self.read_mux.start()
         self.establish_leadership()
         self._start_telemetry()
 
@@ -427,6 +443,8 @@ class Server:
         self.dispatch.start()
         self.executive.start()
         self.defrag.start()
+        if self.config.read_mux_enabled:
+            self.read_mux.start()
         self.raft.start()
         threading.Thread(target=self._membership_reconcile_loop,
                          name="raft-membership-sweep", daemon=True).start()
@@ -545,6 +563,7 @@ class Server:
         self.dispatch.stop()
         self.executive.stop()
         self.defrag.stop()
+        self.read_mux.stop()
         for w in self.workers:
             w.stop()
         if self.vault is not None and hasattr(self.vault, "stop"):
@@ -552,6 +571,30 @@ class Server:
 
     def is_leader(self) -> bool:
         return self._leader
+
+    def read_staleness(self) -> tuple:
+        """(last_contact_ms, known_leader) for `?stale` read headers:
+        how old this replica's view may be (0.0 while leading or in
+        dev mode — the local store IS the authority) and whether a
+        leader is currently known."""
+        if self._leader:
+            return 0.0, True
+        raft = self.raft
+        if raft is None:
+            # Dev mode never revokes leadership; a non-leader without
+            # raft is mid-shutdown — report unknown.
+            return 0.0, False
+        return raft.last_contact() * 1000.0, raft.leader_id is not None
+
+    def wait_consistent(self, timeout: float = 5.0) -> None:
+        """`?consistent` read barrier: block until the local FSM has
+        applied the leader's last-known commit index (read-your-writes
+        on a follower without forwarding the read). No-op on the
+        leader/dev server, whose FSM is the commit authority."""
+        raft = self.raft
+        if raft is None or self._leader:
+            return
+        self._wait_applied(raft.known_commit_index(), timeout=timeout)
 
     # ---------------------------------------------------- serf/federation
 
@@ -1425,6 +1468,10 @@ class Server:
             # per path; the applier-side whole-gang rejections live in
             # plan_applier stats ("gangs_rejected").
             "gang": _gang_stats(),
+            # Read plane (nomad_tpu/readplane): parked continuations,
+            # wake/spurious/served/timeout/write-error counters, and
+            # the serve-pool depth.
+            "read_mux": self.read_mux.stats(),
         }
         if self.raft is not None:
             # Term/commit/membership for operators (the reference's

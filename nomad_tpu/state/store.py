@@ -246,6 +246,16 @@ class StateStore:
         }
         self._table_indexes: Dict[str, int] = {}
         self._latest_index = 0
+        # Per-watch-scope modify indexes (the reference's state_store.go
+        # index-table device, at watch.Item granularity): one entry per
+        # (kind, key) actually touched by a commit. Blocking queries
+        # wake — and stamp X-Nomad-Index — off THEIR scope's index, not
+        # the global one, so a write to job A never re-runs a watcher
+        # of job B. Bounded by _SCOPE_CAP: pruning raises _scope_floor
+        # so evicted scopes degrade to conservative (global-ish) wakes
+        # instead of missed ones.
+        self._scope_indexes: Dict[watch.Item, int] = {}
+        self._scope_floor = 0
         self.notify = watch.NotifyGroup()
         from ..utils.ids import generate_uuid
 
@@ -277,6 +287,28 @@ class StateStore:
 
     def stop_watch(self, items, ev) -> None:
         self.notify.stop_watch(items, ev)
+
+    def scope_index(self, items) -> int:
+        """Max modify index across the given watch scopes — the index a
+        blocking query on `items` should compare against ?index=N and
+        report as X-Nomad-Index. Never-stamped scopes fall back to the
+        scope floor (0 on a fresh store; the restored latest index when
+        the snapshot predates scope persistence, so correctness degrades
+        to the old conservative global behavior, never to missed
+        wakes)."""
+        with self._lock:
+            best = 0
+            for item in items:
+                idx = self._scope_indexes.get(item)
+                if idx is None:
+                    kind, key = item
+                    if kind == "table":
+                        idx = self._table_indexes.get(key, 0)
+                    else:
+                        idx = self._scope_floor
+                if idx > best:
+                    best = idx
+            return best
 
     # Read API mirrors the snapshot's (reads go through a fresh snapshot
     # so they are consistent).
@@ -312,10 +344,31 @@ class StateStore:
     # write transactions (FSM-only)
     # ------------------------------------------------------------------
 
+    # Scope entries ever stamped before pruning engages; prune drops
+    # the oldest half and raises the floor to the highest dropped
+    # index (conservative, not lossy).
+    _SCOPE_CAP = 262144
+
     def _bump(self, index: int, *tables: str) -> None:
         for t in tables:
             self._table_indexes[t] = index
         self._latest_index = max(self._latest_index, index)
+
+    def _stamp(self, index: int, items) -> None:
+        """Record `index` as the modify index of every touched scope.
+        Runs under self._lock, after the txn's table writes, so a
+        reader never sees new data with a pre-txn scope index."""
+        scopes = self._scope_indexes
+        for item in items:
+            scopes[item] = index
+        if len(scopes) > self._SCOPE_CAP:
+            by_age = sorted(scopes.items(), key=lambda kv: kv[1])
+            cut = len(by_age) // 2
+            for item, idx in by_age[:cut]:
+                del scopes[item]
+            if cut:
+                self._scope_floor = max(self._scope_floor,
+                                        by_age[cut - 1][1])
 
     def upsert_node(self, index: int, node: Node) -> None:
         items = [watch.table("nodes"), watch.node(node.id)]
@@ -333,6 +386,7 @@ class StateStore:
             node.compute_class()
             table[node.id] = node
             self._bump(index, "nodes")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def delete_node(self, index: int, node_id: str) -> None:
@@ -343,6 +397,7 @@ class StateStore:
                 return
             del table[node_id]
             self._bump(index, "nodes")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -360,6 +415,7 @@ class StateStore:
             node.status_updated_at = _time.time()
             table[node_id] = node
             self._bump(index, "nodes")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
@@ -374,6 +430,7 @@ class StateStore:
             node.modify_index = index
             table[node_id] = node
             self._bump(index, "nodes")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def upsert_job(self, index: int, job: Job) -> None:
@@ -393,6 +450,7 @@ class StateStore:
             self._ensure_job_summary(index, job)
             items.extend(self._set_job_status(index, job))
             self._bump(index, "jobs", "job_summary")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def delete_job(self, index: int, job_id: str) -> None:
@@ -407,6 +465,7 @@ class StateStore:
             launches = self._tables["periodic_launch"].for_write()
             launches.pop(job_id, None)
             self._bump(index, "jobs", "job_summary", "periodic_launch")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def upsert_periodic_launch(self, index: int, launch: PeriodicLaunch) -> None:
@@ -422,6 +481,7 @@ class StateStore:
             )
             table[launch.id] = rec
             self._bump(index, "periodic_launch")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def delete_periodic_launch(self, index: int, job_id: str) -> None:
@@ -430,6 +490,7 @@ class StateStore:
             table = self._tables["periodic_launch"].for_write()
             table.pop(job_id, None)
             self._bump(index, "periodic_launch")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def upsert_vault_accessors(self, index: int, accessors) -> None:
@@ -442,6 +503,7 @@ class StateStore:
                 acc.create_index = index
                 table[acc.accessor] = acc
             self._bump(index, "vault_accessors")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def delete_vault_accessors(self, index: int, accessors: List[str]) -> None:
@@ -451,6 +513,7 @@ class StateStore:
             for acc in accessors:
                 table.pop(acc, None)
             self._bump(index, "vault_accessors")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
@@ -477,6 +540,7 @@ class StateStore:
                     items.extend(self._set_job_status(index, job))
                     items.append(watch.job_summary(ev.job_id))
             self._bump(index, "evals", "job_summary")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def delete_evals(self, index: int, eval_ids: List[str], alloc_ids: List[str]) -> None:
@@ -511,6 +575,7 @@ class StateStore:
                 if job is not None:
                     items.extend(self._set_job_status(index, job, eval_delete=True))
             self._bump(index, "evals", "allocs")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
@@ -561,6 +626,7 @@ class StateStore:
                 if job is not None:
                     items.extend(self._set_job_status(index, job))
             self._bump(index, "allocs", "job_summary")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     def update_allocs_from_client(self, index: int, allocs: List[Allocation]) -> None:
@@ -598,6 +664,7 @@ class StateStore:
                     ]
                 )
             self._bump(index, "allocs", "job_summary")
+            self._stamp(index, items)
         self.notify.notify(items)
 
     # ------------------------------------------------------------------
@@ -725,6 +792,11 @@ class StateStore:
                 ],
                 "table_indexes": dict(self._table_indexes),
                 "latest_index": self._latest_index,
+                "scope_indexes": [
+                    [kind, key, idx]
+                    for (kind, key), idx in self._scope_indexes.items()
+                ],
+                "scope_floor": self._scope_floor,
             }
 
     @classmethod
@@ -762,4 +834,16 @@ class StateStore:
                 store._tables["vault_accessors"].data[v.accessor] = v
             store._table_indexes = dict(data.get("table_indexes", {}))
             store._latest_index = data.get("latest_index", 0)
+            scopes = data.get("scope_indexes")
+            if scopes is None:
+                # Snapshot predates scope persistence: every scope's
+                # history is unknown, so the floor is the whole
+                # restored history (conservative global-index wakes for
+                # pre-restore scopes, exact tracking from here on).
+                store._scope_floor = store._latest_index
+            else:
+                store._scope_indexes = {
+                    (kind, key): idx for kind, key, idx in scopes
+                }
+                store._scope_floor = data.get("scope_floor", 0)
         return store

@@ -53,11 +53,20 @@ def alloc_eval(eval_id: str) -> Item:
 
 
 class NotifyGroup:
-    """Fan-out notification: wait on any of a set of items."""
+    """Fan-out notification: wait on any of a set of items.
+
+    Two consumer shapes: per-query Events (``watch``/``stop_watch``,
+    the thread-parking blocking query) and process-wide sinks
+    (``subscribe``), callables invoked with every commit's item list —
+    the read-plane multiplexer's wake feed. Sinks run OUTSIDE the
+    group lock, on the committing (FSM) thread, so they must be cheap
+    and non-blocking (the mux only appends to a deque and signals its
+    own condition)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._watchers: Dict[Item, Set[threading.Event]] = {}
+        self._sinks: list = []  # guarded-by: _lock (copy-on-write)
 
     def watch(self, items: Iterable[Item]) -> threading.Event:
         ev = threading.Event()
@@ -75,11 +84,25 @@ class NotifyGroup:
                     if not group:
                         del self._watchers[item]
 
+    def subscribe(self, sink) -> None:
+        """Register a commit sink: called with every notify()'s item
+        list (a materialized list, safe to retain)."""
+        with self._lock:
+            self._sinks = self._sinks + [sink]
+
+    def unsubscribe(self, sink) -> None:
+        with self._lock:
+            self._sinks = [s for s in self._sinks if s is not sink]
+
     def notify(self, items: Iterable[Item]) -> None:
+        items = list(items)
         fired: Set[threading.Event] = set()
         with self._lock:
+            sinks = self._sinks
             for item in items:
                 for ev in self._watchers.get(item, ()):
                     fired.add(ev)
         for ev in fired:
             ev.set()
+        for sink in sinks:
+            sink(items)

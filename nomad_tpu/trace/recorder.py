@@ -239,6 +239,16 @@ class FlightRecorder:
                 entry.faults[n] = (now, site, seq, kind)
                 entry.n_faults = n + 1
 
+    def observe_stage(self, stage: str, ms: float) -> None:
+        """Public per-stage histogram feed for non-eval pipelines (the
+        read plane's `read.park`/`read.serve` stages): lands in
+        stage_stats() without opening a trace and without touching the
+        e2e histogram — e2e_p99() feeds the admission pressure monitor
+        and must keep measuring the eval lifecycle only."""
+        if not self.enabled:
+            return
+        self._hist_add(stage, ms)
+
     def _hist_add(self, stage: str, ms: Optional[float]) -> None:
         if ms is None:
             return

@@ -329,6 +329,26 @@ def test_controller_red_sheds_writes_503_limits_reads():
     assert exc.value.status == 429
 
 
+def test_controller_red_degrades_reads_to_stale_with_replica():
+    """With replica state on hand, an over-budget red read degrades to
+    the 'stale' verdict (serve local replica) instead of a 429; the
+    stub-server path without an fsm keeps the old 429 behavior."""
+    cfg = ServerConfig(admission_read_rate=100.0,
+                       admission_read_burst=1.0)
+    server = stub_server(cfg)
+    server.fsm = SimpleNamespace(
+        state=SimpleNamespace(latest_index=lambda: 7))
+    ctl = AdmissionController(server, cfg)
+    ctl.force_level(LEVEL_RED)
+    assert ctl.check_http("GET", "/v1/jobs", "jobs") is None  # burst token
+    assert ctl.check_http("GET", "/v1/jobs", "jobs") == "stale"
+    # No replica yet (index 0) → the 429 path stands.
+    server.fsm.state = SimpleNamespace(latest_index=lambda: 0)
+    with pytest.raises(AdmissionRejected) as exc:
+        ctl.check_http("GET", "/v1/jobs", "jobs")
+    assert exc.value.status == 429
+
+
 def test_controller_exemptions_hold_under_red():
     ctl = make_controller()
     ctl.force_level(LEVEL_RED)

@@ -164,6 +164,68 @@ class TestFederation:
         got, _ = client.jobs.info(job.id)
         assert got.id == job.id
 
+    def test_two_region_write_forward_local_stale_read(self, two_region_cluster):
+        """The two-region read/write split: writes forward to the
+        owning region, stale reads serve the local replica, and the
+        remote region's index survives the proxy hop."""
+        servers, https = two_region_cluster
+        client = Client(https[0].addr, region="east")
+        job = mock.job()
+        client.jobs.register(job)
+        assert servers[1].fsm.state.job_by_id(job.id) is not None
+
+        import json as _json
+        import urllib.request
+
+        def raw_get(addr, path):
+            with urllib.request.urlopen(addr + path, timeout=10.0) as resp:
+                return resp.status, dict(resp.headers), _json.loads(resp.read())
+
+        # Forwarded read: the EAST region's X-Nomad-Index comes back
+        # through the global agent, not the global store's index.
+        status, headers, body = raw_get(
+            https[0].addr, f"/v1/job/{job.id}?region=east")
+        assert status == 200 and body["id"] == job.id
+        east_idx = servers[1].fsm.state.scope_index([("job", job.id)])
+        assert east_idx >= 1
+        assert int(headers["X-Nomad-Index"]) == east_idx
+
+        # Local stale read on the global agent: served immediately from
+        # the LOCAL replica (which never saw the east write), stamped
+        # with staleness headers instead of forwarding.
+        status, headers, body = raw_get(https[0].addr, "/v1/jobs?stale")
+        assert status == 200
+        assert all(j["id"] != job.id for j in body)
+        assert headers["X-Nomad-KnownLeader"] == "true"
+        assert int(headers["X-Nomad-LastContact"]) >= 0
+
+        # Same stale read against the owning region sees the job.
+        status, headers, body = raw_get(https[1].addr, "/v1/jobs?stale")
+        assert status == 200
+        assert any(j["id"] == job.id for j in body)
+
+    def test_forwarding_loop_returns_508(self, two_region_cluster, monkeypatch):
+        """Two agents whose region tables point at each other for a
+        region neither owns must 508 after one round trip, not
+        ping-pong until both HTTP pools wedge."""
+        servers, https = two_region_cluster
+        # Both servers claim the phantom region lives at the OTHER one.
+        monkeypatch.setattr(
+            servers[0], "peer_http_addr",
+            lambda region: https[1].addr if region == "west" else None)
+        monkeypatch.setattr(
+            servers[1], "peer_http_addr",
+            lambda region: https[0].addr if region == "west" else None)
+
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(
+                https[0].addr + "/v1/jobs?region=west", timeout=10.0)
+        assert excinfo.value.code == 508
+        assert "loop" in excinfo.value.read().decode()
+
     def test_forward_to_unknown_region_fails(self, two_region_cluster):
         _, https = two_region_cluster
         client = Client(https[0].addr, region="mars")

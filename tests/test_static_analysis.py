@@ -617,7 +617,8 @@ CORE_DIRS = ("nomad_tpu/dispatch/", "nomad_tpu/scheduler/",
              "nomad_tpu/trace/", "nomad_tpu/admission/",
              "nomad_tpu/models/", "nomad_tpu/kernels/",
              "nomad_tpu/migrate/", "nomad_tpu/profile/",
-             "nomad_tpu/defrag/", "nomad_tpu/gang/")
+             "nomad_tpu/defrag/", "nomad_tpu/gang/",
+             "nomad_tpu/readplane/")
 
 
 def _tree_findings():
@@ -2023,6 +2024,32 @@ def test_executive_module_manifests_and_raw_clean():
     src = open(os.path.join(
         REPO, "nomad_tpu", "server", "executive.py")).read()
     assert "nta: disable" not in src
+
+
+def test_readplane_manifests_and_raw_clean():
+    """The read plane's self-check (PR 19): readplane/ declares the
+    wake owner as a never-blocking dispatcher entrypoint, sits inside
+    the unbounded-wait + swallowed-exception scopes and the must-be-
+    clean CORE_DIRS, and the real tree shows ZERO findings of ANY rule
+    in it — empty baseline, no inline suppressions."""
+    from nomad_tpu.analysis.robustness import (
+        SWALLOW_SCOPE_MARKERS,
+        WAIT_SCOPE_MARKERS,
+    )
+    from nomad_tpu.readplane import mux as mux_mod
+
+    assert mux_mod.NTA_DISPATCHER_ENTRYPOINTS == ("ReadMux._wake_loop",)
+    assert "nomad_tpu/readplane/" in CORE_DIRS
+    assert "/readplane/" in WAIT_SCOPE_MARKERS
+    assert "/readplane/" in SWALLOW_SCOPE_MARKERS
+    offenders = [f for f in _tree_findings()
+                 if f.path.startswith("nomad_tpu/readplane/")]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+    assert [e for e in load_baseline()
+            if e["path"].startswith("nomad_tpu/readplane/")] == []
+    for rel in ("readplane/__init__.py", "readplane/mux.py"):
+        src = open(os.path.join(REPO, "nomad_tpu", rel)).read()
+        assert "nta: disable" not in src, rel
 
 
 def test_raft_funnel_stamp_set_covers_eviction_terminals():
